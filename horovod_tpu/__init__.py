@@ -1,0 +1,70 @@
+"""horovod_tpu — a TPU-native distributed training framework with the
+capabilities of Horovod (reference: sgpyc/horovod), built from scratch
+on JAX/XLA/pjit/Pallas.
+
+The 5-line experience, on TPU:
+
+    import horovod_tpu as hvd
+    hvd.init()
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    opt = hvd.DistributedOptimizer(optax.adam(1e-3 * hvd.size()))
+    # shard your data by hvd.rank() — train as usual
+
+Data plane: XLA collectives over TPU ICI/DCN via PJRT — no NCCL, MPI,
+or Gloo anywhere. Control plane: the JAX coordination service plus a
+native negotiation core. See SURVEY.md for the full component map of
+the reference this mirrors.
+"""
+
+from .common.basics import (  # noqa: F401
+    init, shutdown, is_initialized, rank, size, local_rank, local_size,
+    cross_rank, cross_size, is_homogeneous, start_timeline, stop_timeline,
+)
+from .common import basics as _basics
+from .ops.collective_ops import (  # noqa: F401
+    allreduce, allreduce_async, grouped_allreduce, grouped_allreduce_async,
+    allgather, allgather_async, broadcast, broadcast_async,
+    alltoall, alltoall_async, reducescatter, reducescatter_async,
+    barrier, join, synchronize, poll,
+    Average, Sum, Adasum, Min, Max, Product,
+)
+from .ops.compression import Compression  # noqa: F401
+from .ops.process_set import ProcessSet  # noqa: F401
+from .metadata import (  # noqa: F401
+    nccl_built, mpi_built, gloo_built, cuda_built, rocm_built,
+    xla_built, tpu_available, check_build_summary,
+)
+from .optim.distributed_optimizer import (  # noqa: F401
+    DistributedOptimizer, DistributedGradientTransformation,
+)
+from .optim.functions import (  # noqa: F401
+    broadcast_parameters, broadcast_optimizer_state, broadcast_object,
+    allreduce_parameters,
+)
+from . import elastic  # noqa: F401
+
+__version__ = "0.1.0"
+
+
+def add_process_set(ranks) -> ProcessSet:
+    """Register a new process set after init
+    (reference: hvd.add_process_set; requires
+    HOROVOD_DYNAMIC_PROCESS_SETS in the reference — always allowed
+    here since set registration is collective-free)."""
+    st = _basics._require_init()
+    ps = ranks if isinstance(ranks, ProcessSet) else ProcessSet(ranks)
+    return st.process_set_table.register(ps)
+
+
+def remove_process_set(process_set: ProcessSet) -> None:
+    st = _basics._require_init()
+    st.process_set_table.remove(process_set)
+
+
+def process_set_included(process_set_id: int) -> bool:
+    st = _basics._require_init()
+    return st.process_set_table.get(process_set_id).included()
+
+
+def global_process_set() -> ProcessSet:
+    return _basics._require_init().process_set_table.global_set

@@ -1,0 +1,98 @@
+"""Online autotuning of engine parameters.
+
+TPU-native analog of the reference's ParameterManager
+(reference: horovod/common/parameter_manager.cc — ParameterManager /
+BayesianParameter; utils/bayesian_optimization.cc). The reference tunes
+fusion-threshold / cycle-time with a Gaussian-process Bayesian search;
+here a coordinate hill-climb over the same discrete grids is used —
+the search space is tiny (two knobs, ~10 levels each) and the score
+function (bytes reduced per second) is the same. A GP is easy to add
+later behind the same record()/suggest() interface if the hill-climb
+plateaus badly on real pods.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional, Tuple
+
+_MB = 1024 * 1024
+
+FUSION_GRID = [0, 1 * _MB, 2 * _MB, 4 * _MB, 8 * _MB, 16 * _MB,
+               32 * _MB, 64 * _MB, 128 * _MB, 256 * _MB]
+CYCLE_GRID = [0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 50.0]
+
+
+class Autotuner:
+    def __init__(self, cfg):
+        self.enabled = True
+        self.warmup_remaining = cfg.autotune_warmup_samples
+        self.steps_per_sample = cfg.autotune_steps_per_sample
+        self.log_path = cfg.autotune_log
+        self.fusion_threshold = cfg.fusion_threshold
+        self.cycle_time_ms = cfg.cycle_time_ms
+        self._bytes = 0
+        self._seconds = 0.0
+        self._events = 0
+        self._best_score = -1.0
+        self._best = (self.fusion_threshold, self.cycle_time_ms)
+        self._knob = 0              # 0: fusion, 1: cycle
+        self._direction = 1
+        self._samples: List[Tuple[int, float, float]] = []
+        if self.log_path:
+            with open(self.log_path, "w") as f:
+                f.write("fusion_threshold,cycle_time_ms,score_bytes_per_sec\n")
+
+    # -- hot-path accounting -------------------------------------------------
+    def record(self, nbytes: int, seconds: float) -> None:
+        self._bytes += nbytes
+        self._seconds += seconds
+        self._events += 1
+        if self._events >= self.steps_per_sample:
+            self._finish_sample()
+
+    def _score(self) -> float:
+        return self._bytes / self._seconds if self._seconds > 0 else 0.0
+
+    def _finish_sample(self) -> None:
+        score = self._score()
+        self._bytes = 0
+        self._seconds = 0.0
+        self._events = 0
+        if self.warmup_remaining > 0:
+            self.warmup_remaining -= 1
+            return
+        self._samples.append(
+            (self.fusion_threshold, self.cycle_time_ms, score))
+        if self.log_path:
+            with open(self.log_path, "a") as f:
+                f.write(f"{self.fusion_threshold},{self.cycle_time_ms},"
+                        f"{score:.1f}\n")
+        if score > self._best_score:
+            self._best_score = score
+            self._best = (self.fusion_threshold, self.cycle_time_ms)
+        else:
+            # revert and turn around
+            self.fusion_threshold, self.cycle_time_ms = self._best
+            self._direction = -self._direction
+            self._knob = 1 - self._knob
+        self._step_knob()
+
+    def _step_knob(self) -> None:
+        if self._knob == 0:
+            grid, cur = FUSION_GRID, self.fusion_threshold
+        else:
+            grid, cur = CYCLE_GRID, self.cycle_time_ms
+        try:
+            i = grid.index(cur)
+        except ValueError:
+            i = min(range(len(grid)), key=lambda j: abs(grid[j] - cur))
+        j = max(0, min(len(grid) - 1, i + self._direction))
+        if self._knob == 0:
+            self.fusion_threshold = grid[j]
+        else:
+            self.cycle_time_ms = grid[j]
+
+    def best(self) -> Tuple[int, float]:
+        return self._best

@@ -1,0 +1,202 @@
+"""Global runtime state and lifecycle: init / shutdown / rank queries.
+
+TPU-native analog of the reference's core runtime entry points
+(reference: horovod/common/operations.cc — horovod_init /
+InitializeHorovodOnce / horovod_rank / horovod_size ...; state struct in
+horovod/common/global_state.h — HorovodGlobalState).
+
+Bootstrap maps the reference's MPI/Gloo rendezvous onto the JAX
+coordination service: the launcher provides HOROVOD_COORDINATOR_ADDR and
+rank/size env, and init() calls jax.distributed.initialize() — which is
+rendezvous + KV store + heartbeat/failure detection in one
+(reference analog: horovod/common/gloo/gloo_context.cc HTTPStore
+rendezvous against the launcher's RendezvousServer).
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+
+from . import logging as hlog
+from .config import Config
+from .topology import Topology, detect
+
+
+class HorovodTpuState:
+    """Singleton runtime state (reference: HorovodGlobalState)."""
+
+    def __init__(self):
+        self.initialized = False
+        self.config: Optional[Config] = None
+        self.topology: Optional[Topology] = None
+        self.process_set_table = None   # built by ops.process_set at init
+        self.engine = None              # eager fusion engine (ops.engine)
+        self.timeline = None            # timeline.Timeline when enabled
+        self.autotuner = None
+        self.elastic_enabled = False
+        self._lock = threading.Lock()
+        self._owns_distributed = False
+
+
+_state = HorovodTpuState()
+
+
+def _ensure_distributed(cfg: Config) -> bool:
+    """Bring up the JAX coordination service when launched multi-process.
+
+    Returns True if this call performed jax.distributed.initialize().
+    """
+    if cfg.coordinator_addr and cfg.size > 1:
+        jax.distributed.initialize(
+            coordinator_address=cfg.coordinator_addr,
+            num_processes=cfg.size,
+            process_id=max(cfg.rank, 0),
+        )
+        return True
+    return False
+
+
+def init(config_overrides: Optional[Dict[str, Any]] = None,
+         process_sets: Optional[list] = None) -> None:
+    """Initialize horovod_tpu. Idempotent (reference: InitializeHorovodOnce).
+
+    Args:
+      config_overrides: programmatic overrides for any HOROVOD_* knob.
+      process_sets: optional list of ProcessSet objects to register at
+        init, mirroring hvd.init(process_sets=...).
+    """
+    with _state._lock:
+        if _state.initialized:
+            return
+        cfg = Config(config_overrides)
+        _state.config = cfg
+        hlog.configure(cfg.log_level, cfg.log_timestamp)
+        _state._owns_distributed = _ensure_distributed(cfg)
+        _state.topology = detect(cfg)
+        hlog.set_rank(_state.topology.rank)
+
+        # Process-set table (global set at slot 0), built lazily here to
+        # avoid import cycles.
+        from ..ops.process_set import ProcessSetTable
+        _state.process_set_table = ProcessSetTable(_state.topology)
+        if process_sets:
+            for ps in process_sets:
+                _state.process_set_table.register(ps)
+
+        # Eager engine (queue + fusion + negotiation). Cheap to create;
+        # spawns its background thread on first eager enqueue.
+        from ..ops.engine import Engine
+        _state.engine = Engine(cfg, _state.topology,
+                               _state.process_set_table)
+
+        if cfg.timeline_path and _state.topology.rank == 0:
+            from ..timeline import Timeline
+            _state.timeline = Timeline(cfg.timeline_path,
+                                       mark_cycles=cfg.timeline_mark_cycles)
+            _state.engine.attach_timeline(_state.timeline)
+
+        if cfg.autotune:
+            from ..autotune import Autotuner
+            _state.autotuner = Autotuner(cfg)
+            _state.engine.attach_autotuner(_state.autotuner)
+
+        _state.initialized = True
+        hlog.info("horovod_tpu initialized: rank=%d size=%d local_rank=%d "
+                  "local_size=%d cross_rank=%d cross_size=%d devices=%d",
+                  _state.topology.rank, _state.topology.size,
+                  _state.topology.local_rank, _state.topology.local_size,
+                  _state.topology.cross_rank, _state.topology.cross_size,
+                  jax.local_device_count())
+
+
+def shutdown() -> None:
+    """Tear down the engine and (if we started it) the coordination
+    service (reference: horovod_shutdown in operations.cc)."""
+    with _state._lock:
+        if not _state.initialized:
+            return
+        if _state.engine is not None:
+            _state.engine.shutdown()
+            _state.engine = None
+        if _state.timeline is not None:
+            _state.timeline.close()
+            _state.timeline = None
+        if _state._owns_distributed:
+            try:
+                jax.distributed.shutdown()
+            except Exception as e:  # pragma: no cover - best effort
+                hlog.debug("jax.distributed.shutdown failed: %s", e)
+            _state._owns_distributed = False
+        _state.initialized = False
+        _state.process_set_table = None
+        _state.topology = None
+
+
+atexit.register(shutdown)
+
+
+def _require_init() -> HorovodTpuState:
+    if not _state.initialized:
+        raise RuntimeError(
+            "horovod_tpu has not been initialized; call hvd.init() first.")
+    return _state
+
+
+def state() -> HorovodTpuState:
+    return _state
+
+
+def is_initialized() -> bool:
+    return _state.initialized
+
+
+def rank() -> int:
+    return _require_init().topology.rank
+
+
+def size() -> int:
+    return _require_init().topology.size
+
+
+def local_rank() -> int:
+    return _require_init().topology.local_rank
+
+
+def local_size() -> int:
+    return _require_init().topology.local_size
+
+
+def cross_rank() -> int:
+    return _require_init().topology.cross_rank
+
+
+def cross_size() -> int:
+    return _require_init().topology.cross_size
+
+
+def is_homogeneous() -> bool:
+    return _require_init().topology.is_homogeneous
+
+
+def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
+    """Runtime timeline start (reference: TimelineController)."""
+    st = _require_init()
+    if st.topology.rank != 0:
+        return
+    if st.timeline is not None:
+        st.timeline.close()
+    from ..timeline import Timeline
+    st.timeline = Timeline(file_path, mark_cycles=mark_cycles)
+    st.engine.attach_timeline(st.timeline)
+
+
+def stop_timeline() -> None:
+    st = _require_init()
+    if st.timeline is not None:
+        st.timeline.close()
+        st.timeline = None
+        st.engine.attach_timeline(None)

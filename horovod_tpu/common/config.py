@@ -1,0 +1,205 @@
+"""Central configuration registry for horovod_tpu.
+
+Environment variables are the config system, mirroring the reference
+(reference: horovod/common/utils/env_parser.cc — SetBoolFromEnv /
+ParseStallInspectorFromEnv; constants declared in horovod/common/common.h).
+Every knob is declared here once with its env name, type, default and doc,
+so `hvdrun --help` and the doctor can enumerate them.
+
+The reference's HOROVOD_* names are kept verbatim where the concept carries
+over so users migrating from Horovod find the same switches; TPU-specific
+knobs use the same prefix for a single coherent namespace.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+
+def _parse_bool(v: str) -> bool:
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    env: str
+    type: Callable[[str], Any]
+    default: Any
+    doc: str
+
+
+# Registry of every configuration knob. Order matters only for docs.
+KNOBS: List[Knob] = [
+    # -- core engine ---------------------------------------------------------
+    Knob("HOROVOD_FUSION_THRESHOLD", int, 64 * 1024 * 1024,
+         "Tensor-fusion buffer threshold in bytes; pending gradients are "
+         "greedily packed into buckets up to this size before a single "
+         "fused allreduce is launched. 0 disables fusion."),
+    Knob("HOROVOD_CYCLE_TIME", float, 1.0,
+         "Background engine cycle time in milliseconds: how often the "
+         "pending-tensor queue is drained and negotiated."),
+    Knob("HOROVOD_CACHE_CAPACITY", int, 1024,
+         "Response-cache capacity (entries). Tensors seen before skip full "
+         "negotiation via a bit-vector exchange. 0 disables the cache."),
+    Knob("HOROVOD_HIERARCHICAL_ALLREDUCE", _parse_bool, False,
+         "Use hierarchical allreduce: reduce-scatter over ICI within a "
+         "slice, allreduce over DCN across slices, allgather over ICI."),
+    Knob("HOROVOD_BATCH_D2D_MEMCOPIES", _parse_bool, True,
+         "Batch bucket gather/scatter copies into single fused XLA "
+         "executables rather than per-tensor dispatches."),
+    # -- controller / backends ----------------------------------------------
+    Knob("HOROVOD_CONTROLLER", str, "auto",
+         "Control-plane implementation: 'native' (C++ core), 'python' "
+         "(pure-python fallback), or 'auto' (native if built)."),
+    Knob("HOROVOD_CPU_OPERATIONS", str, "xla",
+         "CPU data plane. Only 'xla' is supported: XLA CPU collectives "
+         "(the reference's gloo/mpi analog for tests)."),
+    Knob("HOROVOD_GPU_OPERATIONS", str, "",
+         "Unused on TPU; recognised for compatibility and ignored. The "
+         "data plane is always XLA collectives over ICI/DCN via PJRT."),
+    # -- timeline / profiling -----------------------------------------------
+    Knob("HOROVOD_TIMELINE", str, "",
+         "Path to write a Chrome-trace JSON timeline of per-tensor "
+         "negotiation/queue/fusion/collective phases (rank 0 only)."),
+    Knob("HOROVOD_TIMELINE_MARK_CYCLES", _parse_bool, False,
+         "Mark background-engine cycles in the timeline."),
+    # -- autotune ------------------------------------------------------------
+    Knob("HOROVOD_AUTOTUNE", _parse_bool, False,
+         "Enable online autotuning of fusion threshold and cycle time."),
+    Knob("HOROVOD_AUTOTUNE_LOG", str, "",
+         "If set, append autotune samples (params, score) to this CSV."),
+    Knob("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", int, 3,
+         "Autotune warmup samples discarded before scoring."),
+    Knob("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", int, 10,
+         "Training steps contributing to one autotune sample."),
+    # -- stall inspector -----------------------------------------------------
+    Knob("HOROVOD_STALL_CHECK_DISABLE", _parse_bool, False,
+         "Disable the stall inspector."),
+    Knob("HOROVOD_STALL_CHECK_TIME_SECONDS", float, 60.0,
+         "Warn when a tensor has waited this long for missing ranks."),
+    Knob("HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", float, 0.0,
+         "Hard-fail the job when a tensor stalls this long (0 = never)."),
+    # -- logging -------------------------------------------------------------
+    Knob("HOROVOD_LOG_LEVEL", str, "warning",
+         "Log level: trace, debug, info, warning, error, fatal."),
+    Knob("HOROVOD_LOG_TIMESTAMP", _parse_bool, True,
+         "Prefix log lines with a timestamp."),
+    # -- elastic -------------------------------------------------------------
+    Knob("HOROVOD_ELASTIC_TIMEOUT", float, 600.0,
+         "Seconds to wait for the elastic job to reach min size after a "
+         "membership change before giving up."),
+    # -- process sets --------------------------------------------------------
+    Knob("HOROVOD_DYNAMIC_PROCESS_SETS", _parse_bool, False,
+         "Allow process sets to be registered after init."),
+    # -- bootstrap / topology (TPU-specific) ---------------------------------
+    Knob("HOROVOD_RANK", int, -1,
+         "Process rank, set by the launcher. -1 = single-process mode."),
+    Knob("HOROVOD_SIZE", int, -1,
+         "World size (number of processes), set by the launcher."),
+    Knob("HOROVOD_LOCAL_RANK", int, -1,
+         "Rank within the host, set by the launcher."),
+    Knob("HOROVOD_LOCAL_SIZE", int, -1,
+         "Number of ranks on this host, set by the launcher."),
+    Knob("HOROVOD_CROSS_RANK", int, -1,
+         "Host index (rank across hosts / slices), set by the launcher."),
+    Knob("HOROVOD_CROSS_SIZE", int, -1,
+         "Number of hosts / slices, set by the launcher."),
+    Knob("HOROVOD_COORDINATOR_ADDR", str, "",
+         "host:port of the JAX coordination service (rendezvous, KV store, "
+         "heartbeats). Set by the launcher; empty = single-process."),
+    Knob("HOROVOD_CONTROL_ADDR", str, "",
+         "host:port of the control-plane KV/negotiation server used by the "
+         "eager engine. Defaults to the coordinator host on port+1."),
+    Knob("HOROVOD_GLOO_TIMEOUT_SECONDS", float, 30.0,
+         "Control-plane message timeout (name kept from the reference; "
+         "applies to the KV-store control plane)."),
+    Knob("HOROVOD_NUM_STREAMS", int, 1,
+         "Number of independent collective launch lanes for the eager "
+         "engine (the reference's HOROVOD_NUM_NCCL_STREAMS analog)."),
+]
+
+_KNOBS_BY_ENV: Dict[str, Knob] = {k.env: k for k in KNOBS}
+
+
+class Config:
+    """Snapshot of all knobs, parsed once at `hvd.init()`.
+
+    Mirrors the reference's one-shot env parse in InitializeHorovodOnce
+    (reference: horovod/common/operations.cc). Values may be overridden
+    programmatically via `hvd.init(config_overrides={...})`.
+    """
+
+    def __init__(self, overrides: Optional[Dict[str, Any]] = None,
+                 env: Optional[Dict[str, str]] = None):
+        env = os.environ if env is None else env
+        overrides = overrides or {}
+        self._values: Dict[str, Any] = {}
+        for knob in KNOBS:
+            if knob.env in overrides:
+                self._values[knob.env] = overrides[knob.env]
+            elif knob.env in env and env[knob.env] != "":
+                try:
+                    self._values[knob.env] = knob.type(env[knob.env])
+                except (ValueError, TypeError) as e:
+                    raise ValueError(
+                        f"Bad value for {knob.env}={env[knob.env]!r}: {e}")
+            else:
+                self._values[knob.env] = knob.default
+
+    def __getitem__(self, env_name: str) -> Any:
+        return self._values[env_name]
+
+    def get(self, env_name: str, default: Any = None) -> Any:
+        return self._values.get(env_name, default)
+
+    # Convenience attribute access: cfg.fusion_threshold etc.
+    _ATTR_MAP = {
+        "fusion_threshold": "HOROVOD_FUSION_THRESHOLD",
+        "cycle_time_ms": "HOROVOD_CYCLE_TIME",
+        "cache_capacity": "HOROVOD_CACHE_CAPACITY",
+        "hierarchical_allreduce": "HOROVOD_HIERARCHICAL_ALLREDUCE",
+        "controller": "HOROVOD_CONTROLLER",
+        "timeline_path": "HOROVOD_TIMELINE",
+        "timeline_mark_cycles": "HOROVOD_TIMELINE_MARK_CYCLES",
+        "autotune": "HOROVOD_AUTOTUNE",
+        "autotune_log": "HOROVOD_AUTOTUNE_LOG",
+        "autotune_warmup_samples": "HOROVOD_AUTOTUNE_WARMUP_SAMPLES",
+        "autotune_steps_per_sample": "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE",
+        "stall_check_disable": "HOROVOD_STALL_CHECK_DISABLE",
+        "stall_check_time": "HOROVOD_STALL_CHECK_TIME_SECONDS",
+        "stall_shutdown_time": "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS",
+        "log_level": "HOROVOD_LOG_LEVEL",
+        "log_timestamp": "HOROVOD_LOG_TIMESTAMP",
+        "elastic_timeout": "HOROVOD_ELASTIC_TIMEOUT",
+        "dynamic_process_sets": "HOROVOD_DYNAMIC_PROCESS_SETS",
+        "rank": "HOROVOD_RANK",
+        "size": "HOROVOD_SIZE",
+        "local_rank": "HOROVOD_LOCAL_RANK",
+        "local_size": "HOROVOD_LOCAL_SIZE",
+        "cross_rank": "HOROVOD_CROSS_RANK",
+        "cross_size": "HOROVOD_CROSS_SIZE",
+        "coordinator_addr": "HOROVOD_COORDINATOR_ADDR",
+        "control_addr": "HOROVOD_CONTROL_ADDR",
+        "control_timeout": "HOROVOD_GLOO_TIMEOUT_SECONDS",
+        "num_streams": "HOROVOD_NUM_STREAMS",
+    }
+
+    def __getattr__(self, name: str) -> Any:
+        try:
+            return self._values[self._ATTR_MAP[name]]
+        except KeyError:
+            raise AttributeError(name)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dict(self._values)
+
+
+def describe_knobs() -> str:
+    """Human-readable table of every knob for --help / doctor output."""
+    lines = []
+    for k in KNOBS:
+        lines.append(f"{k.env:<42} default={k.default!r}")
+        lines.append(f"    {k.doc}")
+    return "\n".join(lines)

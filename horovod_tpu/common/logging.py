@@ -1,0 +1,73 @@
+"""Leveled logging for horovod_tpu.
+
+Mirrors the reference's glog-style LOG(level) macros with
+HOROVOD_LOG_LEVEL / HOROVOD_LOG_TIMESTAMP control
+(reference: horovod/common/logging.cc).
+"""
+
+from __future__ import annotations
+
+import logging as _pylog
+import os
+import sys
+
+TRACE = 5
+_pylog.addLevelName(TRACE, "TRACE")
+
+_LEVELS = {
+    "trace": TRACE,
+    "debug": _pylog.DEBUG,
+    "info": _pylog.INFO,
+    "warning": _pylog.WARNING,
+    "error": _pylog.ERROR,
+    "fatal": _pylog.CRITICAL,
+}
+
+logger = _pylog.getLogger("horovod_tpu")
+
+
+class _RankFilter(_pylog.Filter):
+    """Injects the process rank into every record once known."""
+
+    rank = None
+
+    def filter(self, record):
+        record.hvdrank = f"[{self.rank}]" if self.rank is not None else ""
+        return True
+
+
+_rank_filter = _RankFilter()
+
+
+def configure(level: str = None, timestamp: bool = None) -> None:
+    level = level if level is not None else os.environ.get(
+        "HOROVOD_LOG_LEVEL", "warning")
+    if timestamp is None:
+        timestamp = os.environ.get("HOROVOD_LOG_TIMESTAMP", "1").lower() in (
+            "1", "true", "yes", "on")
+    logger.setLevel(_LEVELS.get(level.lower(), _pylog.WARNING))
+    logger.handlers.clear()
+    handler = _pylog.StreamHandler(sys.stderr)
+    fmt = "%(asctime)s " if timestamp else ""
+    fmt += "hvd%(hvdrank)s %(levelname)s %(message)s"
+    handler.setFormatter(_pylog.Formatter(fmt))
+    handler.addFilter(_rank_filter)
+    logger.addHandler(handler)
+    logger.propagate = False
+
+
+def set_rank(rank: int) -> None:
+    _rank_filter.rank = rank
+
+
+def trace(msg, *args):
+    logger.log(TRACE, msg, *args)
+
+
+debug = logger.debug
+info = logger.info
+warning = logger.warning
+error = logger.error
+fatal = logger.critical
+
+configure()

@@ -1,0 +1,82 @@
+"""Process/device topology bookkeeping.
+
+Maps Horovod's rank trichotomy onto the TPU world
+(reference: horovod/common/mpi/mpi_context.cc — global/local/cross
+communicator split):
+
+  rank        — index of this *process* in the job (one process per host
+                in multi-controller JAX; the launcher sets HOROVOD_RANK).
+  local_rank  — index of this process among processes on the same host.
+  cross_rank  — index of this process's host (slice) among hosts.
+
+Devices are a separate axis: a process owns jax.local_devices() chips
+(4 on a v5p host). The classic eager API reduces across *processes*; the
+jit path shards across *all chips* via horovod_tpu.parallel meshes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+from typing import List, Optional
+
+import jax
+
+
+@dataclasses.dataclass
+class Topology:
+    rank: int
+    size: int
+    local_rank: int
+    local_size: int
+    cross_rank: int
+    cross_size: int
+    hostname: str
+
+    @property
+    def is_homogeneous(self) -> bool:
+        # With launcher-provided env this is exact for this host; a
+        # truly heterogeneous job would need a cross-host exchange, which
+        # the launcher performs and reflects into the env.
+        return self.size % max(self.local_size, 1) == 0
+
+
+def detect(cfg) -> Topology:
+    """Derive topology from launcher env, falling back to JAX runtime."""
+    hostname = socket.gethostname()
+    if cfg.size > 0:
+        rank = max(cfg.rank, 0)
+        size = cfg.size
+        local_rank = cfg.local_rank if cfg.local_rank >= 0 else 0
+        local_size = cfg.local_size if cfg.local_size >= 0 else 1
+        cross_rank = cfg.cross_rank if cfg.cross_rank >= 0 else rank // max(local_size, 1)
+        cross_size = cfg.cross_size if cfg.cross_size >= 0 else (
+            size + local_size - 1) // max(local_size, 1)
+    else:
+        # No launcher: single process (possibly already-initialized
+        # jax.distributed from the user's own bootstrap).
+        rank = jax.process_index()
+        size = jax.process_count()
+        local_rank = 0
+        local_size = 1
+        cross_rank = rank
+        cross_size = size
+    return Topology(rank=rank, size=size, local_rank=local_rank,
+                    local_size=local_size, cross_rank=cross_rank,
+                    cross_size=cross_size, hostname=hostname)
+
+
+def process_device(process_index: int) -> jax.Device:
+    """The representative device of a process, used for the eager
+    process-level mesh (one device per rank)."""
+    devs = [d for d in jax.devices() if d.process_index == process_index]
+    if not devs:
+        raise RuntimeError(f"no devices for process {process_index}")
+    return min(devs, key=lambda d: d.id)
+
+
+def process_mesh_devices(ranks: Optional[List[int]] = None) -> List[jax.Device]:
+    """One device per process, in rank order (optionally a subset)."""
+    n = jax.process_count()
+    ranks = list(range(n)) if ranks is None else ranks
+    return [process_device(r) for r in ranks]

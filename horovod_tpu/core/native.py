@@ -1,0 +1,37 @@
+"""ctypes loader for the native (C++) control-plane core.
+
+The C++ core (core/cc/) provides the tensor queue, negotiation
+controller, fusion planner, KV-store client/server and timeline writer
+— the TPU-native equivalents of the reference's horovod/common/ C++
+core. Built as libhvdtpu_core.so via core/cc/Makefile; this module
+loads it and exposes a thin API. Falls back gracefully (available() ==
+False) when not built, in which case the pure-python control plane in
+ops/controller.py is used (HOROVOD_CONTROLLER=python).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+_lib = None
+_tried = False
+
+
+def _lib_path() -> str:
+    return os.path.join(os.path.dirname(__file__), "cc",
+                        "libhvdtpu_core.so")
+
+
+def load():
+    global _lib, _tried
+    if _lib is None and not _tried:
+        _tried = True
+        path = _lib_path()
+        if os.path.exists(path):
+            _lib = ctypes.CDLL(path)
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None

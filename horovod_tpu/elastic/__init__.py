@@ -1,0 +1,13 @@
+"""Elastic training: fault-tolerant, resizable jobs.
+
+Reference: horovod/torch/elastic/__init__.py (run decorator),
+horovod/torch/elastic/state.py (State/TorchState), horovod/common
+elastic exceptions. See elastic/state.py and elastic/run.py here.
+"""
+
+from .state import (  # noqa: F401
+    State, ObjectState, JaxState,
+    HorovodInternalError, HostsUpdatedInterrupt,
+)
+from .run import run  # noqa: F401
+from .sampler import ElasticSampler  # noqa: F401

@@ -1,0 +1,37 @@
+"""Worker-side membership-change notification sink.
+
+Reference: horovod/runner/elastic/worker.py —
+WorkerNotificationService/Manager: the driver pushes HostsUpdated
+messages; workers surface them at the next commit/batch boundary.
+Here the launcher's driver pokes a tiny TCP listener (elastic/worker.py)
+which flips this flag; `State.check_host_updates()` polls it.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_lock = threading.Lock()
+_pending = False
+_last_update_info = None
+
+
+def notify(info=None) -> None:
+    global _pending, _last_update_info
+    with _lock:
+        _pending = True
+        _last_update_info = info
+
+
+def pending() -> bool:
+    return _pending
+
+
+def consume():
+    """Clear the flag, returning the update info."""
+    global _pending, _last_update_info
+    with _lock:
+        info = _last_update_info
+        _pending = False
+        _last_update_info = None
+        return info

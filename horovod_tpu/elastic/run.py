@@ -1,0 +1,71 @@
+"""The hvd.elastic.run decorator: retry loop around training.
+
+Reference: horovod/torch/elastic/__init__.py — run():
+  while True:
+      try: train(state)
+      except HorovodInternalError: state.restore(); reinit; state.sync()
+      except HostsUpdatedInterrupt: reinit; state.sync()
+
+TPU adaptation: "reinit" tears down and re-creates the JAX coordination
+service connection with the new world (slice membership), then rebuilds
+process-set meshes. Within a slice the ICI topology is fixed, so
+membership changes happen at slice granularity.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from typing import Callable
+
+from ..common import basics, logging as hlog
+from . import notifications
+from .state import HorovodInternalError, HostsUpdatedInterrupt
+
+
+def _reinitialize() -> None:
+    """Tear down and re-init against the (possibly updated) rendezvous.
+
+    The elastic driver re-publishes rank/size env via the rendezvous
+    before workers reach this point (reference: the updated-rendezvous
+    re-poll in horovod/runner/elastic/rendezvous.py).
+    """
+    basics.shutdown()
+    from .worker import refresh_env_from_rendezvous
+    refresh_env_from_rendezvous()
+    basics.init()
+
+
+def run(func: Callable) -> Callable:
+    """Decorator making a training function elastic. The wrapped
+    function must take a State as its first argument."""
+
+    @functools.wraps(func)
+    def wrapper(state, *args, **kwargs):
+        notifications.consume()
+        reset_limit = int(os.environ.get("HOROVOD_ELASTIC_RESET_LIMIT", 0))
+        resets = 0
+        while True:
+            try:
+                return func(state, *args, **kwargs)
+            except HorovodInternalError:
+                hlog.warning("elastic: collective failure — restoring "
+                             "committed state and re-initializing")
+                state.restore()
+                _reinitialize()
+                state.on_reset()
+                state.sync()
+            except HostsUpdatedInterrupt as e:
+                hlog.info("elastic: hosts updated — re-initializing")
+                notifications.consume()
+                _reinitialize()
+                state.on_reset()
+                if not e.skip_sync:
+                    state.sync()
+            resets += 1
+            if reset_limit and resets >= reset_limit:
+                raise RuntimeError(
+                    f"elastic reset limit ({reset_limit}) reached")
+
+    return wrapper

@@ -1,0 +1,68 @@
+"""Resharding-aware data sampler for elastic training.
+
+Reference: horovod/torch/elastic/sampler.py — ElasticSampler: shards
+indices across the current world, tracks processed indices, and
+reshards the *remaining* data when the world changes so no sample is
+repeated or dropped within an epoch.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterator, List, Optional
+
+
+class ElasticSampler:
+    def __init__(self, num_samples: int, shuffle: bool = True,
+                 seed: int = 0):
+        self.num_samples = num_samples
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.processed_indices: List[int] = []
+        self.rank = 0
+        self.world_size = 1
+        self._reset()
+
+    def _reset(self) -> None:
+        import horovod_tpu as hvd
+        if hvd.is_initialized():
+            self.rank = hvd.rank()
+            self.world_size = hvd.size()
+        remaining = sorted(set(range(self.num_samples))
+                           - set(self.processed_indices))
+        if self.shuffle:
+            rng = random.Random(self.seed + self.epoch)
+            rng.shuffle(remaining)
+        self.remaining_indices = remaining
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+        self.processed_indices = []
+        self._reset()
+
+    def record_batch(self, batch_idx: int, batch_size: int) -> None:
+        """Mark this rank's samples for the batch as processed (kept in
+        the elastic State so restore() rewinds it)."""
+        start = batch_idx * batch_size
+        mine = self.local_indices()[start:start + batch_size]
+        self.processed_indices.extend(mine)
+
+    def reset_from_state(self) -> None:
+        """Called after sync() on reset: reshard remaining data over the
+        new world."""
+        self._reset()
+
+    def local_indices(self) -> List[int]:
+        n = len(self.remaining_indices)
+        per = n // self.world_size
+        # drop the ragged tail so all ranks step together (reference
+        # behavior: even sharding)
+        return [self.remaining_indices[i]
+                for i in range(self.rank * per, (self.rank + 1) * per)]
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.local_indices())
+
+    def __len__(self) -> int:
+        return len(self.remaining_indices) // max(self.world_size, 1)

@@ -1,0 +1,144 @@
+"""Elastic state: commit / restore / sync.
+
+API parity with the reference's elastic state layer
+(reference: horovod/torch/elastic/state.py — State / TorchState;
+horovod/common/elastic protocol exceptions). The design ports nearly
+verbatim because it is framework-agnostic: snapshots live in host
+memory; `commit()` saves, `restore()` rolls back after a failure,
+`sync()` broadcasts rank-0's state to everyone after a membership
+change.
+
+On TPU the unit of membership is a *slice* (a chip failure kills its
+slice), so re-initialization rebuilds the device mesh; within-slice
+topology is fixed.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class HorovodInternalError(Exception):
+    """A collective failed (peer died, control plane timeout); training
+    should restore committed state and re-initialize."""
+
+
+class HostsUpdatedInterrupt(Exception):
+    """Membership changed gracefully; re-initialize without restore."""
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__()
+        self.skip_sync = skip_sync
+
+
+def _to_host(tree: Any) -> Any:
+    return jax.tree_util.tree_map(
+        lambda x: np.asarray(x) if isinstance(x, (jax.Array, np.ndarray))
+        else x, tree)
+
+
+class State:
+    """Base elastic state (reference: horovod/common/elastic State)."""
+
+    def __init__(self, **kwargs):
+        self._saved: Dict[str, Any] = {}
+        self._reset_callbacks = []
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def register_reset_callbacks(self, callbacks) -> None:
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        for cb in self._reset_callbacks:
+            cb()
+
+    def commit(self) -> None:
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self) -> None:
+        """Raise HostsUpdatedInterrupt if the driver pushed a membership
+        change notification (wired up by elastic/run.py)."""
+        from . import notifications
+        if notifications.pending():
+            raise HostsUpdatedInterrupt(skip_sync=False)
+
+    # subclass responsibilities
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+
+class ObjectState(State):
+    """Elastic state of picklable python attributes
+    (reference: horovod/common/elastic ObjectState)."""
+
+    def __init__(self, bcast_object: Optional[Callable] = None, **kwargs):
+        if bcast_object is None:
+            from ..optim.functions import broadcast_object
+            bcast_object = broadcast_object
+        self._bcast_object = bcast_object
+        self._known_attrs = list(kwargs)
+        super().__init__(**kwargs)
+        self.save()
+
+    def save(self) -> None:
+        self._saved = {k: copy.deepcopy(getattr(self, k))
+                       for k in self._known_attrs}
+
+    def restore(self) -> None:
+        for k, v in self._saved.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self) -> None:
+        synced = self._bcast_object(
+            {k: getattr(self, k) for k in self._known_attrs}, root_rank=0)
+        for k, v in synced.items():
+            setattr(self, k, v)
+        self.save()
+
+
+class JaxState(ObjectState):
+    """Elastic state for JAX training: params/opt_state pytrees plus
+    arbitrary python attributes (reference analog: TorchState holding
+    model + optimizer + custom attrs).
+
+    Pytree snapshots are host-offloaded numpy copies, so device OOM or
+    a dead slice cannot take the snapshot with it.
+    """
+
+    def __init__(self, params: Any = None, opt_state: Any = None, **kwargs):
+        self.params = params
+        self.opt_state = opt_state
+        self._tree_attrs = ["params", "opt_state"]
+        super().__init__(**kwargs)
+
+    def save(self) -> None:
+        super().save()
+        self._tree_saved = {k: _to_host(getattr(self, k))
+                            for k in self._tree_attrs}
+
+    def restore(self) -> None:
+        super().restore()
+        for k, v in self._tree_saved.items():
+            setattr(self, k, jax.tree_util.tree_map(jnp.asarray, v)
+                    if v is not None else None)
+
+    def sync(self) -> None:
+        from ..optim.functions import broadcast_parameters
+        for k in self._tree_attrs:
+            v = getattr(self, k)
+            if v is not None:
+                setattr(self, k, broadcast_parameters(v, root_rank=0))
+        ObjectState.sync(self)

@@ -1,0 +1,85 @@
+"""Worker-side elastic plumbing: notification listener + rendezvous
+re-poll.
+
+Reference: horovod/runner/elastic/worker.py (WorkerNotificationService)
+and horovod/runner/elastic/rendezvous.py (workers re-read their rank
+assignment from the rendezvous server after membership changes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import urllib.request
+from typing import Optional
+
+from ..common import logging as hlog
+from . import notifications
+
+_listener: Optional["NotificationListener"] = None
+
+
+class NotificationListener:
+    """Tiny TCP listener the driver pokes on membership changes."""
+
+    def __init__(self, port: int = 0):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+        self._thread = threading.Thread(target=self._loop,
+                                        name="hvd-elastic-notify",
+                                        daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            try:
+                data = conn.recv(65536)
+                info = json.loads(data.decode()) if data else None
+                hlog.info("elastic: hosts-updated notification: %s", info)
+                notifications.notify(info)
+                conn.sendall(b"ok")
+            except Exception as e:
+                hlog.debug("notification recv error: %s", e)
+            finally:
+                conn.close()
+
+    def stop(self) -> None:
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def start_listener() -> int:
+    """Start (once) the notification listener; returns its port."""
+    global _listener
+    if _listener is None:
+        _listener = NotificationListener()
+    return _listener.port
+
+
+def refresh_env_from_rendezvous() -> None:
+    """Re-read rank/size/coordinator assignment from the rendezvous
+    KV server after a membership change. No-op outside elastic runs."""
+    addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "")
+    if not addr:
+        return
+    me = os.environ.get("HOROVOD_HOSTNAME", socket.gethostname())
+    lr = os.environ.get("HOROVOD_LOCAL_RANK", "0")
+    url = f"http://{addr}/rank/{me}/{lr}"
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        assignment = json.loads(resp.read().decode())
+    for k, v in assignment.items():
+        os.environ[k] = str(v)
+    hlog.info("elastic: refreshed assignment: %s", assignment)

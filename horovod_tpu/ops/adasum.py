@@ -1,0 +1,130 @@
+"""Adasum adaptive-summation reduction (arXiv:2006.02924).
+
+TPU-native re-design of the reference's Adasum op family
+(reference: horovod/common/ops/adasum/adasum.h —
+Adasum<Communicator_type>::DispatchFusedAllreduce, recursive
+vector-halving/doubling; adasum_mpi.cc; ops/adasum_gpu_operations.cc).
+
+The pairwise combine of gradients a, b is an orthogonal-projection
+blend instead of a plain sum:
+
+    combined = (1 - (a.b) / (2*|a|^2)) * a  +  (1 - (a.b) / (2*|b|^2)) * b
+
+which damps the shared direction when a and b point the same way
+(large-batch friendly) and reduces to a+b when they are orthogonal.
+
+Where the reference runs a log2(n)-round halving-doubling exchange over
+MPI, here every member gathers all contributions with one XLA
+`all_gather` over the ICI mesh and folds them in an identical binary
+tree locally. On TPU the gather of a gradient bucket rides ICI at full
+bandwidth and the fold is fused elementwise math on the MXU/VPU —
+a far better fit than emulating the MPI message schedule; the result is
+bit-identical on every rank because the tree order is deterministic.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .process_set import ProcessSet
+from . import dispatch
+
+
+def _pair_combine(a, b):
+    """The Adasum combine for one pair, with zero-norm guards
+    (reference: adasum.h ComputeDotAndNormSqrds + ScaledAdd)."""
+    dot = jnp.vdot(a, b).real.astype(jnp.float32)
+    asq = jnp.vdot(a, a).real.astype(jnp.float32)
+    bsq = jnp.vdot(b, b).real.astype(jnp.float32)
+    ca = jnp.where(asq == 0, 1.0, 1.0 - dot / (2.0 * jnp.maximum(asq, 1e-30)))
+    cb = jnp.where(bsq == 0, 1.0, 1.0 - dot / (2.0 * jnp.maximum(bsq, 1e-30)))
+    return ca.astype(a.dtype) * a + cb.astype(b.dtype) * b
+
+
+def _tree_fold(rows):
+    """Deterministic binary-tree fold of (n, d) stacked contributions.
+    Odd member passes through to the next round, matching the
+    reference's handling of non-power-of-two groups."""
+    items = list(rows)
+    while len(items) > 1:
+        nxt = []
+        for i in range(0, len(items) - 1, 2):
+            nxt.append(_pair_combine(items[i], items[i + 1]))
+        if len(items) % 2:
+            nxt.append(items[-1])
+        items = nxt
+    return items[0]
+
+
+@functools.lru_cache(maxsize=None)
+def _adasum_kernel(mesh, n: int, sig: Tuple):
+    shapes = [s for s, _ in sig]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+
+    def body(*blocks):
+        flats = [b.reshape(-1) for b in blocks]
+        concat = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+        g = lax.all_gather(concat, "proc")          # (n, total)
+        red = _tree_fold([g[i] for i in range(n)])
+        outs = []
+        off = 0
+        for s, sz in zip(shapes, sizes):
+            outs.append(red[off:off + sz].reshape((1,) + s))
+            off += sz
+        return tuple(outs)
+
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=tuple(P("proc") for _ in sig),
+                       out_specs=tuple(P("proc") for _ in sig))
+    return jax.jit(fn)
+
+
+def adasum_allreduce(tensors: List[jax.Array], pset: ProcessSet,
+                     prescale: float = 1.0, postscale: float = 1.0
+                     ) -> List[jax.Array]:
+    """Adasum-allreduce a same-dtype group across the process set.
+    prescale multiplies each contribution before the fold, postscale
+    the combined result (reference: prescale/postscale handling in
+    horovod/common/ops/adasum_mpi_operations.cc)."""
+    tensors = [jnp.asarray(t) for t in tensors]
+
+    def scale(ts, f):
+        if f == 1.0:
+            return ts
+        return [t * jnp.asarray(f, t.dtype) for t in ts]
+
+    if pset.size == 1:
+        return scale(scale(tensors, prescale), postscale)
+    tensors = scale(tensors, prescale)
+    sig = dispatch._sig(tensors)
+    kern = _adasum_kernel(pset.mesh, pset.size, sig)
+    gins = [dispatch.to_global(t, pset) for t in tensors]
+    gouts = kern(*gins)
+    return scale([dispatch.local_shard(g) for g in gouts], postscale)
+
+
+def adasum_reference(contributions: List[np.ndarray]) -> np.ndarray:
+    """Pure-numpy model of the tree fold, for tests."""
+    def comb(a, b):
+        dot = float(np.vdot(a, b))
+        asq = float(np.vdot(a, a))
+        bsq = float(np.vdot(b, b))
+        ca = 1.0 if asq == 0 else 1.0 - dot / (2 * asq)
+        cb = 1.0 if bsq == 0 else 1.0 - dot / (2 * bsq)
+        return ca * a + cb * b
+
+    items = [np.asarray(c, np.float64) for c in contributions]
+    while len(items) > 1:
+        nxt = [comb(items[i], items[i + 1])
+               for i in range(0, len(items) - 1, 2)]
+        if len(items) % 2:
+            nxt.append(items[-1])
+        items = nxt
+    return items[0]

@@ -1,0 +1,320 @@
+"""Public eager collective API: hvd.allreduce / allgather / broadcast /
+alltoall / reducescatter / barrier / join and their _async variants.
+
+API parity with the reference's Python op layer
+(reference: horovod/torch/mpi_ops.py — allreduce / allreduce_async /
+grouped_allreduce / allgather / broadcast / alltoall / reducescatter /
+synchronize / poll; op constants Average/Sum/Adasum/Min/Max/Product),
+with jax.Arrays in place of torch tensors. Handles are integers, and
+`synchronize(handle)` blocks, exactly like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.basics import _require_init
+from . import dispatch
+from .adasum import adasum_allreduce
+from .compression import Compression, NoneCompressor
+from .dispatch import AVERAGE, SUM, ADASUM, MIN, MAX, PRODUCT
+from .process_set import ProcessSet
+
+# Re-exported op constants (hvd.Average, hvd.Sum, ...).
+Average = AVERAGE
+Sum = SUM
+Adasum = ADASUM
+Min = MIN
+Max = MAX
+Product = PRODUCT
+
+
+def _pset(process_set: Optional[ProcessSet]) -> ProcessSet:
+    st = _require_init()
+    if process_set is None:
+        return st.process_set_table.global_set
+    if process_set.process_set_id is None:
+        raise ValueError("process set is not registered; pass it to "
+                         "hvd.init(process_sets=...) or hvd.add_process_set")
+    if not process_set.included():
+        raise ValueError(
+            f"rank {st.topology.rank} is not a member of {process_set}")
+    return process_set
+
+
+def _resolve_op(op: Optional[int], average: Optional[bool]) -> int:
+    if op is not None and average is not None:
+        raise ValueError("specify either op or average, not both")
+    if average is not None:
+        return AVERAGE if average else SUM
+    return AVERAGE if op is None else op
+
+
+def _nbytes(tensors) -> int:
+    return int(sum(np.prod(t.shape) * jnp.dtype(t.dtype).itemsize
+                   for t in tensors))
+
+
+def _check_inexact_for_average(op: int, tensors) -> None:
+    if op == AVERAGE:
+        for t in tensors:
+            if not jnp.issubdtype(jnp.asarray(t).dtype, jnp.inexact):
+                raise ValueError(
+                    "hvd.Average is not supported for integer tensors; "
+                    "use op=hvd.Sum (matches the reference's behavior)")
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+# ---------------------------------------------------------------------------
+
+def grouped_allreduce_async(tensors: List[jax.Array], average=None,
+                            name: Optional[str] = None, op=None,
+                            prescale_factor: float = 1.0,
+                            postscale_factor: float = 1.0,
+                            compression=NoneCompressor,
+                            process_set: Optional[ProcessSet] = None) -> int:
+    st = _require_init()
+    pset = _pset(process_set)
+    rop = _resolve_op(op, average)
+    _check_inexact_for_average(rop, tensors)
+    name = name or st.engine.auto_name("grouped_allreduce")
+
+    comp = [compression.compress(t) for t in tensors]
+    wire = [c[0] for c in comp]
+    ctxs = [c[1] for c in comp]
+
+    def fn():
+        outs = _grouped_by_dtype(wire, pset, rop, prescale_factor,
+                                 postscale_factor)
+        return [compression.decompress(o, c) for o, c in zip(outs, ctxs)]
+
+    h = st.engine.run(name, _nbytes(wire), fn)
+    return h.id
+
+
+def _grouped_by_dtype(tensors, pset, rop, prescale, postscale):
+    """Split a mixed-dtype group into same-dtype fused subgroups
+    (the reference controller only fuses same-dtype responses)."""
+    if rop == ADASUM:
+        return dispatch.group_by_dtype(
+            tensors, lambda g: adasum_allreduce(g, pset, prescale,
+                                                postscale))
+    return dispatch.group_by_dtype(
+        tensors, lambda g: dispatch.allreduce_group(g, pset, rop,
+                                                    prescale, postscale))
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None,
+                      prescale_factor=1.0, postscale_factor=1.0,
+                      compression=NoneCompressor,
+                      process_set=None) -> List[jax.Array]:
+    h = grouped_allreduce_async(tensors, average=average, name=name, op=op,
+                                prescale_factor=prescale_factor,
+                                postscale_factor=postscale_factor,
+                                compression=compression,
+                                process_set=process_set)
+    return synchronize(h)
+
+
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0,
+                    compression=NoneCompressor, process_set=None) -> int:
+    st = _require_init()
+    name = name or st.engine.auto_name("allreduce")
+    pset = _pset(process_set)
+    rop = _resolve_op(op, average)
+    _check_inexact_for_average(rop, [tensor])
+    wire, ctx = compression.compress(tensor)
+
+    def fn():
+        if rop == ADASUM:
+            out = adasum_allreduce([wire], pset, prescale_factor,
+                                   postscale_factor)[0]
+        else:
+            out = dispatch.allreduce_group([wire], pset, rop,
+                                           prescale_factor,
+                                           postscale_factor)[0]
+        return compression.decompress(out, ctx)
+
+    h = st.engine.run(name, _nbytes([wire]), fn)
+    return h.id
+
+
+def allreduce(tensor, average=None, name=None, op=None,
+              prescale_factor=1.0, postscale_factor=1.0,
+              compression=NoneCompressor, process_set=None) -> jax.Array:
+    h = allreduce_async(tensor, average=average, name=name, op=op,
+                        prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor,
+                        compression=compression, process_set=process_set)
+    return synchronize(h)
+
+
+# ---------------------------------------------------------------------------
+# allgather
+# ---------------------------------------------------------------------------
+
+def allgather_async(tensor, name: Optional[str] = None,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    st = _require_init()
+    pset = _pset(process_set)
+    name = name or st.engine.auto_name("allgather")
+    t = jnp.asarray(tensor)
+    if t.ndim == 0:
+        t = t[None]
+
+    def fn():
+        sizes = dispatch.exchange_int_vector([t.shape[0]], pset)[:, 0]
+        return dispatch.allgather(t, pset, [int(s) for s in sizes])
+
+    h = st.engine.run(name, _nbytes([t]), fn)
+    return h.id
+
+
+def allgather(tensor, name=None, process_set=None) -> jax.Array:
+    return synchronize(allgather_async(tensor, name=name,
+                                       process_set=process_set))
+
+
+# ---------------------------------------------------------------------------
+# broadcast
+# ---------------------------------------------------------------------------
+
+def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
+                    process_set: Optional[ProcessSet] = None) -> int:
+    st = _require_init()
+    pset = _pset(process_set)
+    name = name or st.engine.auto_name("broadcast")
+    if root_rank not in pset.ranks:
+        raise ValueError(f"root_rank {root_rank} not in {pset}")
+    set_root = pset.ranks.index(root_rank)
+    t = jnp.asarray(tensor)
+
+    def fn():
+        return dispatch.broadcast(t, set_root, pset)
+
+    h = st.engine.run(name, _nbytes([t]), fn)
+    return h.id
+
+
+def broadcast(tensor, root_rank: int, name=None,
+              process_set=None) -> jax.Array:
+    return synchronize(broadcast_async(tensor, root_rank, name=name,
+                                       process_set=process_set))
+
+
+# ---------------------------------------------------------------------------
+# alltoall
+# ---------------------------------------------------------------------------
+
+def alltoall_async(tensor, splits: Optional[Sequence[int]] = None,
+                   name: Optional[str] = None,
+                   process_set: Optional[ProcessSet] = None) -> int:
+    st = _require_init()
+    pset = _pset(process_set)
+    name = name or st.engine.auto_name("alltoall")
+    t = jnp.asarray(tensor)
+    n = pset.size
+    if splits is None:
+        if t.shape[0] % n:
+            raise ValueError(
+                f"alltoall without splits needs first dim divisible by "
+                f"set size ({t.shape[0]} % {n})")
+        splits = [t.shape[0] // n] * n
+    splits = [int(s) for s in splits]
+    if len(splits) != n:
+        raise ValueError(f"splits must have length {n}, got {len(splits)}")
+    if sum(splits) != t.shape[0]:
+        raise ValueError("splits must sum to the first dimension")
+
+    def fn():
+        mat = dispatch.exchange_int_vector(splits, pset)   # (n, n)
+        me = pset.rank()
+        recv = [int(mat[src, me]) for src in range(n)]
+        # Global max over the whole split matrix so every rank compiles
+        # the same padded SPMD program.
+        maxsplit = max(int(mat.max()), 1)
+        out = dispatch.alltoall(t, splits, recv, pset, maxsplit=maxsplit)
+        return out, jnp.asarray(recv, jnp.int32)
+
+    h = st.engine.run(name, _nbytes([t]), fn)
+    return h.id
+
+
+def alltoall(tensor, splits=None, name=None, process_set=None):
+    """Returns (output, received_splits), like the reference when splits
+    is given; returns just output when splits is None."""
+    out, recv = synchronize(alltoall_async(tensor, splits=splits, name=name,
+                                           process_set=process_set))
+    return out if splits is None else (out, recv)
+
+
+# ---------------------------------------------------------------------------
+# reducescatter
+# ---------------------------------------------------------------------------
+
+def reducescatter_async(tensor, op=None, name: Optional[str] = None,
+                        prescale_factor: float = 1.0,
+                        postscale_factor: float = 1.0,
+                        process_set: Optional[ProcessSet] = None) -> int:
+    st = _require_init()
+    pset = _pset(process_set)
+    rop = _resolve_op(op, None)
+    if rop not in (SUM, AVERAGE):
+        raise ValueError("reducescatter supports Sum and Average only")
+    name = name or st.engine.auto_name("reducescatter")
+    t = jnp.asarray(tensor)
+    _check_inexact_for_average(rop, [t])
+
+    def fn():
+        return dispatch.reducescatter(t, pset, rop, prescale_factor,
+                                      postscale_factor)
+
+    h = st.engine.run(name, _nbytes([t]), fn)
+    return h.id
+
+
+def reducescatter(tensor, op=None, name=None, prescale_factor=1.0,
+                  postscale_factor=1.0, process_set=None) -> jax.Array:
+    return synchronize(reducescatter_async(
+        tensor, op=op, name=name, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, process_set=process_set))
+
+
+# ---------------------------------------------------------------------------
+# barrier / join / handle plumbing
+# ---------------------------------------------------------------------------
+
+def barrier(process_set: Optional[ProcessSet] = None) -> None:
+    dispatch.barrier(_pset(process_set))
+
+
+def join(device: int = -1) -> int:
+    """Mark this rank as done; requires the negotiated controller
+    (reference: horovod/common/ops/collective_operations.cc JoinOp).
+    Implemented by ops/controller.py when the eager cycle engine is
+    active; raises otherwise because uncoordinated inline dispatch
+    cannot know about ops it did not submit."""
+    st = _require_init()
+    if st.engine.controller is None:
+        raise NotImplementedError(
+            "hvd.join() is not available yet: it needs the negotiated "
+            "cycle controller (ops/controller.py), which is not active "
+            "in this build — inline dispatch cannot participate in ops "
+            "submitted only by other ranks")
+    return st.engine.controller.join()
+
+
+def synchronize(handle: int):
+    st = _require_init()
+    return st.engine.synchronize(st.engine.get_handle(handle))
+
+
+def poll(handle: int) -> bool:
+    st = _require_init()
+    return st.engine.get_handle(handle).done()

@@ -1,0 +1,66 @@
+"""Gradient compression for collectives.
+
+Mirrors the reference's Compression API
+(reference: horovod/torch/compression.py / horovod/tensorflow/compression.py
+— Compression.none / Compression.fp16, Compressor.compress/decompress).
+
+On TPU the natural wire dtype is bfloat16 (same byte savings as fp16,
+no overflow cliff, native MXU dtype), so `Compression.bf16` is added and
+`Compression.fp16` is kept for parity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Compressor:
+    @staticmethod
+    def compress(tensor):
+        """Returns (compressed_tensor, context_for_decompress)."""
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+class NoneCompressor(Compressor):
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _CastCompressor(Compressor):
+    wire_dtype = None
+
+    @classmethod
+    def compress(cls, tensor):
+        tensor = jnp.asarray(tensor)
+        if jnp.issubdtype(tensor.dtype, jnp.floating):
+            return tensor.astype(cls.wire_dtype), tensor.dtype
+        return tensor, None
+
+    @classmethod
+    def decompress(cls, tensor, ctx):
+        return tensor.astype(ctx) if ctx is not None else tensor
+
+
+class FP16Compressor(_CastCompressor):
+    wire_dtype = jnp.float16
+
+
+class BF16Compressor(_CastCompressor):
+    wire_dtype = jnp.bfloat16
+
+
+class Compression:
+    """Namespace matching hvd.Compression."""
+    none = NoneCompressor
+    fp16 = FP16Compressor
+    bf16 = BF16Compressor

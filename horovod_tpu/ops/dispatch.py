@@ -1,0 +1,432 @@
+"""Jitted XLA collective kernels over process-set meshes — the data plane.
+
+This is the TPU-native replacement for the reference's backend op
+implementations (reference: horovod/common/ops/nccl_operations.cc,
+mpi_operations.cc, gloo_operations.cc). Where those call
+ncclAllReduce/MPI_Allreduce on fusion buffers, here every collective is
+a `jax.jit`-compiled `shard_map` program over the process-set's mesh:
+XLA lowers `lax.psum`/`all_gather`/`all_to_all` to ICI/DCN DMAs via
+PJRT. There is no NCCL/MPI/Gloo anywhere in the link.
+
+Kernels are compiled once per (process set, op, signature) and cached —
+the compile cache plays the role of the reference's fusion-buffer reuse.
+Because XLA dispatch is asynchronous, "eager" collectives still overlap
+with compute: the Python caller gets a future-backed jax.Array
+immediately (the analog of the reference's background-thread overlap).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .process_set import ProcessSet
+
+# Reduce-op enum (reference: horovod/common/common.h ReduceOp and the
+# Python-level Average/Sum/Adasum/Min/Max/Product constants in
+# horovod/torch/mpi_ops.py).
+AVERAGE = 0
+SUM = 1
+ADASUM = 2
+MIN = 3
+MAX = 4
+PRODUCT = 5
+
+_OP_NAMES = {AVERAGE: "Average", SUM: "Sum", ADASUM: "Adasum",
+             MIN: "Min", MAX: "Max", PRODUCT: "Product"}
+
+
+def op_name(op: int) -> str:
+    return _OP_NAMES.get(op, f"op{op}")
+
+
+def _as_local(x) -> jax.Array:
+    return x if isinstance(x, jax.Array) else jnp.asarray(x)
+
+
+def _is_bool(x) -> bool:
+    return x.dtype == jnp.bool_
+
+
+# ---------------------------------------------------------------------------
+# Global-array assembly: one shard per member process.
+# ---------------------------------------------------------------------------
+
+def to_global(x: jax.Array, pset: ProcessSet) -> jax.Array:
+    """Lift this process's tensor into a global array sharded one-row-per-
+    process over the set's mesh (the frontier between the per-rank world
+    and the SPMD world; analog of handing a tensor to the reference's
+    background thread)."""
+    x = _as_local(x)
+    local = jax.device_put(x[None], pset.my_device)
+    shape = (pset.size,) + tuple(x.shape)
+    sharding = NamedSharding(pset.mesh, P("proc"))
+    return jax.make_array_from_single_device_arrays(shape, sharding, [local])
+
+
+def local_shard(g: jax.Array, squeeze: bool = True) -> jax.Array:
+    """This process's shard of a ('proc',)-sharded result."""
+    shard = g.addressable_shards[0].data
+    return shard[0] if squeeze else shard
+
+
+def replicated_local(g: jax.Array) -> jax.Array:
+    """Local view of a fully-replicated result."""
+    return g.addressable_shards[0].data
+
+
+# ---------------------------------------------------------------------------
+# Kernels (cached per signature)
+# ---------------------------------------------------------------------------
+
+def _sig(arrs: Sequence[jax.Array]) -> Tuple:
+    return tuple((tuple(a.shape), str(a.dtype)) for a in arrs)
+
+
+def group_by_dtype(arrs: Sequence[jax.Array], fn) -> List[jax.Array]:
+    """Split `arrs` into same-dtype subgroups (preserving order within
+    each), apply `fn(group_list) -> outputs_list` per group, and
+    reassemble in original order. The fusion layer only fuses same-dtype
+    tensors, mirroring the reference controller's FuseResponses rule."""
+    arrs = [_as_local(a) for a in arrs]
+    by_dtype: dict = {}
+    for i, a in enumerate(arrs):
+        by_dtype.setdefault(str(a.dtype), []).append(i)
+    out: List[Any] = [None] * len(arrs)
+    for idxs in by_dtype.values():
+        results = fn([arrs[i] for i in idxs])
+        for i, r in zip(idxs, results):
+            out[i] = r
+    return out
+
+
+@functools.lru_cache(maxsize=None)
+def _allreduce_kernel(mesh, n: int, op: int, prescale: float,
+                      postscale: float, sig: Tuple):
+    """Fused allreduce over 'proc' for a group of tensors (group of one
+    for plain allreduce). Flatten+concat per dtype happens inside the jit
+    so XLA fuses the copies (the MemcpyInFusionBuffer analog,
+    reference: horovod/common/ops/collective_operations.cc)."""
+    shapes = [s for s, _ in sig]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+
+    def reduce_one(flat):
+        if op in (SUM, AVERAGE, ADASUM):
+            # ADASUM at this layer is a plain sum; the Adasum scaling is
+            # applied by the recursive combine in ops/adasum.py.
+            return lax.psum(flat, "proc")
+        if op == MIN:
+            return lax.pmin(flat, "proc")
+        if op == MAX:
+            return lax.pmax(flat, "proc")
+        if op == PRODUCT:
+            g = lax.all_gather(flat, "proc")
+            return jnp.prod(g, axis=0)
+        raise ValueError(f"unknown reduce op {op}")
+
+    def body(*blocks):
+        # blocks: tuples of (1, *shape) per tensor.
+        flats = [b.reshape(-1) for b in blocks]
+        concat = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+        if prescale != 1.0:
+            concat = concat * jnp.asarray(prescale, concat.dtype)
+        red = reduce_one(concat)
+        if op == AVERAGE:
+            red = red / jnp.asarray(n, red.dtype)
+        if postscale != 1.0:
+            red = red * jnp.asarray(postscale, red.dtype)
+        outs = []
+        off = 0
+        for s, sz in zip(shapes, sizes):
+            outs.append(red[off:off + sz].reshape((1,) + s))
+            off += sz
+        return tuple(outs)
+
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=tuple(P("proc") for _ in sig),
+                       out_specs=tuple(P("proc") for _ in sig))
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _allgather_kernel(mesh, n: int, sizes: Tuple[int, ...], sig: Tuple):
+    """Allgather with (possibly uneven) first-dim sizes; inputs are
+    pre-padded to the max first-dim (reference: MPI_Allgatherv in
+    horovod/common/ops/mpi_operations.cc)."""
+
+    def body(block):
+        g = lax.all_gather(block[0], "proc")      # (n, maxr, *rest)
+        pieces = [g[i, : sizes[i]] for i in range(n)]
+        return jnp.concatenate(pieces, axis=0)[None]
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc"),
+                       out_specs=P("proc"))
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _broadcast_kernel(mesh, n: int, root: int, sig: Tuple):
+    def body(block):
+        idx = lax.axis_index("proc")
+        masked = jnp.where(idx == root, block, jnp.zeros_like(block))
+        return lax.psum(masked, "proc")
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc"),
+                       out_specs=P("proc"))
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _alltoall_kernel(mesh, n: int, maxsplit: int, sig: Tuple):
+    """All-to-all of padded per-destination chunks. Input block is
+    (1, n, maxsplit, *rest); output block is (1, n, maxsplit, *rest)
+    holding the chunk received from each source
+    (reference: horovod/common/ops/nccl_operations.cc NCCLAlltoall)."""
+
+    def body(block):
+        # split over the destination axis, concat received over a new
+        # leading axis — classic all_to_all.
+        out = lax.all_to_all(block, "proc", split_axis=1, concat_axis=0)
+        # out: (n, 1, maxsplit, *rest) -> (1, n, maxsplit, *rest)
+        return jnp.swapaxes(out, 0, 1)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc"),
+                       out_specs=P("proc"))
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _reducescatter_kernel(mesh, n: int, op: int, prescale: float,
+                          postscale: float, rows: Tuple[int, ...],
+                          sig: Tuple):
+    """Reduce-scatter: rank i receives rows [off_i, off_i+rows_i) of the
+    reduction. Uses psum_scatter when the split is even, else psum+slice
+    (reference: NCCLReducescatter; uneven sizing rule — first dim split
+    with remainder to low ranks — from the reference controller's
+    response construction)."""
+    even = len(set(rows)) == 1
+    offsets = np.concatenate([[0], np.cumsum(rows)]).tolist()
+
+    def body(block):
+        x = block[0]
+        if prescale != 1.0:
+            x = x * jnp.asarray(prescale, x.dtype)
+        if even:
+            red = lax.psum_scatter(x, "proc", scatter_dimension=0,
+                                   tiled=True)
+        else:
+            full = lax.psum(x, "proc")
+            idx = lax.axis_index("proc")
+            # Static per-rank slices are impossible in SPMD; slice the
+            # max-rows window dynamically and let the caller trim. Pad
+            # first so dynamic_slice never clamps the last rank's start.
+            maxr = max(rows)
+            pad_cfg = [(0, maxr)] + [(0, 0)] * (full.ndim - 1)
+            full = jnp.pad(full, pad_cfg)
+            start = jnp.asarray(offsets[:-1])[idx]
+            red = lax.dynamic_slice_in_dim(full, start, maxr, axis=0)
+        if op == AVERAGE:
+            red = red / jnp.asarray(n, red.dtype)
+        if postscale != 1.0:
+            red = red * jnp.asarray(postscale, red.dtype)
+        return red[None]
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc"),
+                       out_specs=P("proc"))
+    return jax.jit(fn)
+
+
+# ---------------------------------------------------------------------------
+# Public dispatch entry points (per-process view in, per-process view out)
+# ---------------------------------------------------------------------------
+
+def allreduce_group(tensors: List[jax.Array], pset: ProcessSet, op: int,
+                    prescale: float = 1.0, postscale: float = 1.0
+                    ) -> List[jax.Array]:
+    """Fused allreduce of a same-dtype group (group of 1 = plain)."""
+    tensors = [_as_local(t) for t in tensors]
+    n = pset.size
+    if n == 1:
+        scale = prescale * postscale
+        return [t * jnp.asarray(scale, t.dtype) if scale != 1.0 else t
+                for t in tensors]
+    sig = _sig(tensors)
+    kern = _allreduce_kernel(pset.mesh, n, op, float(prescale),
+                             float(postscale), sig)
+    gins = [to_global(t, pset) for t in tensors]
+    gouts = kern(*gins)
+    return [local_shard(g) for g in gouts]
+
+
+@functools.lru_cache(maxsize=None)
+def _broadcast_group_kernel(mesh, n: int, root: int, sig: Tuple):
+    """Fused broadcast of a same-dtype group: concat → one psum-mask
+    broadcast → split (the fusion-buffer analog for broadcast;
+    reference: horovod/common/ops/collective_operations.cc BroadcastOp +
+    FuseResponses packing in controller.cc)."""
+    shapes = [s for s, _ in sig]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+
+    def body(*blocks):
+        flats = [b.reshape(-1) for b in blocks]
+        concat = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+        idx = lax.axis_index("proc")
+        masked = jnp.where(idx == root, concat, jnp.zeros_like(concat))
+        red = lax.psum(masked, "proc")
+        outs = []
+        off = 0
+        for s, sz in zip(shapes, sizes):
+            outs.append(red[off:off + sz].reshape((1,) + s))
+            off += sz
+        return tuple(outs)
+
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=tuple(P("proc") for _ in sig),
+                       out_specs=tuple(P("proc") for _ in sig))
+    return jax.jit(fn)
+
+
+def broadcast_group(tensors: List[jax.Array], root: int,
+                    pset: ProcessSet) -> List[jax.Array]:
+    """Fused broadcast of a group of tensors from set-rank `root`.
+    Mixed dtypes are split into same-dtype fused subgroups by the
+    caller; bools ride as uint8."""
+    tensors = [_as_local(t) for t in tensors]
+    if pset.size == 1:
+        return tensors
+    bools = [t.dtype == jnp.bool_ for t in tensors]
+    wire = [t.astype(jnp.uint8) if b else t
+            for t, b in zip(tensors, bools)]
+    sig = _sig(wire)
+    kern = _broadcast_group_kernel(pset.mesh, pset.size, int(root), sig)
+    gouts = kern(*[to_global(t, pset) for t in wire])
+    outs = [local_shard(g) for g in gouts]
+    return [o.astype(jnp.bool_) if b else o for o, b in zip(outs, bools)]
+
+
+def allgather(tensor: jax.Array, pset: ProcessSet,
+              all_rows: Sequence[int]) -> jax.Array:
+    """Concatenate ranks' tensors along dim 0; `all_rows[i]` is rank i's
+    first-dim size (exchanged by the caller via the control plane)."""
+    x = _as_local(tensor)
+    n = pset.size
+    was_bool = _is_bool(x)
+    if was_bool:
+        x = x.astype(jnp.uint8)
+    if n == 1:
+        return tensor
+    maxr = max(all_rows)
+    if x.shape[0] < maxr:
+        pad = [(0, maxr - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+        x = jnp.pad(x, pad)
+    kern = _allgather_kernel(pset.mesh, n, tuple(int(r) for r in all_rows),
+                             _sig([x]))
+    out = local_shard(kern(to_global(x, pset)))
+    return out.astype(jnp.bool_) if was_bool else out
+
+
+def broadcast(tensor: jax.Array, root: int, pset: ProcessSet) -> jax.Array:
+    x = _as_local(tensor)
+    n = pset.size
+    if n == 1:
+        return tensor
+    was_bool = _is_bool(x)
+    if was_bool:
+        x = x.astype(jnp.uint8)
+    kern = _broadcast_kernel(pset.mesh, n, int(root), _sig([x]))
+    out = local_shard(kern(to_global(x, pset)))
+    return out.astype(jnp.bool_) if was_bool else out
+
+
+def alltoall(tensor: jax.Array, splits: Sequence[int],
+             recv_splits: Sequence[int], pset: ProcessSet,
+             maxsplit: Optional[int] = None) -> jax.Array:
+    """Distribute `tensor` rows: splits[i] rows go to set-rank i;
+    recv_splits[i] rows arrive from set-rank i (exchanged by caller).
+
+    `maxsplit` MUST be the global maximum over the full split matrix
+    (all ranks' sends), or ranks would compile different-shaped SPMD
+    programs for the same collective; the caller computes it from the
+    exchanged matrix."""
+    x = _as_local(tensor)
+    n = pset.size
+    if n == 1:
+        return tensor
+    was_bool = _is_bool(x)
+    if was_bool:
+        x = x.astype(jnp.uint8)
+    splits = [int(s) for s in splits]
+    recv_splits = [int(s) for s in recv_splits]
+    if maxsplit is None:
+        maxsplit = max(max(splits), max(recv_splits), 1)
+    rest = x.shape[1:]
+    # Pack into (n, maxsplit, *rest) with chunk for dest i at [i].
+    chunks = []
+    off = 0
+    for s in splits:
+        c = x[off:off + s]
+        if s < maxsplit:
+            pad = [(0, maxsplit - s)] + [(0, 0)] * (x.ndim - 1)
+            c = jnp.pad(c, pad)
+        chunks.append(c)
+        off += s
+    packed = jnp.stack(chunks)                      # (n, maxsplit, *rest)
+    kern = _alltoall_kernel(pset.mesh, n, maxsplit, _sig([packed]))
+    received = local_shard(kern(to_global(packed, pset)))  # (n,maxsplit,*rest)
+    pieces = [received[i, : recv_splits[i]] for i in range(n)]
+    out = jnp.concatenate(pieces, axis=0) if pieces else jnp.zeros(
+        (0,) + rest, x.dtype)
+    return out.astype(jnp.bool_) if was_bool else out
+
+
+def reducescatter(tensor: jax.Array, pset: ProcessSet, op: int,
+                  prescale: float = 1.0, postscale: float = 1.0
+                  ) -> jax.Array:
+    x = _as_local(tensor)
+    n = pset.size
+    if n == 1:
+        scale = prescale * postscale
+        return x * jnp.asarray(scale, x.dtype) if scale != 1.0 else tensor
+    d0 = x.shape[0]
+    if d0 < n:
+        raise ValueError(
+            f"reducescatter needs first dim >= set size ({d0} < {n})")
+    base, rem = divmod(d0, n)
+    rows = tuple(base + (1 if i < rem else 0) for i in range(n))
+    kern = _reducescatter_kernel(pset.mesh, n, op, float(prescale),
+                                 float(postscale), rows, _sig([x]))
+    out = local_shard(kern(to_global(x, pset)))
+    my_rows = rows[pset.rank()]
+    return out[:my_rows]
+
+
+def barrier(pset: ProcessSet) -> None:
+    """Block until every member reaches the barrier
+    (reference: horovod/common/ops/collective_operations.cc BarrierOp)."""
+    if pset.size == 1:
+        return
+    token = jnp.zeros((1,), jnp.int32) + 1
+    out = allreduce_group([token], pset, SUM)[0]
+    jax.block_until_ready(out)
+
+
+def exchange_int_vector(values: Sequence[int], pset: ProcessSet
+                        ) -> np.ndarray:
+    """Control-plane helper: allgather a small int vector; returns an
+    (n, len(values)) host matrix. Used to exchange allgather first-dim
+    sizes and alltoall splits (reference: the controller's
+    Request metadata exchange in horovod/common/controller.cc)."""
+    v = jnp.asarray(list(values), jnp.int32)
+    n = pset.size
+    if n == 1:
+        return np.asarray(v)[None]
+    rows = [1] * n
+    kern = _allgather_kernel(pset.mesh, n, tuple(rows), _sig([v[None]]))
+    out = local_shard(kern(to_global(v[None], pset)))
+    return np.asarray(out)

@@ -1,0 +1,173 @@
+"""Eager execution engine: handles, ordering, timeline/autotune hooks.
+
+TPU-native rethink of the reference's background-thread core
+(reference: horovod/common/operations.cc — BackgroundThreadLoop /
+RunLoopOnce / PerformOperation; horovod/common/tensor_queue.cc).
+
+Key design departure, deliberate: the reference needs a background
+thread because cudaMemcpy/NCCL calls are synchronous w.r.t. the caller
+and must be overlapped manually. XLA dispatch is *already* asynchronous
+— a jitted collective returns future-backed jax.Arrays immediately and
+executes on the device timeline. So the eager engine dispatches inline
+(keeping the caller's program order, which multi-controller SPMD
+requires) and gets comm/compute overlap for free; `synchronize()` is
+the only blocking point, exactly like the reference's HandleManager
+(reference: horovod/torch/handle_manager.cc).
+
+The negotiation/fusion cycle layer (reference: controller.cc) sits on
+top of this in ops/controller.py: when enabled it batches pending
+tensors into fused groups per cycle with a cross-rank agreed order,
+relaxing the same-program-order requirement.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+
+from ..common import logging as hlog
+
+
+class Handle:
+    """Async op handle (reference: horovod/torch/handle_manager.cc)."""
+
+    __slots__ = ("id", "result", "error", "_done", "name")
+
+    def __init__(self, hid: int, name: str):
+        self.id = hid
+        self.name = name
+        self.result: Any = None
+        self.error: Optional[BaseException] = None
+        self._done = threading.Event()
+
+    def set_result(self, result: Any) -> None:
+        self.result = result
+        self._done.set()
+
+    def set_error(self, err: BaseException) -> None:
+        self.error = err
+        self._done.set()
+
+    def done(self) -> bool:
+        if not self._done.is_set():
+            return False
+        if self.error is None and self.result is not None:
+            return _results_ready(self.result)
+        return True
+
+    def wait(self) -> Any:
+        self._done.wait()
+        if self.error is not None:
+            raise self.error
+        jax.block_until_ready(self.result)
+        return self.result
+
+
+def _results_ready(res: Any) -> bool:
+    leaves = jax.tree_util.tree_leaves(res)
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array):
+            try:
+                if not leaf.is_ready():
+                    return False
+            except AttributeError:  # older jax without is_ready
+                pass
+    return True
+
+
+class Engine:
+    """Owns handle bookkeeping, op naming, and the observer hooks; the
+    actual collective math lives in ops/dispatch.py."""
+
+    def __init__(self, cfg, topology, pset_table):
+        self.cfg = cfg
+        self.topology = topology
+        self.pset_table = pset_table
+        self._handles: Dict[int, Handle] = {}
+        self._hid = itertools.count(1)
+        self._name_counters: Dict[str, itertools.count] = {}
+        self._lock = threading.Lock()
+        self.timeline = None
+        self.autotuner = None
+        self.controller = None      # negotiated-cycle controller (optional)
+        self._shutdown = False
+        # Bytes/latency accounting for autotune scoring.
+        self._bytes_processed = 0
+
+    # -- hooks ---------------------------------------------------------------
+    def attach_timeline(self, timeline) -> None:
+        self.timeline = timeline
+
+    def attach_autotuner(self, autotuner) -> None:
+        self.autotuner = autotuner
+
+    # -- naming --------------------------------------------------------------
+    def auto_name(self, kind: str) -> str:
+        """allreduce.noname.N-style deterministic names
+        (reference: horovod/torch/mpi_ops.py name counters)."""
+        with self._lock:
+            ctr = self._name_counters.setdefault(kind, itertools.count())
+            return f"{kind}.noname.{next(ctr)}"
+
+    # -- handle management ---------------------------------------------------
+    def new_handle(self, name: str) -> Handle:
+        h = Handle(next(self._hid), name)
+        with self._lock:
+            self._handles[h.id] = h
+        return h
+
+    def get_handle(self, hid: int) -> Handle:
+        with self._lock:
+            return self._handles[hid]
+
+    def release_handle(self, hid: int) -> None:
+        with self._lock:
+            self._handles.pop(hid, None)
+
+    # -- execution -----------------------------------------------------------
+    def run(self, name: str, nbytes: int,
+            fn: Callable[[], Any]) -> Handle:
+        """Dispatch `fn` (a closure over ops.dispatch) inline, recording
+        timeline phases and autotune throughput."""
+        if self._shutdown:
+            raise RuntimeError("horovod_tpu engine is shut down")
+        h = self.new_handle(name)
+        t0 = time.perf_counter()
+        if self.timeline is not None:
+            self.timeline.enqueue(name)
+        try:
+            result = fn()
+            h.set_result(result)
+        except BaseException as e:
+            h.set_error(e)
+            if self.timeline is not None:
+                self.timeline.error(name)
+            return h
+        if self.timeline is not None:
+            self.timeline.dispatched(name)
+        self._bytes_processed += nbytes
+        if self.autotuner is not None:
+            # Throughput scoring needs the wall time to completion, not
+            # async-dispatch latency, so block only when autotuning.
+            jax.block_until_ready(result)
+            self.autotuner.record(nbytes, time.perf_counter() - t0)
+        return h
+
+    def synchronize(self, h: Handle) -> Any:
+        res = h.wait()
+        if self.timeline is not None:
+            self.timeline.done(h.name)
+        self.release_handle(h.id)
+        return res
+
+    def shutdown(self) -> None:
+        self._shutdown = True
+        if self.controller is not None:
+            self.controller.shutdown()
+            self.controller = None
+        hlog.debug("engine shut down; %d bytes processed",
+                   self._bytes_processed)

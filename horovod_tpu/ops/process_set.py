@@ -1,0 +1,113 @@
+"""Process sets: named sub-groups of ranks with their own collectives.
+
+TPU-native analog of the reference's ProcessSet/ProcessSetTable
+(reference: horovod/common/process_set.cc). Where the reference gives
+each set its own MPI/Gloo communicator + controller + queue, here each
+set owns a `jax.sharding.Mesh` over one representative device per member
+process; collectives on the set are XLA collectives over that mesh, so a
+subset collective only involves the member processes.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import jax
+from jax.sharding import Mesh
+
+from ..common import logging as hlog
+from ..common.topology import Topology, process_mesh_devices
+
+
+class ProcessSet:
+    """An ordered set of process ranks (reference: hvd.ProcessSet)."""
+
+    def __init__(self, ranks: Sequence[int]):
+        if len(set(ranks)) != len(ranks):
+            raise ValueError(f"duplicate ranks in process set: {ranks}")
+        self.ranks: List[int] = sorted(int(r) for r in ranks)
+        self.process_set_id: Optional[int] = None
+        self._mesh: Optional[Mesh] = None
+        self._table: Optional["ProcessSetTable"] = None
+
+    # -- membership ----------------------------------------------------------
+    @property
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def rank(self) -> int:
+        """This process's rank *within* the set; -1 if not a member."""
+        if self._table is None:
+            raise RuntimeError("process set is not registered")
+        try:
+            return self.ranks.index(self._table.topology.rank)
+        except ValueError:
+            return -1
+
+    def included(self) -> bool:
+        if self._table is None:
+            raise RuntimeError("process set is not registered")
+        return self._table.topology.rank in self.ranks
+
+    # -- mesh ----------------------------------------------------------------
+    @property
+    def mesh(self) -> Mesh:
+        """Mesh with axis 'proc' over one device per member process."""
+        if self._mesh is None:
+            import numpy as np
+            devs = np.array(process_mesh_devices(self.ranks))
+            self._mesh = Mesh(devs, axis_names=("proc",))
+        return self._mesh
+
+    @property
+    def my_device(self) -> jax.Device:
+        return self.mesh.devices.flat[self.rank()]
+
+    def __repr__(self):
+        return (f"ProcessSet(id={self.process_set_id}, ranks={self.ranks})")
+
+
+class ProcessSetTable:
+    """Registry of process sets; id 0 is the global set
+    (reference: horovod/common/process_set.cc — ProcessSetTable)."""
+
+    def __init__(self, topology: Topology):
+        self.topology = topology
+        self._lock = threading.Lock()
+        self._by_id: Dict[int, ProcessSet] = {}
+        self._next_id = 0
+        self.global_set = self.register(
+            ProcessSet(range(topology.size)))
+
+    def register(self, ps: ProcessSet) -> ProcessSet:
+        with self._lock:
+            for existing in self._by_id.values():
+                if existing.ranks == ps.ranks:
+                    return existing
+            bad = [r for r in ps.ranks if r >= self.topology.size or r < 0]
+            if bad:
+                raise ValueError(
+                    f"process set ranks {bad} out of range for world size "
+                    f"{self.topology.size}")
+            ps.process_set_id = self._next_id
+            ps._table = self
+            self._next_id += 1
+            self._by_id[ps.process_set_id] = ps
+            hlog.debug("registered %s", ps)
+            return ps
+
+    def remove(self, ps: ProcessSet) -> None:
+        with self._lock:
+            if ps.process_set_id == 0:
+                raise ValueError("cannot remove the global process set")
+            self._by_id.pop(ps.process_set_id, None)
+            ps.process_set_id = None
+
+    def get(self, process_set_id: int) -> ProcessSet:
+        with self._lock:
+            return self._by_id[process_set_id]
+
+    def ids(self) -> List[int]:
+        with self._lock:
+            return sorted(self._by_id)

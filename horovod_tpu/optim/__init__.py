@@ -1,0 +1,7 @@
+from .distributed_optimizer import (  # noqa: F401
+    DistributedOptimizer, DistributedGradientTransformation,
+)
+from .functions import (  # noqa: F401
+    broadcast_parameters, broadcast_optimizer_state, broadcast_object,
+    allreduce_parameters,
+)

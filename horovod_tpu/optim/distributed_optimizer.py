@@ -1,0 +1,216 @@
+"""DistributedOptimizer: the one-line optimizer wrapper.
+
+API parity with the reference's optimizer wrappers
+(reference: horovod/torch/optimizer.py — _DistributedOptimizer with
+op / compression / backward_passes_per_step / num_groups / groups;
+horovod/tensorflow/__init__.py — DistributedOptimizer /
+DistributedGradientTape; gradient_aggregation*.py —
+LocalGradientAggregationHelper), re-designed for JAX/optax:
+
+* Instead of per-parameter backward hooks (impossible and unnecessary
+  under XLA), the wrapper is an `optax.GradientTransformation` that
+  averages gradients across workers before the inner transformation.
+* Two reduction paths:
+  - `axis_name=...`: for use **inside** `pjit`/`shard_map` training
+    steps — lowers to `lax.psum` on the mesh axis; XLA's latency-hiding
+    scheduler overlaps the reduction with remaining backprop, which is
+    the compiler-native version of the reference's background-thread
+    overlap.
+  - default (no axis): eager cross-process reduction through the
+    engine (hvd.grouped_allreduce) — for non-jitted update loops,
+    mirroring the reference's eager torch path.
+* `backward_passes_per_step=k` reproduces local gradient aggregation:
+  gradients accumulate locally for k calls, the reduction happens on
+  the k-th, and intermediate calls return zero updates.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import optax
+
+from ..ops import collective_ops as C
+from ..ops.compression import Compression, NoneCompressor
+from ..ops.dispatch import AVERAGE, SUM, ADASUM
+from ..ops.process_set import ProcessSet
+
+
+class _AggState(NamedTuple):
+    inner: Any
+    acc: Any
+    counter: jnp.ndarray
+
+
+def _tree_zeros_like(tree):
+    return jax.tree_util.tree_map(jnp.zeros_like, tree)
+
+
+def _axis_reduce(grads, axis_name: str, op: int, compression, size_hint):
+    """In-jit reduction over a mesh axis."""
+    def red(g):
+        wire, ctx = compression.compress(g)
+        if op == AVERAGE:
+            out = lax.pmean(wire, axis_name)
+        elif op == SUM:
+            out = lax.psum(wire, axis_name)
+        elif op == ADASUM:
+            from ..ops.adasum import _tree_fold
+            n = lax.psum(1, axis_name)
+            stacked = lax.all_gather(wire.reshape(-1), axis_name)
+            out = _tree_fold([stacked[i] for i in range(size_hint)]
+                             ).reshape(wire.shape)
+        else:
+            raise ValueError(f"unsupported op {op} inside jit")
+        return compression.decompress(out, ctx)
+    return jax.tree_util.tree_map(red, grads)
+
+
+def _eager_reduce(grads, op: int, compression,
+                  process_set: Optional[ProcessSet], num_groups: int,
+                  groups: Optional[Sequence[Sequence[Any]]],
+                  prescale: float, postscale: float):
+    """Cross-process reduction through the eager engine, fused into
+    grouped allreduces (the tensor-fusion analog)."""
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    if not leaves:
+        return grads
+    if groups is not None:
+        # Explicit fusion groups as lists of leaf indices (the pytree
+        # analog of the reference's lists of parameters). Leaves not
+        # covered by any group form one trailing group.
+        seen = set()
+        chunks = []
+        for g in groups:
+            idxs = [int(i) for i in g]
+            bad = [i for i in idxs if i < 0 or i >= len(leaves)]
+            if bad:
+                raise ValueError(f"groups contains leaf indices {bad} out "
+                                 f"of range for {len(leaves)} gradient "
+                                 "leaves")
+            dup = [i for i in idxs if i in seen]
+            if dup:
+                raise ValueError(f"leaf indices {dup} appear in multiple "
+                                 "groups")
+            seen.update(idxs)
+            chunks.append(idxs)
+        rest = [i for i in range(len(leaves)) if i not in seen]
+        if rest:
+            chunks.append(rest)
+    elif num_groups and num_groups > 0:
+        chunks = [list(c) for c in
+                  _split_round_robin(list(range(len(leaves))), num_groups)]
+    else:
+        chunks = [list(range(len(leaves)))]
+    out: List[Any] = [None] * len(leaves)
+    for idxs in chunks:
+        reduced = C.grouped_allreduce(
+            [leaves[i] for i in idxs], op=op, compression=compression,
+            prescale_factor=prescale, postscale_factor=postscale,
+            process_set=process_set)
+        for i, r in zip(idxs, reduced):
+            out[i] = r
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _split_round_robin(items, n):
+    buckets = [[] for _ in range(min(n, len(items)))]
+    for i, it in enumerate(items):
+        buckets[i % len(buckets)].append(it)
+    return buckets
+
+
+def DistributedGradientTransformation(
+        inner: optax.GradientTransformation,
+        *,
+        op: int = AVERAGE,
+        compression=NoneCompressor,
+        axis_name: Optional[str] = None,
+        backward_passes_per_step: int = 1,
+        num_groups: int = 0,
+        groups: Optional[Sequence] = None,
+        process_set: Optional[ProcessSet] = None,
+        gradient_predivide_factor: float = 1.0,
+        size_hint: Optional[int] = None,
+) -> optax.GradientTransformation:
+    """Wrap an optax transformation with cross-worker gradient reduction."""
+    if gradient_predivide_factor != 1.0 and op != AVERAGE:
+        raise ValueError(
+            "gradient_predivide_factor requires op=Average "
+            "(matches the reference's restriction)")
+
+    k = int(backward_passes_per_step)
+    if k < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
+
+    def reduce_grads(grads):
+        if axis_name is not None:
+            n = size_hint
+            if op == ADASUM and n is None:
+                raise ValueError("op=Adasum with axis_name requires "
+                                 "size_hint=<axis size>")
+            return _axis_reduce(grads, axis_name, op, compression, n)
+        prescale, postscale = 1.0, 1.0
+        eff_op = op
+        if op == AVERAGE and gradient_predivide_factor != 1.0:
+            # reference: prescale by 1/f before the sum, postscale by
+            # f/size after — numerically safer for fp16 sums.
+            import horovod_tpu as hvd
+            prescale = 1.0 / gradient_predivide_factor
+            postscale = gradient_predivide_factor / hvd.size()
+            eff_op = SUM
+        return _eager_reduce(grads, eff_op, compression, process_set,
+                             num_groups, groups, prescale, postscale)
+
+    def init_fn(params):
+        inner_state = inner.init(params)
+        if k == 1:
+            return inner_state
+        return _AggState(inner=inner_state, acc=_tree_zeros_like(params),
+                         counter=jnp.zeros((), jnp.int32))
+
+    def update_fn(grads, state, params=None, **extra):
+        if k == 1:
+            reduced = reduce_grads(grads)
+            return inner.update(reduced, state, params, **extra)
+        # Local aggregation path (LocalGradientAggregationHelper analog).
+        acc = jax.tree_util.tree_map(jnp.add, state.acc, grads)
+        counter = state.counter + 1
+        if axis_name is not None:
+            # In-jit: branchlessly blend "flush" and "hold" updates.
+            def flush(_):
+                avg = jax.tree_util.tree_map(lambda a: a / k, acc)
+                reduced = reduce_grads(avg)
+                updates, new_inner = inner.update(reduced, state.inner,
+                                                  params, **extra)
+                return updates, new_inner, _tree_zeros_like(acc), \
+                    jnp.zeros((), jnp.int32)
+
+            def hold(_):
+                return (_tree_zeros_like(grads), state.inner, acc, counter)
+
+            updates, new_inner, new_acc, new_counter = lax.cond(
+                counter >= k, flush, hold, operand=None)
+        else:
+            if int(counter) >= k:
+                avg = jax.tree_util.tree_map(lambda a: a / k, acc)
+                reduced = reduce_grads(avg)
+                updates, new_inner = inner.update(reduced, state.inner,
+                                                  params, **extra)
+                new_acc = _tree_zeros_like(acc)
+                new_counter = jnp.zeros((), jnp.int32)
+            else:
+                updates = _tree_zeros_like(grads)
+                new_inner, new_acc, new_counter = state.inner, acc, counter
+        return updates, _AggState(inner=new_inner, acc=new_acc,
+                                  counter=new_counter)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+# The hvd.DistributedOptimizer name, for the 5-line experience.
+DistributedOptimizer = DistributedGradientTransformation

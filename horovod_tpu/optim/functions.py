@@ -1,0 +1,95 @@
+"""Parameter/state broadcast and averaging helpers.
+
+API parity with the reference's torch functions module
+(reference: horovod/torch/functions.py — broadcast_parameters /
+broadcast_optimizer_state / broadcast_object), generalized to pytrees:
+in JAX, model params and optax optimizer states are both pytrees, so
+one fused-broadcast implementation serves both.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..common.basics import _require_init
+from ..ops import collective_ops as C
+from ..ops import dispatch
+from ..ops.process_set import ProcessSet
+
+
+def _grouped_leaf_broadcast(leaves, set_root: int, pset: ProcessSet):
+    """Fuse same-dtype leaves into single broadcast launches."""
+    return dispatch.group_by_dtype(
+        leaves, lambda g: dispatch.broadcast_group(g, set_root, pset))
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0,
+                         process_set: Optional[ProcessSet] = None) -> Any:
+    """Broadcast a pytree of arrays from root_rank to all members and
+    return the synchronized pytree (functional — JAX arrays are
+    immutable, unlike the reference's in-place torch broadcast_)."""
+    st = _require_init()
+    pset = process_set or st.process_set_table.global_set
+    if pset.size == 1:
+        return params
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    if not leaves:
+        return params
+    set_root = pset.ranks.index(root_rank)
+    out = _grouped_leaf_broadcast(leaves, set_root, pset)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0,
+                              process_set: Optional[ProcessSet] = None
+                              ) -> Any:
+    """Broadcast an optax optimizer state pytree. Non-array leaves
+    (step counts as python ints, schedules) ride through
+    broadcast_object semantics via array conversion when possible."""
+    return broadcast_parameters(opt_state, root_rank, process_set)
+
+
+def allreduce_parameters(params: Any, process_set: Optional[ProcessSet]
+                         = None) -> Any:
+    """Average a pytree across members (used e.g. to average model
+    params or metrics at epoch end; reference analog:
+    MetricAverageCallback in horovod/_keras/callbacks.py)."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    if not leaves:
+        return params
+    out = C.grouped_allreduce(leaves, op=C.Average,
+                              process_set=process_set)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def broadcast_object(obj: Any, root_rank: int = 0,
+                     name: Optional[str] = None,
+                     process_set: Optional[ProcessSet] = None) -> Any:
+    """Broadcast an arbitrary picklable object
+    (reference: horovod/torch/functions.py broadcast_object — pickle to
+    a byte tensor, broadcast the length, then the payload)."""
+    st = _require_init()
+    pset = process_set or st.process_set_table.global_set
+    if pset.size == 1:
+        return obj
+    set_root = pset.ranks.index(root_rank)
+    me = pset.rank()
+    if me == set_root:
+        payload = pickle.dumps(obj)
+        data = np.frombuffer(payload, dtype=np.uint8)
+    else:
+        data = np.zeros((0,), dtype=np.uint8)
+    # Length exchange, then pad to the root's length and broadcast.
+    sizes = dispatch.exchange_int_vector([int(data.size)], pset)[:, 0]
+    total = int(sizes[set_root])
+    if data.size < total:
+        data = np.pad(data, (0, total - data.size))
+    out = dispatch.broadcast(jnp.asarray(data), set_root, pset)
+    raw = bytes(np.asarray(out).tobytes())
+    return pickle.loads(raw)

@@ -1,0 +1,118 @@
+"""Chrome-trace timeline of eager collective lifecycle phases.
+
+TPU-native analog of the reference timeline
+(reference: horovod/common/timeline.cc — Timeline::NegotiateStart /
+ActivityStart / WriteEvent, TimelineWriter background thread). Rank 0
+writes a Chrome-trace JSON (chrome://tracing / Perfetto-loadable) with
+one lane per tensor name and phases ENQUEUE → NEGOTIATE → QUEUE →
+FUSE → DISPATCH → DONE. Device-side detail comes from jax.profiler
+(XPlane) instead — this file covers the host-side engine semantics the
+XLA trace cannot see.
+
+Events are queued to a dedicated writer thread so the hot path never
+blocks on file IO, matching the reference's TimelineWriter design.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+
+
+class Timeline:
+    def __init__(self, path: str, mark_cycles: bool = False):
+        self.path = path
+        self.mark_cycles = mark_cycles
+        self._q: "queue.Queue" = queue.Queue()
+        self._t0 = time.perf_counter()
+        self._tids: dict = {}
+        self._next_tid = 1
+        self._lock = threading.Lock()
+        self._file = open(path, "w")
+        self._file.write("[\n")
+        self._first = True
+        self._closed = False
+        self._writer = threading.Thread(target=self._write_loop,
+                                        name="hvd-timeline", daemon=True)
+        self._writer.start()
+
+    # -- event API (called from the engine hot path) -------------------------
+    def _ts_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def _tid(self, name: str) -> int:
+        with self._lock:
+            if name not in self._tids:
+                self._tids[name] = self._next_tid
+                self._q.put({"name": "thread_name", "ph": "M", "pid": 0,
+                             "tid": self._next_tid,
+                             "args": {"name": name}})
+                self._next_tid += 1
+            return self._tids[name]
+
+    def _emit(self, name: str, phase: str, ph: str) -> None:
+        if self._closed:
+            return
+        self._q.put({"name": phase, "ph": ph, "pid": 0,
+                     "tid": self._tid(name), "ts": self._ts_us()})
+
+    def enqueue(self, name: str) -> None:
+        self._emit(name, "QUEUE", "B")
+
+    def negotiate_start(self, name: str) -> None:
+        self._emit(name, "NEGOTIATE", "B")
+
+    def negotiate_end(self, name: str) -> None:
+        self._emit(name, "NEGOTIATE", "E")
+
+    def fuse(self, name: str, bucket: int) -> None:
+        if self._closed:
+            return
+        self._q.put({"name": f"FUSE(bucket={bucket})", "ph": "i", "pid": 0,
+                     "tid": self._tid(name), "ts": self._ts_us(), "s": "t"})
+
+    def dispatched(self, name: str) -> None:
+        self._emit(name, "QUEUE", "E")
+        self._emit(name, "DISPATCH", "B")
+
+    def done(self, name: str, error: bool = False) -> None:
+        self._emit(name, "DISPATCH", "E")
+
+    def error(self, name: str) -> None:
+        """Close the QUEUE span for an op that failed before dispatch,
+        keeping the trace well-formed."""
+        self._emit(name, "QUEUE", "E")
+        if self._closed:
+            return
+        self._q.put({"name": "ERROR", "ph": "i", "pid": 0,
+                     "tid": self._tid(name), "ts": self._ts_us(), "s": "t"})
+
+    def cycle(self, index: int) -> None:
+        if not self.mark_cycles or self._closed:
+            return
+        self._q.put({"name": f"CYCLE {index}", "ph": "i", "pid": 0,
+                     "tid": 0, "ts": self._ts_us(), "s": "g"})
+
+    # -- writer thread -------------------------------------------------------
+    def _write_loop(self) -> None:
+        while True:
+            ev = self._q.get()
+            if ev is None:
+                return
+            line = json.dumps(ev)
+            if not self._first:
+                line = ",\n" + line
+            self._first = False
+            self._file.write(line)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._q.put(None)
+        self._writer.join(timeout=5)
+        self._file.write("\n]\n")
+        self._file.close()

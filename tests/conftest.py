@@ -1,0 +1,50 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+Mirrors the reference's strategy of testing distributed semantics
+without a cluster (SURVEY.md §4): the reference runs Gloo over
+loopback; here multi-*device* semantics run on
+--xla_force_host_platform_device_count=8 CPU devices, and
+multi-*process* semantics run by spawning real subprocesses via the
+launcher (see test_multiprocess.py), each on its own CPU backend.
+"""
+
+import os
+import sys
+
+# Must happen before jax import anywhere in the test process.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8").strip()
+# Neutralize the axon TPU sitecustomize hook (it force-registers the
+# TPU backend even when JAX_PLATFORMS=cpu).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+# Exercise float64/int64 paths like the reference CPU tests do.
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+@pytest.fixture
+def hvd_single():
+    """hvd initialized in single-process mode; shut down after."""
+    import horovod_tpu as hvd
+    hvd.init()
+    yield hvd
+    hvd.shutdown()
+
+
+@pytest.fixture(scope="session")
+def eight_device_mesh():
+    from jax.sharding import Mesh
+    import numpy as np
+    devs = np.array(jax.devices()[:8])
+    return Mesh(devs, axis_names=("proc",))

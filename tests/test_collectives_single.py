@@ -1,0 +1,135 @@
+"""Single-process collective semantics: identity paths, scaling,
+dtype handling, error cases
+(reference analog: the size==1 paths of test/parallel/test_torch.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+@pytest.mark.parametrize("dtype", ["float32", "float64", "bfloat16",
+                                   "float16", "int32", "int64", "uint8"])
+def test_allreduce_identity(hvd_single, dtype):
+    hvd = hvd_single
+    x = jnp.arange(12, dtype=dtype).reshape(3, 4)
+    out = hvd.allreduce(x, op=hvd.Sum)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+    assert out.dtype == x.dtype
+
+
+def test_allreduce_average_int_raises(hvd_single):
+    hvd = hvd_single
+    with pytest.raises(ValueError, match="Average"):
+        hvd.allreduce(jnp.arange(4), op=hvd.Average)
+
+
+def test_allreduce_scaling(hvd_single):
+    hvd = hvd_single
+    x = jnp.ones((4,), jnp.float32)
+    out = hvd.allreduce(x, op=hvd.Sum, prescale_factor=2.0,
+                        postscale_factor=3.0)
+    np.testing.assert_allclose(np.asarray(out), 6 * np.ones(4), rtol=1e-6)
+
+
+def test_allreduce_average_float(hvd_single):
+    hvd = hvd_single
+    x = jnp.ones((4,), jnp.float32) * 5
+    out = hvd.allreduce(x)  # default Average
+    np.testing.assert_allclose(np.asarray(out), 5 * np.ones(4))
+
+
+def test_allreduce_op_and_average_conflict(hvd_single):
+    hvd = hvd_single
+    with pytest.raises(ValueError, match="either op or average"):
+        hvd.allreduce(jnp.ones(3), average=True, op=hvd.Sum)
+
+
+def test_grouped_allreduce(hvd_single):
+    hvd = hvd_single
+    ts = [jnp.ones((3,)), jnp.arange(4, dtype=jnp.float32),
+          jnp.ones((2, 2), jnp.int32)]
+    outs = hvd.grouped_allreduce(ts, op=hvd.Sum)
+    assert len(outs) == 3
+    for t, o in zip(ts, outs):
+        np.testing.assert_array_equal(np.asarray(o), np.asarray(t))
+        assert o.dtype == t.dtype
+
+
+def test_allgather_single(hvd_single):
+    hvd = hvd_single
+    x = jnp.arange(6, dtype=jnp.float32).reshape(2, 3)
+    out = hvd.allgather(x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_broadcast_single(hvd_single):
+    hvd = hvd_single
+    x = jnp.arange(4)
+    out = hvd.broadcast(x, root_rank=0)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_broadcast_bad_root(hvd_single):
+    hvd = hvd_single
+    with pytest.raises(ValueError, match="root_rank"):
+        hvd.broadcast(jnp.ones(2), root_rank=3)
+
+
+def test_alltoall_single(hvd_single):
+    hvd = hvd_single
+    x = jnp.arange(8, dtype=jnp.float32)
+    out = hvd.alltoall(x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_alltoall_bad_splits(hvd_single):
+    hvd = hvd_single
+    with pytest.raises(ValueError, match="splits must sum"):
+        hvd.alltoall(jnp.arange(8.0), splits=[3])
+
+
+def test_reducescatter_single(hvd_single):
+    hvd = hvd_single
+    x = jnp.arange(8, dtype=jnp.float32).reshape(4, 2)
+    out = hvd.reducescatter(x, op=hvd.Sum)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+
+
+def test_barrier_single(hvd_single):
+    hvd_single.barrier()
+
+
+def test_async_poll_synchronize(hvd_single):
+    hvd = hvd_single
+    h = hvd.allreduce_async(jnp.ones((1000,)), op=hvd.Sum)
+    out = hvd.synchronize(h)
+    np.testing.assert_array_equal(np.asarray(out), np.ones(1000))
+
+
+def test_compression_fp16_roundtrip(hvd_single):
+    hvd = hvd_single
+    x = jnp.asarray(np.random.RandomState(0).randn(64), jnp.float32)
+    out = hvd.allreduce(x, op=hvd.Sum, compression=hvd.Compression.fp16)
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=1e-2)
+
+
+def test_compression_bf16_roundtrip(hvd_single):
+    hvd = hvd_single
+    x = jnp.asarray(np.random.RandomState(0).randn(64), jnp.float32)
+    out = hvd.allreduce(x, op=hvd.Sum, compression=hvd.Compression.bf16)
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=5e-2)
+
+
+def test_broadcast_object_single(hvd_single):
+    from horovod_tpu.optim.functions import broadcast_object
+    obj = {"epoch": 3, "name": "x"}
+    assert broadcast_object(obj, root_rank=0) == obj
+
+
+def test_broadcast_parameters_single(hvd_single):
+    from horovod_tpu.optim.functions import broadcast_parameters
+    params = {"w": jnp.ones((3, 3)), "b": jnp.zeros((3,))}
+    out = broadcast_parameters(params, root_rank=0)
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.ones((3, 3)))

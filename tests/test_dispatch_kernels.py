@@ -1,0 +1,222 @@
+"""SPMD collective kernel math on an 8-device virtual mesh.
+
+This is the single-process analog of the reference's 2-process Gloo
+tests (test/parallel/test_torch.py): one process owns all 8 shards, so
+every "rank"'s input and output can be constructed and checked exactly.
+The same kernels run unmodified in true multi-process jobs (covered by
+test_multiprocess.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu.ops import dispatch
+from horovod_tpu.ops.dispatch import (AVERAGE, SUM, MIN, MAX, PRODUCT)
+
+N = 8
+
+
+def make_global(mesh, per_rank_rows):
+    """(n, *s) array sharded one row per device."""
+    full = jnp.stack([jnp.asarray(r) for r in per_rank_rows])
+    sharding = NamedSharding(mesh, P("proc"))
+    return jax.device_put(full, sharding)
+
+
+def rows_of(garr):
+    return [np.asarray(s.data[0]) for s in
+            sorted(garr.addressable_shards, key=lambda s: s.index[0].start)]
+
+
+@pytest.mark.parametrize("op,expect", [
+    (SUM, lambda xs: np.sum(xs, axis=0)),
+    (AVERAGE, lambda xs: np.mean(xs, axis=0)),
+    (MIN, lambda xs: np.min(xs, axis=0)),
+    (MAX, lambda xs: np.max(xs, axis=0)),
+    (PRODUCT, lambda xs: np.prod(xs, axis=0)),
+])
+def test_allreduce_ops(eight_device_mesh, op, expect):
+    mesh = eight_device_mesh
+    rng = np.random.RandomState(op)
+    xs = rng.uniform(0.5, 1.5, size=(N, 3, 4)).astype(np.float32)
+    kern = dispatch._allreduce_kernel(
+        mesh, N, op, 1.0, 1.0, dispatch._sig([jnp.asarray(xs[0])]))
+    (out,) = kern(make_global(mesh, xs))
+    want = expect(xs)
+    for got in rows_of(out):
+        np.testing.assert_allclose(got, want, rtol=2e-5)
+
+
+def test_allreduce_int_sum(eight_device_mesh):
+    mesh = eight_device_mesh
+    xs = np.arange(N * 4, dtype=np.int32).reshape(N, 4)
+    kern = dispatch._allreduce_kernel(
+        mesh, N, SUM, 1.0, 1.0, dispatch._sig([jnp.asarray(xs[0])]))
+    (out,) = kern(make_global(mesh, xs))
+    for got in rows_of(out):
+        np.testing.assert_array_equal(got, xs.sum(0))
+
+
+def test_allreduce_prescale_postscale(eight_device_mesh):
+    mesh = eight_device_mesh
+    xs = np.ones((N, 5), np.float32)
+    kern = dispatch._allreduce_kernel(
+        mesh, N, SUM, 0.5, 3.0, dispatch._sig([jnp.asarray(xs[0])]))
+    (out,) = kern(make_global(mesh, xs))
+    for got in rows_of(out):
+        np.testing.assert_allclose(got, 0.5 * N * 3.0 * np.ones(5))
+
+
+def test_fused_group_allreduce(eight_device_mesh):
+    mesh = eight_device_mesh
+    rng = np.random.RandomState(1)
+    a = rng.randn(N, 3).astype(np.float32)
+    b = rng.randn(N, 2, 2).astype(np.float32)
+    sig = dispatch._sig([jnp.asarray(a[0]), jnp.asarray(b[0])])
+    kern = dispatch._allreduce_kernel(mesh, N, SUM, 1.0, 1.0, sig)
+    out_a, out_b = kern(make_global(mesh, a), make_global(mesh, b))
+    for got in rows_of(out_a):
+        np.testing.assert_allclose(got, a.sum(0), rtol=1e-5)
+    for got in rows_of(out_b):
+        np.testing.assert_allclose(got, b.sum(0), rtol=1e-5)
+
+
+def test_broadcast_kernel(eight_device_mesh):
+    mesh = eight_device_mesh
+    xs = np.stack([np.full((3,), i, np.float32) for i in range(N)])
+    for root in (0, 3, 7):
+        kern = dispatch._broadcast_kernel(
+            mesh, N, root, dispatch._sig([jnp.asarray(xs[0])]))
+        out = kern(make_global(mesh, xs))
+        for got in rows_of(out):
+            np.testing.assert_array_equal(got, xs[root])
+
+
+def test_broadcast_group_kernel(eight_device_mesh):
+    mesh = eight_device_mesh
+    rng = np.random.RandomState(2)
+    a = rng.randn(N, 3).astype(np.float32)
+    b = rng.randn(N, 4).astype(np.float32)
+    sig = dispatch._sig([jnp.asarray(a[0]), jnp.asarray(b[0])])
+    kern = dispatch._broadcast_group_kernel(mesh, N, 2, sig)
+    out_a, out_b = kern(make_global(mesh, a), make_global(mesh, b))
+    for got in rows_of(out_a):
+        np.testing.assert_allclose(got, a[2])
+    for got in rows_of(out_b):
+        np.testing.assert_allclose(got, b[2])
+
+
+def test_allgather_even(eight_device_mesh):
+    mesh = eight_device_mesh
+    xs = np.stack([np.full((2, 3), i, np.float32) for i in range(N)])
+    sizes = tuple([2] * N)
+    kern = dispatch._allgather_kernel(
+        mesh, N, sizes, dispatch._sig([jnp.asarray(xs[0])]))
+    out = kern(make_global(mesh, xs))
+    want = xs.reshape(N * 2, 3)
+    for got in rows_of(out):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_allgather_uneven(eight_device_mesh):
+    mesh = eight_device_mesh
+    # rank i contributes i+1 rows, padded to 8.
+    sizes = tuple(i + 1 for i in range(N))
+    maxr = max(sizes)
+    padded = []
+    pieces = []
+    for i in range(N):
+        block = np.full((sizes[i], 2), i, np.float32)
+        pieces.append(block)
+        pad = np.zeros((maxr - sizes[i], 2), np.float32)
+        padded.append(np.concatenate([block, pad]))
+    xs = np.stack(padded)
+    kern = dispatch._allgather_kernel(
+        mesh, N, sizes, dispatch._sig([jnp.asarray(xs[0])]))
+    out = kern(make_global(mesh, xs))
+    want = np.concatenate(pieces)
+    for got in rows_of(out):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_alltoall_kernel(eight_device_mesh):
+    mesh = eight_device_mesh
+    maxsplit = 2
+    # packed[i, j] = chunk rank i sends to rank j; value = 10*i + j
+    packed = np.zeros((N, N, maxsplit, 1), np.float32)
+    for i in range(N):
+        for j in range(N):
+            packed[i, j] = 10 * i + j
+    kern = dispatch._alltoall_kernel(
+        mesh, N, maxsplit, dispatch._sig([jnp.asarray(packed[0])]))
+    out = kern(make_global(mesh, packed))
+    got_rows = rows_of(out)   # rank j receives (N, maxsplit, 1)
+    for j in range(N):
+        for i in range(N):
+            np.testing.assert_array_equal(
+                got_rows[j][i], np.full((maxsplit, 1), 10 * i + j))
+
+
+def test_reducescatter_even(eight_device_mesh):
+    mesh = eight_device_mesh
+    rng = np.random.RandomState(3)
+    xs = rng.randn(N, 16, 3).astype(np.float32)
+    rows = tuple([2] * N)
+    kern = dispatch._reducescatter_kernel(
+        mesh, N, SUM, 1.0, 1.0, rows, dispatch._sig([jnp.asarray(xs[0])]))
+    out = kern(make_global(mesh, xs))
+    total = xs.sum(0)
+    got_rows = rows_of(out)
+    for i in range(N):
+        np.testing.assert_allclose(got_rows[i], total[2 * i:2 * i + 2],
+                                   rtol=1e-5)
+
+
+def test_reducescatter_uneven(eight_device_mesh):
+    mesh = eight_device_mesh
+    rng = np.random.RandomState(4)
+    d0 = 11  # 8 ranks: rows (2,2,2,1,1,1,1,1)
+    xs = rng.randn(N, d0, 2).astype(np.float32)
+    base, rem = divmod(d0, N)
+    rows = tuple(base + (1 if i < rem else 0) for i in range(N))
+    kern = dispatch._reducescatter_kernel(
+        mesh, N, SUM, 1.0, 1.0, rows, dispatch._sig([jnp.asarray(xs[0])]))
+    out = kern(make_global(mesh, xs))
+    total = xs.sum(0)
+    offsets = np.concatenate([[0], np.cumsum(rows)])
+    got_rows = rows_of(out)
+    maxr = max(rows)
+    for i in range(N):
+        want = total[offsets[i]:offsets[i] + rows[i]]
+        np.testing.assert_allclose(got_rows[i][:rows[i]], want, rtol=1e-5)
+        assert got_rows[i].shape[0] == maxr
+
+
+def test_adasum_kernel_matches_numpy(eight_device_mesh):
+    from horovod_tpu.ops.adasum import _adasum_kernel, adasum_reference
+    mesh = eight_device_mesh
+    rng = np.random.RandomState(5)
+    xs = rng.randn(N, 32).astype(np.float32)
+    sig = dispatch._sig([jnp.asarray(xs[0])])
+    kern = _adasum_kernel(mesh, N, sig)
+    (out,) = kern(make_global(mesh, xs))
+    want = adasum_reference([xs[i] for i in range(N)])
+    for got in rows_of(out):
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_adasum_orthogonal_is_sum():
+    from horovod_tpu.ops.adasum import adasum_reference
+    a = np.array([1.0, 0.0, 0.0])
+    b = np.array([0.0, 1.0, 0.0])
+    np.testing.assert_allclose(adasum_reference([a, b]), a + b)
+
+
+def test_adasum_parallel_damps():
+    from horovod_tpu.ops.adasum import adasum_reference
+    a = np.array([1.0, 1.0])
+    out = adasum_reference([a, a])
+    # identical gradients: combine = a, not 2a
+    np.testing.assert_allclose(out, a)
