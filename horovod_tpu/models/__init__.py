@@ -1,0 +1,13 @@
+"""Model zoo: benchmark + correctness models (the reference ships
+models only as examples/; here they are first-class so the BASELINE.md
+configs are reproducible in-repo)."""
+
+from .mlp import init_mlp, mlp_forward, mlp_loss_fn  # noqa: F401
+from .resnet import (  # noqa: F401
+    ResNet, ResNet50, ResNet101, ResNet152, create_resnet50,
+    init_resnet, resnet_loss_fn,
+)
+from .transformer import (  # noqa: F401
+    EXTRA_RULES, TransformerConfig, forward, init_params, logits_fn,
+    loss_fn, param_logical_axes, vocab_parallel_xent,
+)

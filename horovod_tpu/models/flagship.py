@@ -1,0 +1,115 @@
+"""Flagship training assembly: transformer × mesh × optimizer → one
+jitted SPMD train step with real dp/fsdp/tp/sp/ep shardings.
+
+This is the module the driver's `__graft_entry__.dryrun_multichip`
+exercises, and the template for the BERT/Llama-class benchmark configs
+(BASELINE.md configs 3-4). Given any `Mesh` built by
+`parallel.build_mesh`, it:
+
+  1. adapts the model config to the mesh's live axes,
+  2. derives every parameter's PartitionSpec from its logical axes,
+  3. initializes global params and places them sharded,
+  4. builds the shard_map train step (explicit-collective path).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.mesh import (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS,
+                             TENSOR_AXIS, batch_axes)
+from ..parallel.sharding import Rules
+from ..parallel.train import build_train_step, infer_opt_state_specs
+from . import transformer as tfm
+
+
+def adapt_config(cfg: tfm.TransformerConfig,
+                 mesh: Mesh) -> tfm.TransformerConfig:
+    """Null out strategy axes the mesh doesn't have (or has at size 1)
+    so the model skips dead collectives."""
+    def live(name):
+        return name if name is not None and mesh.shape.get(name, 1) > 1 \
+            else None
+    return dataclasses.replace(
+        cfg,
+        tp_axis=live(cfg.tp_axis),
+        sp_axis=live(cfg.sp_axis),
+        ep_axis=live(cfg.ep_axis) if cfg.moe else None,
+    )
+
+
+def flagship_param_specs(cfg: tfm.TransformerConfig,
+                         mesh: Mesh) -> Dict[str, Any]:
+    rules = Rules(tfm.EXTRA_RULES)
+    logical = tfm.param_logical_axes(cfg)
+    return jax.tree.map(
+        lambda ax: rules.spec(ax, mesh), logical,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x))
+
+
+def batch_spec(mesh: Mesh) -> P:
+    baxes = batch_axes(mesh)
+    b = baxes if len(baxes) > 1 else (baxes[0] if baxes else None)
+    s = SEQ_AXIS if mesh.shape.get(SEQ_AXIS, 1) > 1 else None
+    return P(b, s)
+
+
+def make_flagship(mesh: Mesh,
+                  cfg: Optional[tfm.TransformerConfig] = None,
+                  optimizer: Optional[optax.GradientTransformation] = None,
+                  seed: int = 0,
+                  ) -> Tuple[Any, Any, Any, Any]:
+    """Returns (cfg, params, opt_state, step) with params/opt_state
+    already placed sharded on `mesh` and `step(params, opt_state,
+    batch) -> (params, opt_state, metrics)` jitted."""
+    cfg = adapt_config(cfg or tfm.TransformerConfig(), mesh)
+    optimizer = optimizer or optax.adamw(3e-4)
+
+    tp = mesh.shape.get(TENSOR_AXIS, 1)
+    ep = mesh.shape.get(EXPERT_AXIS, 1) if cfg.moe else 1
+    params_host = tfm.init_params(cfg, jax.random.PRNGKey(seed),
+                                  tp=tp, ep=ep)
+
+    p_specs = flagship_param_specs(cfg, mesh)
+    p_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), p_specs,
+                               is_leaf=lambda x: isinstance(x, P))
+    params = jax.tree.map(jax.device_put, params_host, p_shardings)
+
+    opt_specs = infer_opt_state_specs(optimizer, params_host, p_specs)
+    o_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s),
+                               opt_specs,
+                               is_leaf=lambda x: isinstance(x, P))
+    opt_state = jax.device_put(optimizer.init(params_host), o_shardings)
+
+    def local_loss(params, batch):
+        return tfm.loss_fn(cfg, params, batch)
+
+    step = build_train_step(
+        local_loss, optimizer, mesh,
+        batch_spec=batch_spec(mesh),
+        param_specs=p_specs,
+        opt_state_specs=opt_specs,
+    )
+    return cfg, params, opt_state, step
+
+
+def make_batch(cfg: tfm.TransformerConfig, mesh: Mesh,
+               global_batch: int, seq_len: int, seed: int = 1
+               ) -> Dict[str, jax.Array]:
+    """Synthetic token batch, placed with the step's input sharding."""
+    key = jax.random.PRNGKey(seed)
+    tokens = jax.random.randint(key, (global_batch, seq_len), 0,
+                                cfg.vocab, jnp.int32)
+    targets = jnp.roll(tokens, -1, axis=1)
+    spec = batch_spec(mesh)
+    sh = NamedSharding(mesh, spec)
+    return {"tokens": jax.device_put(tokens, sh),
+            "targets": jax.device_put(targets, sh)}

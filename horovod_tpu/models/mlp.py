@@ -1,0 +1,43 @@
+"""MNIST-class MLP/convnet — the correctness harness model.
+
+TPU-native equivalent of the reference's canonical example
+(reference: examples/pytorch/pytorch_mnist.py — the model used by the
+2-process Gloo/CPU config in BASELINE.md). Pure-jax params (no flax)
+so the 5-line hvd experience is visible end-to-end with nothing but
+this framework."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def init_mlp(key: jax.Array,
+             sizes: Sequence[int] = (784, 512, 512, 10),
+             dtype=jnp.float32) -> Dict[str, Any]:
+    params = {}
+    keys = jax.random.split(key, len(sizes) - 1)
+    for i, (k, din, dout) in enumerate(zip(keys, sizes[:-1], sizes[1:])):
+        params[f"w{i}"] = (jax.random.normal(k, (din, dout), jnp.float32)
+                           * (2.0 / din) ** 0.5).astype(dtype)
+        params[f"b{i}"] = jnp.zeros((dout,), dtype)
+    return params
+
+
+def mlp_forward(params: Dict[str, Any], x: jax.Array) -> jax.Array:
+    n = len(params) // 2
+    h = x.reshape(x.shape[0], -1)
+    for i in range(n):
+        h = h @ params[f"w{i}"] + params[f"b{i}"]
+        if i < n - 1:
+            h = jax.nn.relu(h)
+    return h
+
+
+def mlp_loss_fn(params, batch) -> jax.Array:
+    logits = mlp_forward(params, batch["images"])
+    onehot = jax.nn.one_hot(batch["labels"], logits.shape[-1])
+    return jnp.mean(
+        -jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))

@@ -60,7 +60,6 @@ def _axis_reduce(grads, axis_name: str, op: int, compression, size_hint):
             out = lax.psum(wire, axis_name)
         elif op == ADASUM:
             from ..ops.adasum import _tree_fold
-            n = lax.psum(1, axis_name)
             stacked = lax.all_gather(wire.reshape(-1), axis_name)
             out = _tree_fold([stacked[i] for i in range(size_hint)]
                              ).reshape(wire.shape)
@@ -158,10 +157,13 @@ def DistributedGradientTransformation(
         eff_op = op
         if op == AVERAGE and gradient_predivide_factor != 1.0:
             # reference: prescale by 1/f before the sum, postscale by
-            # f/size after — numerically safer for fp16 sums.
+            # f/size after — numerically safer for fp16 sums. Size is
+            # the PROCESS SET's size (the reduction spans only its
+            # members), matching the reference's process_set.size().
             import horovod_tpu as hvd
+            n = process_set.size if process_set is not None else hvd.size()
             prescale = 1.0 / gradient_predivide_factor
-            postscale = gradient_predivide_factor / hvd.size()
+            postscale = gradient_predivide_factor / n
             eff_op = SUM
         return _eager_reduce(grads, eff_op, compression, process_set,
                              num_groups, groups, prescale, postscale)

@@ -1,0 +1,129 @@
+"""Device-mesh construction and axis management.
+
+The reference scales by flat ranks over NCCL/MPI communicators
+(reference: horovod/common/mpi/mpi_context.cc — global/local/cross
+communicators; horovod/common/process_set.cc for subgroup comms). The
+TPU-native design instead names *axes of parallelism* on a
+`jax.sharding.Mesh` and lets XLA lower collectives onto ICI/DCN:
+
+    data   (dp)   — batch sharding; gradient psum rides ICI
+    fsdp          — parameter/optimizer-state sharding (ZeRO-3 analog)
+    tensor (tp)   — within-layer (Megatron-style) sharding
+    seq    (sp)   — sequence/context parallelism (ring attention)
+    expert (ep)   — MoE expert placement, alltoall routing
+    pipe   (pp)   — pipeline stages
+
+`MeshSpec` resolves a possibly-partial user spec against the actual
+device count (auto-factorizing the remainder into the data axis, the
+way `horovodrun -np N` auto-spreads ranks).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis order: outermost (slowest-varying, DCN-friendly) first.
+# dp/pp tolerate lower bandwidth; tp/sp want the fastest ICI links —
+# innermost mesh dims map to nearest-neighbor ICI on TPU.
+AXIS_ORDER = ("pipe", "data", "fsdp", "expert", "seq", "tensor")
+
+DATA_AXIS = "data"
+FSDP_AXIS = "fsdp"
+TENSOR_AXIS = "tensor"
+SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
+PIPE_AXIS = "pipe"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """A (possibly partial) parallelism layout.
+
+    Any axis set to 0 is auto-sized: remaining device count is folded
+    into `data` (axes default to 1). Example:
+        MeshSpec(tensor=4)         # tp=4, dp=n//4
+        MeshSpec(data=2, seq=4)    # dp=2, sp=4, must have n==8
+    """
+    data: int = 0
+    fsdp: int = 1
+    tensor: int = 1
+    seq: int = 1
+    expert: int = 1
+    pipe: int = 1
+
+    def resolve(self, n_devices: Optional[int] = None) -> "MeshSpec":
+        n = n_devices if n_devices is not None else len(jax.devices())
+        fixed = {a: getattr(self, a) for a in
+                 ("fsdp", "tensor", "seq", "expert", "pipe")}
+        prod_fixed = math.prod(max(v, 1) for v in fixed.values())
+        if self.data and self.data > 0:
+            total = self.data * prod_fixed
+            if total != n:
+                raise ValueError(
+                    f"mesh spec {self} needs {total} devices, have {n}")
+            return dataclasses.replace(
+                self, **{k: max(v, 1) for k, v in fixed.items()})
+        if n % prod_fixed:
+            raise ValueError(
+                f"device count {n} not divisible by fixed axes product "
+                f"{prod_fixed} ({fixed})")
+        return dataclasses.replace(
+            self, data=n // prod_fixed,
+            **{k: max(v, 1) for k, v in fixed.items()})
+
+    def axis_sizes(self) -> Dict[str, int]:
+        return {"pipe": self.pipe, "data": self.data, "fsdp": self.fsdp,
+                "expert": self.expert, "seq": self.seq,
+                "tensor": self.tensor}
+
+    @property
+    def total(self) -> int:
+        return math.prod(max(v, 1) for v in self.axis_sizes().values())
+
+
+def build_mesh(spec: Optional[MeshSpec] = None,
+               devices: Optional[Sequence[jax.Device]] = None,
+               keep_trivial_axes: bool = True) -> Mesh:
+    """Build a named Mesh from a spec.
+
+    Trivial (size-1) axes are kept by default so partition specs can
+    always name every logical axis regardless of layout — XLA erases
+    size-1 mesh dims for free.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    spec = (spec or MeshSpec()).resolve(len(devs))
+    sizes = spec.axis_sizes()
+    names: List[str] = []
+    dims: List[int] = []
+    for a in AXIS_ORDER:
+        if sizes[a] > 1 or keep_trivial_axes:
+            names.append(a)
+            dims.append(max(sizes[a], 1))
+    arr = np.array(devs).reshape(dims)
+    return Mesh(arr, axis_names=tuple(names))
+
+
+def data_parallel_mesh(devices: Optional[Sequence[jax.Device]] = None
+                       ) -> Mesh:
+    """Pure-DP mesh — the Horovod-equivalent layout (every device is a
+    'rank' on the data axis)."""
+    return build_mesh(MeshSpec(), devices, keep_trivial_axes=False)
+
+
+def mesh_axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape.get(axis, 1)
+
+
+def batch_axes(mesh: Mesh) -> Tuple[str, ...]:
+    """Axes the batch dimension is sharded over: dp + fsdp (fsdp shards
+    the batch too — parameters are gathered, not the batch replicated)
+    + expert (Switch-style EP is batch parallelism outside the expert
+    layers; tokens route via all_to_all inside them)."""
+    return tuple(a for a in (DATA_AXIS, FSDP_AXIS, EXPERT_AXIS)
+                 if a in mesh.shape)

@@ -1,0 +1,104 @@
+"""Sharding rules: logical-axis → mesh-axis mapping for pytrees.
+
+The reference has no model-parallel layer (SURVEY.md §2.6) — its
+process sets are the *enabler* for subgroup collectives. Here sharding
+is first-class: parameters and activations carry logical axis names
+(like flax's partitioning metadata), and a `Rules` table maps them to
+mesh axes, producing `NamedSharding`s for `jax.jit(in_shardings=...)`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import (DATA_AXIS, EXPERT_AXIS, FSDP_AXIS, SEQ_AXIS,
+                   TENSOR_AXIS)
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+
+# Default logical→mesh rules, Megatron/GSPMD-style. "embed" rides fsdp
+# so ZeRO-3 sharding falls out of the same table; with fsdp=1 the axis
+# is trivial and XLA erases it.
+DEFAULT_RULES: Dict[str, MeshAxes] = {
+    "batch": (DATA_AXIS, FSDP_AXIS, EXPERT_AXIS),
+    "seq": SEQ_AXIS,
+    "embed": FSDP_AXIS,
+    "mlp": TENSOR_AXIS,
+    "heads": TENSOR_AXIS,
+    "kv_heads": TENSOR_AXIS,
+    "head_dim": None,
+    "vocab": TENSOR_AXIS,
+    "expert": EXPERT_AXIS,
+    "conv_kernel": None,
+    "channels": None,
+    "channels_out": FSDP_AXIS,
+}
+
+
+class Rules:
+    """Immutable-ish mapping of logical axis names to mesh axes."""
+
+    def __init__(self, table: Optional[Dict[str, MeshAxes]] = None):
+        self.table = dict(DEFAULT_RULES)
+        if table:
+            self.table.update(table)
+
+    def spec(self, logical: Sequence[Optional[str]], mesh: Mesh) -> P:
+        """PartitionSpec for a tensor whose dims carry `logical` names.
+        Mesh axes absent from the mesh (or trivial) degrade to None, so
+        one rule table serves every layout."""
+        used = set()
+        parts = []
+        for name in logical:
+            ax = self.table.get(name) if name else None
+            if ax is None:
+                parts.append(None)
+                continue
+            axes = (ax,) if isinstance(ax, str) else tuple(ax)
+            live = tuple(a for a in axes
+                         if a in mesh.shape and mesh.shape[a] > 1
+                         and a not in used)
+            used.update(live)
+            if not live:
+                parts.append(None)
+            elif len(live) == 1:
+                parts.append(live[0])
+            else:
+                parts.append(live)
+        while parts and parts[-1] is None:
+            parts.pop()
+        return P(*parts)
+
+    def sharding(self, logical: Sequence[Optional[str]],
+                 mesh: Mesh) -> NamedSharding:
+        return NamedSharding(mesh, self.spec(logical, mesh))
+
+
+def tree_shardings(logical_tree: Any, mesh: Mesh,
+                   rules: Optional[Rules] = None) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of
+    NamedShardings (leaves are tuples/lists of axis-name strings)."""
+    rules = rules or Rules()
+    return jax.tree.map(
+        lambda ax: rules.sharding(ax, mesh), logical_tree,
+        is_leaf=lambda x: isinstance(x, (tuple, list)) and
+        all(a is None or isinstance(a, str) for a in x))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_put(tree: Any, shardings: Any) -> Any:
+    """device_put a pytree onto its shardings."""
+    return jax.tree.map(jax.device_put, tree, shardings)
+
+
+def infer_logical_from_shapes(params: Any) -> Any:
+    """Fallback heuristic when a model ships no logical annotations:
+    replicate everything (safe, DP-style). Kept explicit so callers
+    can see that no model sharding is happening."""
+    return jax.tree.map(lambda x: tuple(None for _ in x.shape), params)

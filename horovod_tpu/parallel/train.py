@@ -1,0 +1,201 @@
+"""Jitted SPMD training-step builders.
+
+This is the jit-path counterpart of the eager engine: where the
+reference overlaps communication with backprop via its background
+thread (reference: horovod/common/operations.cc BackgroundThreadLoop +
+horovod/torch/optimizer.py gradient hooks), here the entire training
+step is one XLA program over a `Mesh` and the latency-hiding scheduler
+does the overlap. Negotiation collapses to a compile-time concern
+(SURVEY.md §5.8 — "the biggest architectural simplification the TPU
+build gets to make").
+
+Two builders:
+  * `build_train_step`  — shard_map-based, explicit collectives
+    (lax.psum over the batch axes; Adasum/compression via
+    DistributedGradientTransformation(axis_name=...)). Horovod
+    semantics, TPU lowering.
+  * `build_gspmd_train_step` — constraint-based GSPMD: you give
+    shardings, XLA inserts the collectives. The fully
+    compiler-native path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import batch_axes
+from .sharding import Rules, replicated
+
+
+def _psum_axes(x, axes: Tuple[str, ...]):
+    for a in axes:
+        x = lax.psum(x, a)
+    return x
+
+
+def _pmean_axes(x, axes: Tuple[str, ...]):
+    for a in axes:
+        x = lax.pmean(x, a)
+    return x
+
+
+def infer_opt_state_specs(optimizer: optax.GradientTransformation,
+                          example_params: Any, param_specs: Any) -> Any:
+    """Derive PartitionSpecs for an optax state tree: any state leaf
+    whose tree path ends with a parameter's path (optax stores moments
+    as params-shaped subtrees) inherits that parameter's spec;
+    everything else (counts, scalars) is replicated."""
+    flat_params = jax.tree_util.tree_flatten_with_path(example_params)[0]
+    flat_specs = jax.tree_util.tree_leaves(
+        param_specs, is_leaf=lambda x: isinstance(x, P))
+    if len(flat_specs) == 1:
+        flat_specs = flat_specs * len(flat_params)
+    by_path = {tuple(str(k) for k in path): (spec, tuple(p.shape))
+               for (path, p), spec in zip(flat_params, flat_specs)}
+    state_shape = jax.eval_shape(optimizer.init, example_params)
+
+    def leaf_spec(path, leaf):
+        keys = tuple(str(k) for k in path)
+        for plen in range(len(keys), 0, -1):
+            suffix = keys[-plen:]
+            if suffix in by_path:
+                spec, pshape = by_path[suffix]
+                # only adopt if shapes agree — guards against key-name
+                # collisions (e.g. scalar state stored under a
+                # param-named key by inject_hyperparams/schedules).
+                if tuple(leaf.shape) == pshape:
+                    return spec
+                return P()
+        return P()
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, state_shape)
+
+
+def build_train_step(
+    loss_fn: Callable[..., Any],
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    batch_spec: Optional[P] = None,
+    param_specs: Any = None,
+    opt_state_specs: Any = None,
+    grad_reducer: Optional[Callable[[Any], Any]] = None,
+    loss_has_aux: bool = False,
+    donate: bool = True,
+) -> Callable:
+    """Build `step(params, opt_state, batch) -> (params, opt_state,
+    metrics)` as a single jitted shard_map over `mesh`.
+
+    loss_fn(params, batch) -> loss (or (loss, aux) with
+    loss_has_aux=True) computes the LOCAL loss on this device's batch
+    shard; collectives inside loss_fn (tp/sp/ep) are allowed — the
+    whole step runs under shard_map with all mesh axes manual.
+
+    Gradient semantics: under shard_map's VMA typing the local-loss
+    gradients arrive already psum'd over every axis a parameter is
+    replicated across — including the batch axes. The default reducer
+    therefore just scales by 1/n_batch to produce the mean (the
+    hvd.DistributedOptimizer contract). A custom `grad_reducer`
+    receives those SUMMED gradients and owns all scaling itself —
+    do NOT pmean inside it (the values are already replicated across
+    the batch axes, so a pmean is a no-op and the result stays
+    n_batch× too large).
+    """
+    baxes = batch_axes(mesh)
+    n_batch = 1
+    for a in baxes:
+        n_batch *= mesh.shape[a]
+    batch_spec = batch_spec if batch_spec is not None else P(
+        baxes if len(baxes) > 1 else (baxes[0] if baxes else None))
+
+    if param_specs is None:
+        param_specs = P()  # replicated params (pure DP)
+    if opt_state_specs is None:
+        opt_state_specs = param_specs if isinstance(param_specs, P) \
+            else P()
+
+    # Gradient semantics under shard_map VMA typing: each parameter is
+    # unvarying (replicated) over every mesh axis its spec does not
+    # name, so its local-loss gradient is automatically psum'd over
+    # those axes by the transpose machinery — including the batch
+    # axes. The true data-parallel MEAN gradient is therefore that
+    # psum divided by the batch-axis product; one uniform scale is
+    # correct for replicated AND model-sharded parameters alike.
+    def reduce_grads(grads):
+        if grad_reducer is not None:
+            return grad_reducer(grads)
+        if n_batch == 1:
+            return grads
+        inv = 1.0 / n_batch
+        return jax.tree.map(
+            lambda g: g * jnp.asarray(inv, g.dtype), grads)
+
+    def local_step(params, opt_state, batch):
+        if loss_has_aux:
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            aux = None
+        grads = reduce_grads(grads)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = {"loss": _pmean_axes(loss, baxes)}
+        if aux is not None:
+            # aux is device-varying; average it so metrics satisfy the
+            # replicated (P()) out_spec.
+            metrics["aux"] = jax.tree.map(
+                lambda a: _pmean_axes(a, baxes), aux)
+        return params, opt_state, metrics
+
+    step = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(param_specs, opt_state_specs, batch_spec),
+        out_specs=(param_specs, opt_state_specs, P()),
+    )
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
+def build_gspmd_train_step(
+    loss_fn: Callable[..., Any],
+    optimizer: optax.GradientTransformation,
+    mesh: Mesh,
+    *,
+    param_shardings: Any = None,
+    batch_sharding: Optional[NamedSharding] = None,
+    loss_has_aux: bool = False,
+    donate: bool = True,
+) -> Callable:
+    """Constraint-based variant: plain jit; XLA's SPMD partitioner
+    derives every collective from the in/out shardings. loss_fn sees
+    GLOBAL arrays."""
+    baxes = batch_axes(mesh)
+    if batch_sharding is None:
+        batch_sharding = NamedSharding(
+            mesh, P(baxes if len(baxes) > 1 else
+                    (baxes[0] if baxes else None)))
+    if param_shardings is None:
+        param_shardings = replicated(mesh)
+
+    def step(params, opt_state, batch):
+        batch = lax.with_sharding_constraint(batch, batch_sharding)
+        if loss_has_aux:
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            metrics = {"loss": loss, "aux": aux}
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            metrics = {"loss": loss}
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, metrics
+
+    donate_argnums = (0, 1) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)

@@ -1,0 +1,133 @@
+"""Flagship transformer: sharded (tp/sp/ep) numerics vs single-device
+oracle, and the full sharded train step."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from horovod_tpu.models import flagship
+from horovod_tpu.models import transformer as tfm
+from horovod_tpu.parallel import MeshSpec, build_mesh
+
+SMALL = tfm.TransformerConfig(
+    vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=2,
+    head_dim=8, d_ff=64, max_seq=32, dtype=jnp.float32)
+
+
+def oracle_loss(cfg, params, batch):
+    """Single-device loss: same config with all strategy axes off."""
+    cfg1 = dataclasses.replace(cfg, tp_axis=None, sp_axis=None,
+                               ep_axis=None)
+    return tfm.loss_fn(cfg1, params, batch)
+
+
+def make_host_batch(cfg, B, L, seed=1):
+    key = jax.random.PRNGKey(seed)
+    tokens = jax.random.randint(key, (B, L), 0, cfg.vocab, jnp.int32)
+    return {"tokens": tokens, "targets": jnp.roll(tokens, -1, axis=1)}
+
+
+class TestShardedLossMatchesOracle:
+    @pytest.mark.parametrize("spec", [
+        MeshSpec(tensor=2),                 # dp4 × tp2
+        MeshSpec(seq=2),                    # dp4 × sp2
+        MeshSpec(tensor=2, seq=2),          # dp2 × tp2 × sp2
+    ])
+    def test_dense(self, spec):
+        mesh = build_mesh(spec)
+        cfg, params, opt_state, step = flagship.make_flagship(
+            mesh, SMALL, optax.sgd(0.1))
+        batch_host = make_host_batch(cfg, 8, 32)
+
+        params_host = jax.tree.map(np.asarray, jax.device_get(params))
+        l0 = float(oracle_loss(cfg, params_host, batch_host))
+
+        batch = flagship.make_batch(cfg, mesh, 8, 32, seed=1)
+        # same tokens for oracle and sharded run
+        batch = {"tokens": jax.device_put(
+                     batch_host["tokens"], batch["tokens"].sharding),
+                 "targets": jax.device_put(
+                     batch_host["targets"], batch["targets"].sharding)}
+        new_params, _, metrics = step(params, opt_state, batch)
+        np.testing.assert_allclose(float(metrics["loss"]), l0,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_moe_full_mesh(self):
+        """tp×sp×ep all live with MoE — regression for the missing
+        tp-psum on the expert down-projection."""
+        cfg0 = dataclasses.replace(SMALL, moe=True, n_experts=4,
+                                   capacity_factor=8.0)
+        mesh = build_mesh(MeshSpec(tensor=2, seq=2, expert=2))
+        cfg, params, opt_state, step = flagship.make_flagship(
+            mesh, cfg0, optax.adam(1e-2))
+        batch = flagship.make_batch(cfg, mesh, 8, 32)
+        losses = []
+        for _ in range(5):
+            params, opt_state, m = step(params, opt_state, batch)
+            losses.append(float(m["loss"]))
+        assert losses[-1] < losses[0], losses
+
+    def test_moe_ep(self):
+        cfg0 = dataclasses.replace(SMALL, moe=True, n_experts=4,
+                                   capacity_factor=8.0)
+        mesh = build_mesh(MeshSpec(expert=2))
+        cfg, params, opt_state, step = flagship.make_flagship(
+            mesh, cfg0, optax.sgd(0.1))
+        batch_host = make_host_batch(cfg, 8, 32)
+        params_host = jax.tree.map(np.asarray, jax.device_get(params))
+        l0 = float(oracle_loss(cfg, params_host, batch_host))
+        spec_sh = flagship.batch_spec(mesh)
+        from jax.sharding import NamedSharding
+        sh = NamedSharding(mesh, spec_sh)
+        batch = {k: jax.device_put(v, sh) for k, v in batch_host.items()}
+        _, _, metrics = step(params, opt_state, batch)
+        # EP shards tokens per expert-rank: routing/capacity identical
+        # only with generous capacity; loss must match to fp32 noise.
+        np.testing.assert_allclose(float(metrics["loss"]), l0,
+                                   rtol=2e-3, atol=2e-3)
+
+
+class TestTrainingConverges:
+    def test_loss_decreases_sharded(self):
+        mesh = build_mesh(MeshSpec(tensor=2, seq=2))
+        cfg, params, opt_state, step = flagship.make_flagship(
+            mesh, SMALL, optax.adam(1e-2))
+        batch = flagship.make_batch(cfg, mesh, 8, 32)
+        losses = []
+        for _ in range(10):
+            params, opt_state, metrics = step(params, opt_state, batch)
+            losses.append(float(metrics["loss"]))
+        assert losses[-1] < losses[0] * 0.8, losses
+
+    def test_sharded_step_matches_replicated_step(self):
+        """One SGD step on dp2×tp2×sp2 must produce the same params as
+        one full-batch single-device step."""
+        mesh = build_mesh(MeshSpec(tensor=2, seq=2))
+        cfg, params, opt_state, step = flagship.make_flagship(
+            mesh, SMALL, optax.sgd(0.5))
+        batch_host = make_host_batch(cfg, 8, 32)
+        params_host = jax.tree.map(np.asarray, jax.device_get(params))
+
+        from jax.sharding import NamedSharding
+        sh = NamedSharding(mesh, flagship.batch_spec(mesh))
+        batch = {k: jax.device_put(v, sh) for k, v in batch_host.items()}
+        new_params, _, _ = step(params, opt_state, batch)
+        new_params_host = jax.tree.map(np.asarray,
+                                       jax.device_get(new_params))
+
+        grads = jax.grad(
+            lambda p: oracle_loss(cfg, p, batch_host))(params_host)
+        oracle = jax.tree.map(lambda p, g: p - 0.5 * g, params_host,
+                              grads)
+        flat1 = jax.tree_util.tree_leaves_with_path(new_params_host)
+        flat2 = dict(
+            (jax.tree_util.keystr(k), v)
+            for k, v in jax.tree_util.tree_leaves_with_path(oracle))
+        for path, v in flat1:
+            np.testing.assert_allclose(
+                np.asarray(v), np.asarray(flat2[jax.tree_util.keystr(path)]),
+                rtol=2e-4, atol=2e-4, err_msg=jax.tree_util.keystr(path))
