@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""ResNet-50 synthetic training benchmark — the BASELINE.md headline
+metric (img/sec/chip), TPU-native equivalent of the reference's
+examples/pytorch/pytorch_synthetic_benchmark.py.
+
+Trains ResNet-50 (NHWC, bfloat16 compute) on synthetic ImageNet-shaped
+data through the framework's own path: hvd lifecycle + the jitted
+data-parallel train step (build_train_step over a data mesh — the same
+program scales to a pod by adding devices; gradient reduction rides
+XLA psum over ICI, no NCCL anywhere).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "img/sec/chip", "vs_baseline": N}
+
+vs_baseline: BASELINE.json carries no absolute reference img/sec
+(`published` is empty — see BASELINE.md provenance note), so the ratio
+is reported against BENCH_BASELINE_IMG_SEC if set, else 1.0.
+
+Env knobs: BENCH_BATCH (default 128), BENCH_STEPS (30), BENCH_WARMUP
+(5), BENCH_IMAGE (224), BENCH_MODEL (resnet50).
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import horovod_tpu as hvd  # noqa: E402
+from horovod_tpu.models.resnet import create_resnet50, init_resnet  # noqa: E402
+from horovod_tpu.parallel import build_train_step  # noqa: E402
+from horovod_tpu.parallel.mesh import data_parallel_mesh  # noqa: E402
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main():
+    batch_per_chip = int(os.environ.get("BENCH_BATCH", "128"))
+    steps = int(os.environ.get("BENCH_STEPS", "30"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+    image = int(os.environ.get("BENCH_IMAGE", "224"))
+
+    hvd.init()
+    mesh = data_parallel_mesh()
+    n_chips = mesh.devices.size
+    global_batch = batch_per_chip * n_chips
+    log(f"bench: devices={n_chips} platform="
+        f"{jax.devices()[0].platform} global_batch={global_batch}")
+
+    model = create_resnet50(dtype=jnp.bfloat16)
+    variables = init_resnet(model, jax.random.PRNGKey(0), image)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    def loss_fn(params, batch):
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch["batch_stats"]},
+            batch["images"], train=True, mutable=["batch_stats"])
+        onehot = jax.nn.one_hot(batch["labels"], logits.shape[-1])
+        loss = jnp.mean(
+            -jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+        return loss, updates["batch_stats"]
+
+    opt = optax.sgd(0.0125 * n_chips, momentum=0.9)
+    opt_state = opt.init(params)
+
+    step = build_train_step(
+        loss_fn, opt, mesh,
+        batch_spec={"images": P("data"), "labels": P("data"),
+                    "batch_stats": P()},
+        loss_has_aux=True, donate=True)
+
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(
+        rng.standard_normal((global_batch, image, image, 3),
+                            dtype=np.float32))
+    labels = jnp.asarray(rng.integers(0, 1000, global_batch), jnp.int32)
+    data_sh = NamedSharding(mesh, P("data"))
+    images = jax.device_put(images, data_sh)
+    labels = jax.device_put(labels, data_sh)
+    rep_sh = NamedSharding(mesh, P())
+    batch_stats = jax.device_put(batch_stats, rep_sh)
+
+    def run_step(params, opt_state, batch_stats):
+        batch = {"images": images, "labels": labels,
+                 "batch_stats": batch_stats}
+        params, opt_state, metrics = step(params, opt_state, batch)
+        return params, opt_state, metrics["aux"], metrics["loss"]
+
+    t_c0 = time.perf_counter()
+    for _ in range(warmup):
+        params, opt_state, batch_stats, loss = run_step(
+            params, opt_state, batch_stats)
+    jax.block_until_ready(loss)
+    log(f"bench: warmup ({warmup} steps incl. compile) "
+        f"{time.perf_counter() - t_c0:.1f}s loss={float(loss):.3f}")
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, batch_stats, loss = run_step(
+            params, opt_state, batch_stats)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_sec = global_batch * steps / dt
+    img_sec_chip = img_sec / n_chips
+    log(f"bench: {steps} steps in {dt:.2f}s -> {img_sec:.1f} img/sec "
+        f"({img_sec_chip:.1f} img/sec/chip)")
+
+    baseline = float(os.environ.get("BENCH_BASELINE_IMG_SEC", "0")) or None
+    vs = img_sec_chip / baseline if baseline else 1.0
+    print(json.dumps({
+        "metric": "resnet50_synthetic_train_img_sec_per_chip",
+        "value": round(img_sec_chip, 2),
+        "unit": "img/sec/chip",
+        "vs_baseline": round(vs, 4),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

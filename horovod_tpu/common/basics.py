@@ -55,6 +55,7 @@ def _ensure_distributed(cfg: Config) -> bool:
             coordinator_address=cfg.coordinator_addr,
             num_processes=cfg.size,
             process_id=max(cfg.rank, 0),
+            initialization_timeout=int(max(cfg.start_timeout, 1)),
         )
         return True
     return False

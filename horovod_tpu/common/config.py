@@ -115,6 +115,9 @@ KNOBS: List[Knob] = [
     Knob("HOROVOD_GLOO_TIMEOUT_SECONDS", float, 30.0,
          "Control-plane message timeout (name kept from the reference; "
          "applies to the KV-store control plane)."),
+    Knob("HOROVOD_START_TIMEOUT", float, 30.0,
+         "Seconds each rank waits for the coordination service to come "
+         "up at init before aborting (set by hvdrun --start-timeout)."),
     Knob("HOROVOD_NUM_STREAMS", int, 1,
          "Number of independent collective launch lanes for the eager "
          "engine (the reference's HOROVOD_NUM_NCCL_STREAMS analog)."),
@@ -183,6 +186,7 @@ class Config:
         "coordinator_addr": "HOROVOD_COORDINATOR_ADDR",
         "control_addr": "HOROVOD_CONTROL_ADDR",
         "control_timeout": "HOROVOD_GLOO_TIMEOUT_SECONDS",
+        "start_timeout": "HOROVOD_START_TIMEOUT",
         "num_streams": "HOROVOD_NUM_STREAMS",
     }
 

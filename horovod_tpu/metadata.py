@@ -34,6 +34,26 @@ def native_controller_built() -> bool:
         return False
 
 
+def _importable(mod: str) -> bool:
+    import importlib.util
+    try:
+        return importlib.util.find_spec(mod) is not None
+    except (ImportError, ValueError):
+        return False
+
+
+def flax_available() -> bool:
+    return _importable("flax")
+
+
+def optax_available() -> bool:
+    return _importable("optax")
+
+
+def orbax_available() -> bool:
+    return _importable("orbax.checkpoint")
+
+
 # Compatibility shims for code migrating from the reference: the data
 # plane is always XLA over PJRT, never NCCL/MPI/Gloo.
 def nccl_built() -> bool:

@@ -112,8 +112,10 @@ def build_mesh(spec: Optional[MeshSpec] = None,
 def data_parallel_mesh(devices: Optional[Sequence[jax.Device]] = None
                        ) -> Mesh:
     """Pure-DP mesh — the Horovod-equivalent layout (every device is a
-    'rank' on the data axis)."""
-    return build_mesh(MeshSpec(), devices, keep_trivial_axes=False)
+    'rank' on the data axis). Always a 1-axis mesh, even on one
+    device."""
+    devs = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devs), axis_names=(DATA_AXIS,))
 
 
 def mesh_axis_size(mesh: Mesh, axis: str) -> int:

@@ -1,0 +1,7 @@
+"""Launcher / cluster layer (reference: horovod/runner/ — horovodrun).
+
+`python -m horovod_tpu.runner -np N [-H hosts] CMD...` or the
+programmatic `runner.run()`."""
+
+from .launch import main, run  # noqa: F401
+from .hosts import assign_ranks, parse_hosts  # noqa: F401

@@ -1,0 +1,55 @@
+"""`hvdrun --check-build` — the capability matrix
+(reference: horovod/runner/launch.py --check-build, which prints the
+[X] NCCL / [ ] MPI style table from horovod/metadata)."""
+
+from __future__ import annotations
+
+
+def _mark(b: bool) -> str:
+    return "[X]" if b else "[ ]"
+
+
+def check_build(verbose: bool = False) -> str:
+    import jax
+    import jaxlib
+    from .. import metadata
+    from ..core import native
+
+    lines = [
+        "horovod_tpu build/runtime capabilities:",
+        "",
+        "Available Frameworks:",
+        f"    {_mark(True)} JAX        (jax {jax.__version__}, "
+        f"jaxlib {jaxlib.__version__})",
+        f"    {_mark(metadata.flax_available())} Flax",
+        f"    {_mark(metadata.optax_available())} Optax",
+        f"    {_mark(metadata.orbax_available())} Orbax (checkpoint)",
+        "",
+        "Data plane (collectives):",
+        f"    {_mark(True)} XLA collectives (ICI/DCN via PJRT)",
+        f"    {_mark(False)} NCCL   (never: TPU-native build)",
+        f"    {_mark(False)} MPI    (never: TPU-native build)",
+        f"    {_mark(False)} Gloo   (never: TPU-native build)",
+        "",
+        "Control plane:",
+        f"    {_mark(native.available())} native C++ core",
+        f"    {_mark(True)} python controller",
+        f"    {_mark(True)} JAX coordination service "
+        "(rendezvous/KV/heartbeat)",
+    ]
+    try:
+        devs = jax.devices()
+        plat = devs[0].platform
+        kinds = sorted({d.device_kind for d in devs})
+        lines += [
+            "",
+            "Devices:",
+            f"    platform={plat} count={len(devs)} kinds={kinds}",
+            f"    processes={jax.process_count()}",
+        ]
+    except Exception as e:  # pragma: no cover - device-env dependent
+        lines += ["", f"Devices: unavailable ({e})"]
+    if verbose:
+        from ..common.config import describe_knobs
+        lines += ["", "Configuration knobs:", describe_knobs()]
+    return "\n".join(lines)

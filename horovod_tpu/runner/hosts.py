@@ -1,0 +1,104 @@
+"""Host-list parsing and rank assignment.
+
+Reference analog: horovod/runner/launch.py host parsing and
+horovod/runner/gloo_run.py per-rank env construction — `-H
+"h1:4,h2:4"` becomes an ordered (host, slots) list; ranks are assigned
+host-major so local_rank/cross_rank fall out by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+LOCALHOSTS = ("localhost", "127.0.0.1", "::1")
+
+
+@dataclasses.dataclass(frozen=True)
+class HostSlots:
+    host: str
+    slots: int
+
+    @property
+    def is_local(self) -> bool:
+        return self.host in LOCALHOSTS
+
+
+@dataclasses.dataclass(frozen=True)
+class RankInfo:
+    rank: int
+    size: int
+    local_rank: int
+    local_size: int
+    cross_rank: int
+    cross_size: int
+    host: str
+
+    @property
+    def is_local(self) -> bool:
+        return self.host in LOCALHOSTS
+
+    def env(self) -> dict:
+        return {
+            "HOROVOD_RANK": str(self.rank),
+            "HOROVOD_SIZE": str(self.size),
+            "HOROVOD_LOCAL_RANK": str(self.local_rank),
+            "HOROVOD_LOCAL_SIZE": str(self.local_size),
+            "HOROVOD_CROSS_RANK": str(self.cross_rank),
+            "HOROVOD_CROSS_SIZE": str(self.cross_size),
+        }
+
+
+def parse_hosts(hosts: Optional[str], np_: int) -> List[HostSlots]:
+    """Parse "-H h1:2,h2:2"; default = all ranks on localhost."""
+    if not hosts:
+        return [HostSlots("localhost", np_)]
+    out = []
+    for part in hosts.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if ":" in part:
+            h, s = part.rsplit(":", 1)
+            try:
+                slots = int(s)
+            except ValueError:
+                raise ValueError(f"bad host spec {part!r}: slots must be "
+                                 "an integer")
+        else:
+            h, slots = part, 1
+        if slots <= 0:
+            raise ValueError(f"bad host spec {part!r}: slots must be > 0")
+        out.append(HostSlots(h, slots))
+    total = sum(h.slots for h in out)
+    if total < np_:
+        raise ValueError(
+            f"host list provides {total} slots but -np is {np_}")
+    return out
+
+
+def assign_ranks(hostslots: List[HostSlots], np_: int) -> List[RankInfo]:
+    """Host-major rank assignment (reference: gloo_run's host_alloc)."""
+    infos: List[Tuple[str, int, int]] = []  # (host, local_rank, cross)
+    cross = 0
+    for hs in hostslots:
+        used = 0
+        for lr in range(hs.slots):
+            if len(infos) >= np_:
+                break
+            infos.append((hs.host, lr, cross))
+            used += 1
+        if used:
+            cross += 1
+        if len(infos) >= np_:
+            break
+    cross_size = cross
+    local_sizes = {}
+    for host, lr, cr in infos:
+        local_sizes[cr] = max(local_sizes.get(cr, 0), lr + 1)
+    return [
+        RankInfo(rank=i, size=np_, local_rank=lr,
+                 local_size=local_sizes[cr], cross_rank=cr,
+                 cross_size=cross_size, host=host)
+        for i, (host, lr, cr) in enumerate(infos)
+    ]

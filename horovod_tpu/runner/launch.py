@@ -1,0 +1,213 @@
+"""`hvdrun` — the launcher CLI (reference: horovod/runner/launch.py
+`horovodrun` + gloo_run.py's per-rank exec with log prefixing).
+
+Launches N copies of a training command with the bootstrap env each
+rank needs (HOROVOD_RANK/SIZE/..., HOROVOD_COORDINATOR_ADDR pointing
+at the rank-0 JAX coordination service = rendezvous + KV store +
+heartbeat, replacing the reference's HTTP rendezvous + gloo store).
+Local ranks are subprocesses; remote hosts are reached over ssh with
+env inlined (reference: horovod/runner/util/remote.py).
+
+Usage:
+    python -m horovod_tpu.runner -np 4 python train.py
+    python -m horovod_tpu.runner -np 8 -H h1:4,h2:4 python train.py
+    python -m horovod_tpu.runner --check-build
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .hosts import RankInfo, assign_ranks, parse_hosts
+
+# Env vars forwarded to workers in addition to explicitly-set ones
+# (reference: mpi_run's -x passthrough list).
+FORWARD_PREFIXES = ("HOROVOD_", "JAX_", "XLA_", "TPU_", "LIBTPU_",
+                    "PYTHON", "PATH", "LD_LIBRARY_PATH", "HOME")
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _prefix_pump(stream, tag: str, sink, lock: threading.Lock):
+    """Pump a child stream to `sink`, line-buffered, with the rank tag
+    (reference: gloo_run's MultiFile log prefixing)."""
+    for raw in iter(stream.readline, b""):
+        line = raw.decode("utf-8", "replace")
+        with lock:
+            sink.write(f"[{tag}]{line}")
+            sink.flush()
+    stream.close()
+
+
+def build_env(info: RankInfo, coordinator: str,
+              base_env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    env = dict(base_env if base_env is not None else os.environ)
+    env.update(info.env())
+    env["HOROVOD_COORDINATOR_ADDR"] = coordinator
+    return env
+
+
+def _ssh_command(info: RankInfo, command: List[str],
+                 env: Dict[str, str], ssh_port: Optional[int]) -> List[str]:
+    exports = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in sorted(env.items())
+        if k.startswith(FORWARD_PREFIXES))
+    remote = f"cd {shlex.quote(os.getcwd())} && env {exports} " + \
+        " ".join(shlex.quote(c) for c in command)
+    cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if ssh_port:
+        cmd += ["-p", str(ssh_port)]
+    cmd += [info.host, remote]
+    return cmd
+
+
+def run(command: List[str], np_: int = 1, hosts: Optional[str] = None,
+        env: Optional[Dict[str, str]] = None,
+        output_filename: Optional[str] = None,
+        ssh_port: Optional[int] = None,
+        start_timeout: float = 30.0,
+        verbose: bool = False) -> int:
+    """Programmatic launch API (reference: horovod.run()). Returns the
+    job's exit code (first nonzero child, else 0)."""
+    if not command:
+        raise ValueError("no command to run")
+    hostslots = parse_hosts(hosts, np_)
+    infos = assign_ranks(hostslots, np_)
+    # The coordination service is bound by RANK 0 in-process
+    # (common/basics.py _ensure_distributed), so the address must be
+    # rank 0's host — "localhost" only when rank 0 runs locally. The
+    # port is probed on this machine; for a remote rank 0 a random
+    # high port is overwhelmingly likely to be free there too, and a
+    # clash fails fast inside start_timeout.
+    rank0 = infos[0]
+    coord_host = "localhost" if rank0.is_local else rank0.host
+    coordinator = f"{coord_host}:{free_port()}"
+
+    procs: List[subprocess.Popen] = []
+    pumps: List[threading.Thread] = []
+    lock = threading.Lock()
+    sinks = []
+
+    try:
+        for info in infos:
+            child_env = build_env(info, coordinator, env)
+            child_env["HOROVOD_START_TIMEOUT"] = str(start_timeout)
+            if info.is_local:
+                cmd = command
+                popen_env = child_env
+            else:
+                cmd = _ssh_command(info, command, child_env, ssh_port)
+                popen_env = dict(os.environ)
+            if verbose:
+                print(f"[launcher] rank {info.rank} on {info.host}: "
+                      f"{' '.join(cmd)}", file=sys.stderr)
+            p = subprocess.Popen(cmd, env=popen_env,
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.PIPE)
+            procs.append(p)
+            if output_filename:
+                fo = open(f"{output_filename}.{info.rank}.out", "wb")
+                fe = open(f"{output_filename}.{info.rank}.err", "wb")
+                sinks += [fo, fe]
+                t1 = threading.Thread(target=_file_pump,
+                                      args=(p.stdout, fo), daemon=True)
+                t2 = threading.Thread(target=_file_pump,
+                                      args=(p.stderr, fe), daemon=True)
+            else:
+                t1 = threading.Thread(
+                    target=_prefix_pump,
+                    args=(p.stdout, f"{info.rank}", sys.stdout, lock),
+                    daemon=True)
+                t2 = threading.Thread(
+                    target=_prefix_pump,
+                    args=(p.stderr, f"{info.rank}", sys.stderr, lock),
+                    daemon=True)
+            t1.start(); t2.start()
+            pumps += [t1, t2]
+
+        exit_code = 0
+        remaining = set(range(len(procs)))
+        while remaining:
+            for i in list(remaining):
+                rc = procs[i].poll()
+                if rc is None:
+                    continue
+                remaining.discard(i)
+                if rc != 0 and exit_code == 0:
+                    exit_code = rc
+                    print(f"[launcher] rank {infos[i].rank} exited with "
+                          f"code {rc}; terminating remaining ranks",
+                          file=sys.stderr)
+                    for j in remaining:
+                        procs[j].terminate()
+            time.sleep(0.05)
+        for t in pumps:
+            t.join(timeout=5)
+        return exit_code
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for s in sinks:
+            s.close()
+
+
+def _file_pump(stream, f):
+    for raw in iter(stream.readline, b""):
+        f.write(raw)
+        f.flush()
+    stream.close()
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu training job "
+                    "(TPU-native horovodrun).")
+    p.add_argument("-np", "--num-proc", type=int, default=1,
+                   help="total number of processes")
+    p.add_argument("-H", "--hosts", default=None,
+                   help='comma-separated host:slots, e.g. "h1:4,h2:4" '
+                        "(default: all on localhost)")
+    p.add_argument("--output-filename", default=None,
+                   help="redirect each rank's output to "
+                        "FILENAME.<rank>.{out,err} instead of prefixed "
+                        "stdout/stderr")
+    p.add_argument("--ssh-port", type=int, default=None)
+    p.add_argument("--start-timeout", type=float, default=30.0)
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--check-build", action="store_true",
+                   help="print the capability matrix and exit")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="training command")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = make_parser().parse_args(argv)
+    if args.check_build:
+        from .doctor import check_build
+        print(check_build(verbose=args.verbose))
+        return 0
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        print("error: no command given", file=sys.stderr)
+        return 2
+    return run(command, np_=args.num_proc, hosts=args.hosts,
+               output_filename=args.output_filename,
+               ssh_port=args.ssh_port,
+               start_timeout=args.start_timeout, verbose=args.verbose)

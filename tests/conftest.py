@@ -48,3 +48,8 @@ def eight_device_mesh():
     import numpy as np
     devs = np.array(jax.devices()[:8])
     return Mesh(devs, axis_names=("proc",))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "integration: spawns real subprocesses")

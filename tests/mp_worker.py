@@ -1,0 +1,83 @@
+"""Worker script for launcher integration tests: exercises the eager
+collective API across REAL processes (the reference's
+`horovodrun -np 2 pytest` analog, SURVEY.md §4 tier 1)."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main():
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+    assert n == int(os.environ["HOROVOD_SIZE"]), (n, os.environ)
+    print(f"worker rank={r} size={n} devices={jax.device_count()}")
+
+    # allreduce (average)
+    out = hvd.allreduce(jnp.array([float(r + 1), 2.0]), name="t0")
+    expect = np.array([(sum(range(1, n + 1))) / n, 2.0])
+    np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-6)
+
+    # sum + prescale
+    out = hvd.allreduce(jnp.array([1.0]), op=hvd.Sum,
+                        prescale_factor=2.0, name="t1")
+    np.testing.assert_allclose(np.asarray(out), [2.0 * n])
+
+    # grouped allreduce, mixed dtypes
+    outs = hvd.grouped_allreduce(
+        [jnp.ones((3,), jnp.float32) * r, jnp.ones((2,), jnp.float64)],
+        op=hvd.Sum, name="t2")
+    np.testing.assert_allclose(np.asarray(outs[0]),
+                               np.full(3, sum(range(n))))
+    np.testing.assert_allclose(np.asarray(outs[1]), np.full(2, n))
+
+    # broadcast
+    out = hvd.broadcast(jnp.arange(4.0) * (r + 1), root_rank=1 % n,
+                        name="t3")
+    np.testing.assert_allclose(np.asarray(out),
+                               np.arange(4.0) * ((1 % n) + 1))
+
+    # uneven allgather
+    out = hvd.allgather(jnp.full((r + 1, 2), float(r)), name="t4")
+    expect = np.concatenate(
+        [np.full((i + 1, 2), float(i)) for i in range(n)])
+    np.testing.assert_allclose(np.asarray(out), expect)
+
+    # alltoall with splits
+    x = jnp.arange(float(n * 2)).reshape(n * 2)[:, None]
+    out, recv = hvd.alltoall(x, splits=[2] * n, name="t5")
+    assert out.shape[0] == 2 * n
+
+    # reducescatter
+    x = jnp.ones((2 * n, 3)) * (r + 1)
+    out = hvd.reducescatter(x, op=hvd.Sum, name="t6")
+    np.testing.assert_allclose(
+        np.asarray(out), np.full((2, 3), sum(range(1, n + 1))))
+
+    # barrier + broadcast_parameters + optimizer functions
+    hvd.barrier()
+    params = {"w": jnp.ones((2, 2)) * r}
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    np.testing.assert_allclose(np.asarray(params["w"]), 0.0)
+
+    # broadcast_object
+    obj = hvd.broadcast_object({"epoch": r * 10}, root_rank=0)
+    assert obj == {"epoch": 0}
+
+    print(f"worker rank={r}: ALL OK")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

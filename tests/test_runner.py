@@ -1,0 +1,121 @@
+"""Launcher unit tests (reference: test/single/test_run.py — arg
+parsing and command-line construction asserted as strings, no SSH)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.runner.hosts import assign_ranks, parse_hosts
+from horovod_tpu.runner.launch import _ssh_command, build_env, make_parser
+from horovod_tpu.runner.hosts import RankInfo
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestHosts:
+    def test_default_localhost(self):
+        hs = parse_hosts(None, 4)
+        assert len(hs) == 1 and hs[0].host == "localhost" \
+            and hs[0].slots == 4
+
+    def test_parse(self):
+        hs = parse_hosts("h1:2, h2:3", 5)
+        assert [(h.host, h.slots) for h in hs] == [("h1", 2), ("h2", 3)]
+
+    def test_too_few_slots(self):
+        with pytest.raises(ValueError, match="slots"):
+            parse_hosts("h1:2", 4)
+
+    def test_bad_slots(self):
+        with pytest.raises(ValueError):
+            parse_hosts("h1:x", 1)
+        with pytest.raises(ValueError):
+            parse_hosts("h1:0", 1)
+
+    def test_assign_ranks(self):
+        infos = assign_ranks(parse_hosts("h1:2,h2:2", 4), 4)
+        assert [(i.rank, i.host, i.local_rank, i.cross_rank)
+                for i in infos] == [
+            (0, "h1", 0, 0), (1, "h1", 1, 0),
+            (2, "h2", 0, 1), (3, "h2", 1, 1)]
+        assert all(i.local_size == 2 and i.cross_size == 2
+                   for i in infos)
+
+    def test_assign_partial_last_host(self):
+        infos = assign_ranks(parse_hosts("h1:2,h2:2", 3), 3)
+        assert [i.host for i in infos] == ["h1", "h1", "h2"]
+        assert infos[2].local_size == 1
+
+
+class TestEnvAndSsh:
+    def test_build_env(self):
+        info = RankInfo(1, 4, 1, 2, 0, 2, "h1")
+        env = build_env(info, "c:123", {"PATH": "/bin"})
+        assert env["HOROVOD_RANK"] == "1"
+        assert env["HOROVOD_SIZE"] == "4"
+        assert env["HOROVOD_LOCAL_RANK"] == "1"
+        assert env["HOROVOD_COORDINATOR_ADDR"] == "c:123"
+        assert env["PATH"] == "/bin"
+
+    def test_ssh_command_string(self):
+        info = RankInfo(2, 4, 0, 2, 1, 2, "hostB")
+        env = {"HOROVOD_RANK": "2", "SECRET_TOKEN": "x",
+               "JAX_PLATFORMS": "cpu"}
+        cmd = _ssh_command(info, ["python", "train.py"], env, 2222)
+        assert cmd[0] == "ssh"
+        assert "-p" in cmd and "2222" in cmd
+        assert cmd[-2] == "hostB"
+        remote = cmd[-1]
+        assert "HOROVOD_RANK=2" in remote
+        assert "JAX_PLATFORMS=cpu" in remote
+        assert "SECRET_TOKEN" not in remote  # not in forward list
+        assert remote.endswith("python train.py")
+
+    def test_parser(self):
+        args = make_parser().parse_args(
+            ["-np", "4", "-H", "h1:4", "python", "t.py"])
+        assert args.num_proc == 4 and args.hosts == "h1:4"
+        assert args.command == ["python", "t.py"]
+
+
+def run_launcher(np_, script, extra_env=None, timeout=240):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)  # children don't need 8 fake devices
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if extra_env:
+        env.update(extra_env)
+    return subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_),
+         sys.executable, script],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=timeout)
+
+
+@pytest.mark.integration
+class TestRealLaunch:
+    def test_two_process_collectives(self):
+        r = run_launcher(2, os.path.join("tests", "mp_worker.py"))
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert r.stdout.count("ALL OK") == 2
+
+    def test_failing_rank_propagates(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "import os, sys\n"
+            "sys.exit(3 if os.environ['HOROVOD_RANK'] == '1' else 0)\n")
+        r = run_launcher(2, str(bad))
+        assert r.returncode == 3
+        assert "exited with code 3" in r.stdout + r.stderr
+
+
+class TestDoctor:
+    def test_check_build(self):
+        from horovod_tpu.runner.doctor import check_build
+        out = check_build()
+        assert "XLA collectives" in out
+        assert "[ ] NCCL" in out
+        assert "JAX" in out
