@@ -94,6 +94,24 @@ def init(config_overrides: Optional[Dict[str, Any]] = None,
         _state.engine = Engine(cfg, _state.topology,
                                _state.process_set_table)
 
+        # Negotiated-cycle controller: ON whenever ranks could submit
+        # out of order (size > 1) — the reference's core value
+        # proposition — or when forced for tests. 'inline' disables
+        # (single-process fast path keeps inline dispatch).
+        mode = (cfg.controller or "auto").lower()
+        want = {"auto": _state.topology.size > 1,
+                "native": True, "python": True,
+                "inline": False, "none": False}.get(mode, False)
+        if want:
+            from ..ops.controller import (NegotiatedController,
+                                          PythonCore)
+            forced_python = mode == "python"
+            core = (PythonCore(cfg.fusion_threshold)
+                    if forced_python and _state.topology.size == 1
+                    else None)
+            _state.engine.controller = NegotiatedController(
+                cfg, _state.topology, _state.engine, core=core)
+
         if cfg.timeline_path and _state.topology.rank == 0:
             from ..timeline import Timeline
             _state.timeline = Timeline(cfg.timeline_path,

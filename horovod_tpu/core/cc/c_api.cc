@@ -1,0 +1,92 @@
+// extern "C" surface for ctypes (no pybind11 in this image; the
+// ctypes boundary also keeps the core usable from any language).
+//
+// Reference analog: the C API at the bottom of
+// horovod/common/operations.h (horovod_init / EnqueueTensorAllreduces
+// / horovod_rank...) that every framework binding funnels into.
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "controller.h"
+
+using hvdtpu::Controller;
+using hvdtpu::ControllerOptions;
+using hvdtpu::Entry;
+
+extern "C" {
+
+void* hvd_core_create(int rank, int size, const char* coord_host,
+                      int coord_port, long long fusion_threshold,
+                      double cycle_time_ms, double stall_warn_s,
+                      double stall_kill_s, double connect_timeout_s) {
+  ControllerOptions o;
+  o.rank = rank;
+  o.size = size;
+  o.coord_host = coord_host ? coord_host : "127.0.0.1";
+  o.coord_port = coord_port;
+  o.fusion_threshold = fusion_threshold;
+  o.cycle_time_ms = cycle_time_ms;
+  o.stall_warn_s = stall_warn_s;
+  o.stall_kill_s = stall_kill_s;
+  o.connect_timeout_s = connect_timeout_s;
+  return new Controller(o);
+}
+
+void hvd_core_destroy(void* h) { delete static_cast<Controller*>(h); }
+
+int hvd_core_ok(void* h) {
+  return static_cast<Controller*>(h)->ok() ? 1 : 0;
+}
+
+const char* hvd_core_last_error(void* h) {
+  return static_cast<Controller*>(h)->last_error().c_str();
+}
+
+void hvd_core_submit(void* h, const char* name, const char* sig,
+                     long long nbytes) {
+  static_cast<Controller*>(h)->Submit(name, sig, nbytes);
+}
+
+void hvd_core_join(void* h) { static_cast<Controller*>(h)->Join(); }
+
+// -1 until all ranks joined; then the last-joining rank.
+int hvd_core_all_joined(void* h) {
+  return static_cast<Controller*>(h)->AllJoined();
+}
+
+long long hvd_core_cycles(void* h) {
+  return static_cast<Controller*>(h)->cycles();
+}
+
+// Returns: >=0 bytes written into buf (a batch, possibly empty on
+// timeout); -1 shutdown; -2 buffer too small.
+// Batch encoding: entries joined by '\x1e', fields by '\x1f':
+//   name '\x1f' sig '\x1f' active_ranks '\x1f' error
+long long hvd_core_next_batch(void* h, char* buf, long long bufsize,
+                              double timeout_s) {
+  std::vector<Entry> entries;
+  if (!static_cast<Controller*>(h)->NextBatch(timeout_s, &entries))
+    return -1;
+  std::string out;
+  for (size_t i = 0; i < entries.size(); ++i) {
+    if (i) out.push_back('\x1e');
+    out += entries[i].name;
+    out.push_back('\x1f');
+    out += entries[i].sig;
+    out.push_back('\x1f');
+    out += std::to_string(entries[i].active_ranks);
+    out.push_back('\x1f');
+    out += entries[i].error;
+  }
+  if (static_cast<long long>(out.size()) > bufsize) return -2;
+  memcpy(buf, out.data(), out.size());
+  return static_cast<long long>(out.size());
+}
+
+void hvd_core_shutdown(void* h) {
+  static_cast<Controller*>(h)->Shutdown();
+}
+
+}  // extern "C"

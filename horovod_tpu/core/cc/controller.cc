@@ -1,0 +1,429 @@
+#include "controller.h"
+
+#include <algorithm>
+#include <sstream>
+
+namespace hvdtpu {
+
+const char kAllJoined[] = "__hvdtpu_all_joined__";
+
+namespace {
+// Fuse key = signature up to the first '#' (dtype|op); tensors with
+// equal fuse keys may share a fused launch (reference:
+// Controller::FuseResponses same-dtype/op rule).
+std::string FuseKey(const std::string& sig) {
+  auto pos = sig.find('#');
+  return pos == std::string::npos ? sig : sig.substr(0, pos);
+}
+}  // namespace
+
+Controller::Controller(const ControllerOptions& opts) : opts_(opts) {
+  if (opts_.size > 1) {
+    if (opts_.rank == 0) {
+      listen_fd_ = ListenOn(opts_.coord_port, opts_.size + 4);
+      if (listen_fd_ < 0) {
+        ok_ = false;
+        last_error_ = "failed to listen on control port " +
+                      std::to_string(opts_.coord_port);
+        return;
+      }
+      worker_fds_.assign(opts_.size, -1);
+      threads_.emplace_back(&Controller::ServerAcceptLoop, this);
+    } else {
+      coord_fd_ = ConnectTo(opts_.coord_host, opts_.coord_port,
+                            opts_.connect_timeout_s);
+      if (coord_fd_ < 0) {
+        ok_ = false;
+        last_error_ = "failed to connect to controller at " +
+                      opts_.coord_host + ":" +
+                      std::to_string(opts_.coord_port);
+        return;
+      }
+      Buf hello;
+      hello.PutU32(static_cast<uint32_t>(opts_.rank));
+      SendMsg(coord_fd_, MsgType::kHello, hello.data());
+      threads_.emplace_back(&Controller::WorkerReaderLoop, this);
+    }
+  }
+  threads_.emplace_back(&Controller::CycleLoop, this);
+  HVD_LOG(kDebug, "controller up: rank=%d size=%d port=%d", opts_.rank,
+          opts_.size, opts_.coord_port);
+}
+
+Controller::~Controller() { Shutdown(); }
+
+void Controller::Abort() {
+  bool expected = false;
+  if (!shutdown_.compare_exchange_strong(expected, true)) return;
+  // Coordinator: tell workers this is a clean teardown before the
+  // sockets drop, so their reader loops don't report a lost
+  // connection.
+  if (opts_.rank == 0 && !worker_fds_.empty()) {
+    std::lock_guard<std::mutex> lk(send_mu_);
+    for (int fd : worker_fds_)
+      if (fd >= 0) SendMsg(fd, MsgType::kShutdown, "");
+  }
+  {
+    std::lock_guard<std::mutex> lk(ready_mu_);
+    ready_cv_.notify_all();
+  }
+  if (coord_fd_ >= 0) ::shutdown(coord_fd_, SHUT_RDWR);
+  if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
+  for (int fd : worker_fds_)
+    if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+}
+
+void Controller::Shutdown() {
+  Abort();
+  auto self = std::this_thread::get_id();
+  for (auto& t : threads_)
+    if (t.joinable() && t.get_id() != self) t.join();
+  {
+    std::lock_guard<std::mutex> lk(reader_threads_mu_);
+    for (auto& t : reader_threads_)
+      if (t.joinable() && t.get_id() != self) t.join();
+  }
+  if (coord_fd_ >= 0) ::close(coord_fd_);
+  if (listen_fd_ >= 0) ::close(listen_fd_);
+  for (int fd : worker_fds_)
+    if (fd >= 0) ::close(fd);
+  worker_fds_.clear();
+  coord_fd_ = listen_fd_ = -1;
+}
+
+void Controller::Submit(const std::string& name, const std::string& sig,
+                        int64_t nbytes) {
+  std::lock_guard<std::mutex> lk(submit_mu_);
+  Request r;
+  r.name = name;
+  r.sig = sig;
+  r.nbytes = nbytes;
+  pending_.push_back(std::move(r));
+}
+
+void Controller::Join() {
+  std::lock_guard<std::mutex> lk(submit_mu_);
+  Request r;
+  r.join = true;
+  pending_.push_back(std::move(r));
+}
+
+bool Controller::NextBatch(double timeout_s, std::vector<Entry>* out) {
+  out->clear();
+  std::unique_lock<std::mutex> lk(ready_mu_);
+  if (!ready_cv_.wait_for(
+          lk, std::chrono::duration<double>(timeout_s),
+          [&] { return !ready_.empty() || shutdown_.load(); }))
+    return true;  // timeout: empty batch, caller re-polls
+  if (ready_.empty()) return false;  // shutdown
+  int32_t bid = ready_.front().batch_id;
+  while (!ready_.empty() && ready_.front().batch_id == bid) {
+    out->push_back(std::move(ready_.front()));
+    ready_.pop_front();
+  }
+  return true;
+}
+
+int Controller::AllJoined() {
+  std::lock_guard<std::mutex> lk(ready_mu_);
+  return all_joined_last_rank_;
+}
+
+// --------------------------------------------------------------------------
+// cycle loop (all ranks): drain local queue, feed the coordinator
+// (reference: BackgroundThreadLoop / RunLoopOnce)
+// --------------------------------------------------------------------------
+
+void Controller::CycleLoop() {
+  while (!shutdown_.load()) {
+    std::vector<Request> mine;
+    {
+      std::lock_guard<std::mutex> lk(submit_mu_);
+      mine.swap(pending_);
+    }
+    if (!mine.empty()) {
+      if (opts_.rank == 0 || opts_.size == 1) {
+        CoordinatorIngest(0, std::move(mine));
+      } else {
+        if (!SendMsg(coord_fd_, MsgType::kReady,
+                     SerializeRequests(mine)) &&
+            !shutdown_.load()) {
+          HVD_LOG(kError, "lost connection to controller");
+          ok_ = false;
+          last_error_ = "lost connection to controller";
+          Abort();  // never Shutdown() from our own thread
+          return;
+        }
+      }
+    }
+    if (opts_.rank == 0) RunCoordinatorCycle();
+    cycles_.fetch_add(1);
+    std::this_thread::sleep_for(std::chrono::duration<double>(
+        opts_.cycle_time_ms / 1000.0));
+  }
+}
+
+// --------------------------------------------------------------------------
+// coordinator (rank 0)
+// --------------------------------------------------------------------------
+
+void Controller::CoordinatorIngest(int rank, std::vector<Request> reqs) {
+  std::lock_guard<std::mutex> lk(coord_mu_);
+  double now = NowSeconds();
+  for (auto& r : reqs) {
+    if (r.join) {
+      if (joined_ranks_.insert(rank).second) last_joined_rank_ = rank;
+      continue;
+    }
+    auto it = tensors_.find(r.name);
+    if (it == tensors_.end()) {
+      TensorState st;
+      // Consistency is checked WITHIN a negotiation round only:
+      // re-submitting a name with new metadata next round (e.g. a
+      // changed prescale from dynamic loss scaling) renegotiates
+      // cleanly, like the reference's ResponseCache miss path.
+      st.sig = r.sig;
+      st.nbytes = r.nbytes;
+      st.first_seen = now;
+      st.ready_ranks.insert(rank);
+      tensors_.emplace(r.name, std::move(st));
+    } else {
+      TensorState& st = it->second;
+      if (st.sig != r.sig && st.error.empty()) {
+        st.error = "tensor '" + r.name +
+                   "' has mismatched signatures across ranks: '" +
+                   st.sig + "' vs rank " + std::to_string(rank) +
+                   "'s '" + r.sig + "'";
+      }
+      st.ready_ranks.insert(rank);
+    }
+    TensorState& st = tensors_[r.name];
+    // Ready when every non-joined rank has submitted. Joined ranks
+    // still execute the collective (SPMD requires all participants)
+    // with zero contributions, decided Python-side.
+    size_t needed = static_cast<size_t>(opts_.size) - joined_ranks_.size();
+    bool was_ready = st.fully_ready_at > 0.0;
+    if (!was_ready && st.ready_ranks.size() >= needed) {
+      st.fully_ready_at = now;
+      ready_order_.push_back(r.name);
+    }
+  }
+}
+
+void Controller::RunCoordinatorCycle() {
+  std::vector<Entry> out;
+  {
+    std::lock_guard<std::mutex> lk(coord_mu_);
+    double now = NowSeconds();
+    // Re-check readiness: a rank joining can make earlier tensors
+    // eligible (their missing submitters are gone).
+    size_t needed =
+        static_cast<size_t>(opts_.size) - joined_ranks_.size();
+    for (auto& kv : tensors_) {
+      TensorState& st = kv.second;
+      if (st.fully_ready_at == 0.0 && st.ready_ranks.size() >= needed) {
+        st.fully_ready_at = now;
+        ready_order_.push_back(kv.first);
+      }
+    }
+    // Greedy fusion over the fully-ready FIFO (reference:
+    // FuseResponses): consecutive same-fuse-key tensors pack into one
+    // batch up to the threshold.
+    size_t i = 0;
+    while (i < ready_order_.size()) {
+      const std::string& name = ready_order_[i];
+      auto it = tensors_.find(name);
+      if (it == tensors_.end()) {
+        ++i;
+        continue;
+      }
+      int32_t bid = next_batch_id_++;
+      std::string key = FuseKey(it->second.sig);
+      int64_t bytes = 0;
+      size_t j = i;
+      while (j < ready_order_.size()) {
+        auto jt = tensors_.find(ready_order_[j]);
+        if (jt == tensors_.end()) break;
+        const TensorState& st = jt->second;
+        if (FuseKey(st.sig) != key) break;
+        if (bytes > 0 && bytes + st.nbytes > opts_.fusion_threshold)
+          break;
+        Entry e;
+        e.name = ready_order_[j];
+        e.sig = st.sig;
+        e.batch_id = bid;
+        e.active_ranks =
+            opts_.size - static_cast<int>(joined_ranks_.size());
+        e.error = st.error;
+        out.push_back(std::move(e));
+        bytes += st.nbytes;
+        tensors_.erase(jt);
+        ++j;
+      }
+      i = j;
+    }
+    ready_order_.clear();
+    // all-joined announcement
+    if (!join_announced_ &&
+        joined_ranks_.size() == static_cast<size_t>(opts_.size)) {
+      join_announced_ = true;
+      Entry e;
+      e.name = kAllJoined;
+      e.batch_id = next_batch_id_++;
+      e.active_ranks = last_joined_rank_;  // carries the join() result
+      out.push_back(std::move(e));
+    }
+    CheckStalls(now);
+  }
+  if (!out.empty()) BroadcastEntries(out);
+}
+
+void Controller::CheckStalls(double now) {
+  // reference: StallInspector::CheckForStalledTensors — warn listing
+  // the ranks that have NOT submitted a tensor others are waiting on.
+  if (opts_.stall_warn_s <= 0) return;
+  int64_t gen = static_cast<int64_t>(now / opts_.stall_warn_s);
+  if (gen == stall_warned_gen_) return;
+  bool warned = false;
+  for (auto& kv : tensors_) {
+    TensorState& st = kv.second;
+    if (st.fully_ready_at > 0.0) continue;
+    double waited = now - st.first_seen;
+    if (waited > opts_.stall_warn_s) {
+      std::ostringstream missing;
+      for (int r = 0; r < opts_.size; ++r) {
+        if (!st.ready_ranks.count(r) && !joined_ranks_.count(r))
+          missing << r << " ";
+      }
+      HVD_LOG(kWarning,
+              "tensor '%s' stalled for %.0fs: waiting on ranks [ %s]",
+              kv.first.c_str(), waited, missing.str().c_str());
+      warned = true;
+      if (opts_.stall_kill_s > 0 && waited > opts_.stall_kill_s &&
+          st.error.empty()) {
+        st.error = "tensor '" + kv.first + "' stalled beyond " +
+                   std::to_string(opts_.stall_kill_s) + "s";
+        st.fully_ready_at = now;
+        ready_order_.push_back(kv.first);
+      }
+    }
+  }
+  if (warned) stall_warned_gen_ = gen;
+}
+
+void Controller::BroadcastEntries(const std::vector<Entry>& entries) {
+  std::string payload = SerializeEntries(entries);
+  {
+    std::lock_guard<std::mutex> lk(send_mu_);
+    for (int r = 1; r < opts_.size; ++r) {
+      int fd;
+      {
+        std::lock_guard<std::mutex> clk(coord_mu_);
+        fd = r < static_cast<int>(worker_fds_.size()) ? worker_fds_[r]
+                                                      : -1;
+      }
+      if (fd >= 0) SendMsg(fd, MsgType::kResponses, payload);
+    }
+  }
+  DeliverEntries(entries);  // rank 0's own copy
+}
+
+void Controller::DeliverEntries(const std::vector<Entry>& entries) {
+  std::lock_guard<std::mutex> lk(ready_mu_);
+  for (const auto& e : entries) {
+    if (e.name == kAllJoined) {
+      all_joined_last_rank_ = e.active_ranks;
+      continue;
+    }
+    ready_.push_back(e);
+  }
+  ready_cv_.notify_all();
+}
+
+// --------------------------------------------------------------------------
+// socket threads
+// --------------------------------------------------------------------------
+
+void Controller::ServerAcceptLoop() {
+  int connected = 0;
+  while (!shutdown_.load() && connected < opts_.size - 1) {
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) break;
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    MsgType t;
+    std::string payload;
+    if (!RecvMsg(fd, &t, &payload) || t != MsgType::kHello) {
+      ::close(fd);
+      continue;
+    }
+    Reader rd(payload);
+    uint32_t rank = 0;
+    rd.GetU32(&rank);
+    if (rank == 0 || rank >= static_cast<uint32_t>(opts_.size)) {
+      ::close(fd);
+      continue;
+    }
+    {
+      std::lock_guard<std::mutex> lk(coord_mu_);
+      worker_fds_[rank] = fd;
+    }
+    {
+      std::lock_guard<std::mutex> lk(reader_threads_mu_);
+      reader_threads_.emplace_back(&Controller::ReaderLoop, this,
+                                   static_cast<int>(rank), fd);
+    }
+    ++connected;
+    HVD_LOG(kDebug, "rank %u connected (%d/%d)", rank, connected,
+            opts_.size - 1);
+  }
+}
+
+void Controller::ReaderLoop(int rank, int fd) {
+  MsgType t;
+  std::string payload;
+  while (!shutdown_.load() && RecvMsg(fd, &t, &payload)) {
+    if (t == MsgType::kReady) {
+      std::vector<Request> reqs;
+      if (ParseRequests(payload, &reqs))
+        CoordinatorIngest(rank, std::move(reqs));
+    } else if (t == MsgType::kShutdown) {
+      break;
+    }
+  }
+  if (!shutdown_.load())
+    HVD_LOG(kDebug, "rank %d control connection closed", rank);
+}
+
+void Controller::WorkerReaderLoop() {
+  MsgType t;
+  std::string payload;
+  bool clean = false;
+  while (!shutdown_.load() && RecvMsg(coord_fd_, &t, &payload)) {
+    if (t == MsgType::kResponses) {
+      std::vector<Entry> entries;
+      if (ParseEntries(payload, &entries)) DeliverEntries(entries);
+    } else if (t == MsgType::kShutdown) {
+      clean = true;
+      break;
+    }
+  }
+  if (!shutdown_.load()) {
+    bool joined;
+    {
+      std::lock_guard<std::mutex> lk(ready_mu_);
+      joined = all_joined_last_rank_ >= 0;
+    }
+    if (!clean && !joined) {
+      HVD_LOG(kWarning, "controller connection lost");
+      ok_ = false;
+      last_error_ = "controller connection lost";
+    }
+    // Either way the control plane is gone: stop the core so
+    // NextBatch() returns shutdown and pending ops fail fast instead
+    // of hanging in synchronize().
+    Abort();
+  }
+}
+
+}  // namespace hvdtpu

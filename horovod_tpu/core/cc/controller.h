@@ -1,0 +1,153 @@
+// Negotiated-cycle controller: the native control plane.
+//
+// TPU-native re-design of the reference's background-thread core
+// (reference: horovod/common/operations.cc BackgroundThreadLoop /
+// RunLoopOnce; horovod/common/controller.cc Controller::
+// ComputeResponseList / FuseResponses; horovod/common/tensor_queue.cc;
+// horovod/common/stall_inspector.cc; horovod/common/response_cache.cc).
+//
+// What it does: every cycle (HOROVOD_CYCLE_TIME ms) each rank drains
+// its pending-tensor queue and reports readiness to the rank-0
+// coordinator over persistent TCP (wire.h). The coordinator counts
+// readiness per tensor name, validates signature consistency across
+// ranks (mismatch -> clean error entry, not a hang), greedily fuses
+// fully-ready tensors with equal fuse-keys into batches up to the
+// fusion threshold, and broadcasts one ordered entry list — identical
+// on every rank, which is the whole point (SPMD programs must launch
+// in an agreed order). Execution of the batches (the data plane) is
+// NOT here: Python pulls agreed batches via NextBatch() and launches
+// the fused XLA collectives.
+//
+// Deliberate departures from the reference:
+//  * No MPI/gloo: transport is plain sockets; bootstrap address comes
+//    from the launcher (HOROVOD_CONTROL_ADDR).
+//  * No FlatBuffers: dependency-free length-prefixed binary format.
+//  * No response cache: signature consistency is checked within each
+//    negotiation round, and re-submitting a name with new metadata
+//    (dynamic loss-scale factors) renegotiates cleanly. The
+//    reference's bit-vector cache is a bandwidth optimization that is
+//    unnecessary at our control-plane message sizes (a few KB/cycle).
+#pragma once
+
+#include <condition_variable>
+#include <cstdint>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "common.h"
+#include "wire.h"
+
+namespace hvdtpu {
+
+struct ControllerOptions {
+  int rank = 0;
+  int size = 1;
+  std::string coord_host = "127.0.0.1";
+  int coord_port = 0;            // 0 with size==1 -> no sockets
+  int64_t fusion_threshold = 64 << 20;
+  double cycle_time_ms = 1.0;
+  double stall_warn_s = 60.0;
+  double stall_kill_s = 0.0;     // 0 = never
+  double connect_timeout_s = 30.0;
+};
+
+// Sentinel entry name broadcast when every rank has joined
+// (reference: JoinOp completion).
+extern const char kAllJoined[];
+
+class Controller {
+ public:
+  explicit Controller(const ControllerOptions& opts);
+  ~Controller();
+
+  // Frontend (any thread): announce a pending tensor. sig encodes
+  // "dtype|op|shape..." and doubles as the fuse key prefix
+  // (everything before the first '#').
+  void Submit(const std::string& name, const std::string& sig,
+              int64_t nbytes);
+  // Announce this rank is done submitting (reference: hvd.join()).
+  void Join();
+
+  // Worker thread: block up to timeout_s for the next agreed batch.
+  // Returns false on shutdown; *error is set per-entry.
+  bool NextBatch(double timeout_s, std::vector<Entry>* out);
+
+  // -1 until the coordinator reports all ranks joined; then the rank
+  // that joined last (the hvd.join() return value in the reference).
+  int AllJoined();
+
+  void Shutdown();
+  bool ok() const { return ok_; }
+  const std::string& last_error() const { return last_error_; }
+  int64_t cycles() const { return cycles_; }
+
+ private:
+  void CycleLoop();
+  // Set shutdown + wake everything WITHOUT joining threads — safe to
+  // call from the controller's own threads (Shutdown() joins and must
+  // only run on an external thread).
+  void Abort();
+  void CoordinatorIngest(int rank, std::vector<Request> reqs);
+  void RunCoordinatorCycle();
+  void BroadcastEntries(const std::vector<Entry>& entries);
+  void DeliverEntries(const std::vector<Entry>& entries);
+  void ServerAcceptLoop();
+  void ReaderLoop(int rank, int fd);
+  void WorkerReaderLoop();
+  void CheckStalls(double now);
+
+  ControllerOptions opts_;
+  std::atomic<bool> shutdown_{false};
+  bool ok_ = true;
+  std::string last_error_;
+  std::atomic<int64_t> cycles_{0};
+
+  // --- frontend pending queue (reference: TensorQueue) ---
+  std::mutex submit_mu_;
+  std::vector<Request> pending_;
+
+  // --- agreed batches awaiting execution ---
+  std::mutex ready_mu_;
+  std::condition_variable ready_cv_;
+  std::deque<Entry> ready_;
+  int all_joined_last_rank_ = -1;
+
+  // --- coordinator state (rank 0 only) ---
+  struct TensorState {
+    std::string sig;
+    int64_t nbytes = 0;
+    std::set<int> ready_ranks;
+    double first_seen = 0.0;
+    double fully_ready_at = 0.0;
+    bool error_sent = false;
+    std::string error;
+  };
+  std::mutex coord_mu_;
+  std::map<std::string, TensorState> tensors_;  // pending negotiation
+  std::vector<std::string> ready_order_;        // fully-ready FIFO
+  std::set<int> joined_ranks_;
+  int last_joined_rank_ = -1;
+  bool join_announced_ = false;
+  int32_t next_batch_id_ = 1;
+  int64_t stall_warned_gen_ = 0;
+
+  // --- sockets ---
+  int listen_fd_ = -1;
+  int coord_fd_ = -1;                 // worker->coordinator connection
+  std::vector<int> worker_fds_;       // coordinator: fd per rank (idx)
+  std::mutex send_mu_;                // serialize writes to workers
+
+  std::vector<std::thread> threads_;
+  // Per-connection reader threads, spawned by the accept loop while
+  // Shutdown may run concurrently — guarded separately.
+  std::mutex reader_threads_mu_;
+  std::vector<std::thread> reader_threads_;
+};
+
+}  // namespace hvdtpu

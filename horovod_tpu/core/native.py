@@ -1,21 +1,28 @@
-"""ctypes loader for the native (C++) control-plane core.
+"""ctypes loader + typed wrapper for the native (C++) control-plane
+core (core/cc/libhvdtpu_core.so).
 
-The C++ core (core/cc/) provides the tensor queue, negotiation
-controller, fusion planner, KV-store client/server and timeline writer
-— the TPU-native equivalents of the reference's horovod/common/ C++
-core. Built as libhvdtpu_core.so via core/cc/Makefile; this module
-loads it and exposes a thin API. Falls back gracefully (available() ==
-False) when not built, in which case the pure-python control plane in
-ops/controller.py is used (HOROVOD_CONTROLLER=python).
+The C++ core provides the tensor queue, rank-0 negotiation
+coordinator over TCP, fusion planner, response cache and stall
+inspector — the TPU-native equivalents of the reference's
+horovod/common/ C++ core (reference: operations.cc, controller.cc,
+tensor_queue.cc, fusion_buffer_manager.cc, response_cache.cc,
+stall_inspector.cc). Falls back gracefully (available() == False)
+when not built; the pure-python control plane in ops/controller.py
+then drives the same protocol in-process (HOROVOD_CONTROLLER=python).
 """
 
 from __future__ import annotations
 
 import ctypes
 import os
+import subprocess
+from typing import List, Optional
 
 _lib = None
 _tried = False
+
+ENTRY_SEP = b"\x1e"
+FIELD_SEP = b"\x1f"
 
 
 def _lib_path() -> str:
@@ -23,15 +30,155 @@ def _lib_path() -> str:
                         "libhvdtpu_core.so")
 
 
+def build(quiet: bool = True) -> bool:
+    """Build the core in-tree (make) if a toolchain is present.
+
+    Serialized across processes with an exclusive file lock: N local
+    ranks initializing concurrently must not race `make` into the
+    same .so (a rank could dlopen a half-written file)."""
+    import fcntl
+    ccdir = os.path.join(os.path.dirname(__file__), "cc")
+    lock_path = os.path.join(ccdir, ".build.lock")
+    try:
+        with open(lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            try:
+                if os.path.exists(_lib_path()):
+                    return True  # another rank built it while we waited
+                r = subprocess.run(["make", "-C", ccdir],
+                                   capture_output=quiet, timeout=300)
+                return r.returncode == 0
+            finally:
+                fcntl.flock(lock, fcntl.LOCK_UN)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
 def load():
     global _lib, _tried
     if _lib is None and not _tried:
         _tried = True
         path = _lib_path()
+        if not os.path.exists(path):
+            build()
         if os.path.exists(path):
-            _lib = ctypes.CDLL(path)
+            lib = ctypes.CDLL(path)
+            lib.hvd_core_create.restype = ctypes.c_void_p
+            lib.hvd_core_create.argtypes = [
+                ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+                ctypes.c_int, ctypes.c_longlong, ctypes.c_double,
+                ctypes.c_double, ctypes.c_double, ctypes.c_double]
+            lib.hvd_core_destroy.argtypes = [ctypes.c_void_p]
+            lib.hvd_core_ok.argtypes = [ctypes.c_void_p]
+            lib.hvd_core_ok.restype = ctypes.c_int
+            lib.hvd_core_last_error.argtypes = [ctypes.c_void_p]
+            lib.hvd_core_last_error.restype = ctypes.c_char_p
+            lib.hvd_core_submit.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+                ctypes.c_longlong]
+            lib.hvd_core_join.argtypes = [ctypes.c_void_p]
+            lib.hvd_core_all_joined.argtypes = [ctypes.c_void_p]
+            lib.hvd_core_all_joined.restype = ctypes.c_int
+            lib.hvd_core_cycles.argtypes = [ctypes.c_void_p]
+            lib.hvd_core_cycles.restype = ctypes.c_longlong
+            lib.hvd_core_next_batch.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong,
+                ctypes.c_double]
+            lib.hvd_core_next_batch.restype = ctypes.c_longlong
+            lib.hvd_core_shutdown.argtypes = [ctypes.c_void_p]
+            _lib = lib
     return _lib
 
 
 def available() -> bool:
     return load() is not None
+
+
+class BatchEntry:
+    __slots__ = ("name", "sig", "active_ranks", "error")
+
+    def __init__(self, name: str, sig: str, active_ranks: int,
+                 error: str):
+        self.name = name
+        self.sig = sig
+        self.active_ranks = active_ranks
+        self.error = error
+
+    def __repr__(self):
+        return (f"BatchEntry({self.name}, {self.sig}, "
+                f"act={self.active_ranks}, err={self.error!r})")
+
+
+class NativeCore:
+    """One negotiation controller instance (reference: the per-process
+    HorovodGlobalState + background thread)."""
+
+    BUF_SIZE = 1 << 20
+
+    def __init__(self, rank: int, size: int, coord_host: str,
+                 coord_port: int, fusion_threshold: int,
+                 cycle_time_ms: float, stall_warn_s: float,
+                 stall_kill_s: float, connect_timeout_s: float = 30.0):
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native core not built")
+        self._lib = lib
+        self._h = lib.hvd_core_create(
+            rank, size, coord_host.encode(), coord_port,
+            fusion_threshold, cycle_time_ms, stall_warn_s,
+            stall_kill_s, connect_timeout_s)
+        self._buf = ctypes.create_string_buffer(self.BUF_SIZE)
+        if not lib.hvd_core_ok(self._h):
+            err = lib.hvd_core_last_error(self._h).decode()
+            lib.hvd_core_destroy(self._h)
+            self._h = None
+            raise RuntimeError(f"native core init failed: {err}")
+
+    def submit(self, name: str, sig: str, nbytes: int) -> None:
+        self._lib.hvd_core_submit(self._h, name.encode(), sig.encode(),
+                                  nbytes)
+
+    def join(self) -> None:
+        self._lib.hvd_core_join(self._h)
+
+    def all_joined(self) -> int:
+        """-1 until all ranks joined, else the last-joining rank."""
+        return self._lib.hvd_core_all_joined(self._h)
+
+    def cycles(self) -> int:
+        return self._lib.hvd_core_cycles(self._h)
+
+    def next_batch(self, timeout_s: float
+                   ) -> Optional[List[BatchEntry]]:
+        """None on shutdown; [] on timeout; else one agreed batch."""
+        n = self._lib.hvd_core_next_batch(self._h, self._buf,
+                                          self.BUF_SIZE, timeout_s)
+        if n == -1:
+            return None
+        if n == -2:
+            raise RuntimeError("native core batch exceeded buffer")
+        if n == 0:
+            return []
+        raw = self._buf.raw[:n]
+        out = []
+        for part in raw.split(ENTRY_SEP):
+            name, sig, act, err = part.split(FIELD_SEP, 3)
+            out.append(BatchEntry(name.decode(), sig.decode(),
+                                  int(act.decode() or -1),
+                                  err.decode()))
+        return out
+
+    def shutdown(self) -> None:
+        if self._h is not None:
+            self._lib.hvd_core_shutdown(self._h)
+
+    def destroy(self) -> None:
+        if self._h is not None:
+            self._lib.hvd_core_destroy(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover - best effort
+        try:
+            self.destroy()
+        except Exception:
+            pass

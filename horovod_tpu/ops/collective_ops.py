@@ -11,7 +11,8 @@ with jax.Arrays in place of torch tensors. Handles are integers, and
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Union
+import threading
+from typing import Any, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
@@ -59,6 +60,14 @@ def _nbytes(tensors) -> int:
                    for t in tensors))
 
 
+def _run(st, name: str, nbytes: int, fn) -> int:
+    """Route an op through the negotiated controller when active (the
+    agreed-order path), else dispatch inline via the engine."""
+    if st.engine.controller is not None:
+        return st.engine.controller.submit_generic(name, nbytes, fn).id
+    return st.engine.run(name, nbytes, fn).id
+
+
 def _check_inexact_for_average(op: int, tensors) -> None:
     if op == AVERAGE:
         for t in tensors:
@@ -84,6 +93,20 @@ def grouped_allreduce_async(tensors: List[jax.Array], average=None,
     _check_inexact_for_average(rop, tensors)
     name = name or st.engine.auto_name("grouped_allreduce")
 
+    if st.engine.controller is not None:
+        # Same-dtype negotiation units (mixed-dtype groups split, as
+        # the reference controller only fuses same-dtype responses).
+        wires = [jnp.asarray(t) for t in tensors]
+        if len({str(w.dtype) for w in wires}) == 1:
+            return st.engine.controller.submit_allreduce(
+                name, wires, pset, rop, prescale_factor,
+                postscale_factor, compression, grouped=True).id
+        # mixed dtypes: one grouped submission per dtype bucket,
+        # synchronized under one umbrella handle.
+        return _controller_mixed_group(
+            st, name, wires, pset, rop, prescale_factor,
+            postscale_factor, compression)
+
     comp = [compression.compress(t) for t in tensors]
     wire = [c[0] for c in comp]
     ctxs = [c[1] for c in comp]
@@ -95,6 +118,35 @@ def grouped_allreduce_async(tensors: List[jax.Array], average=None,
 
     h = st.engine.run(name, _nbytes(wire), fn)
     return h.id
+
+
+def _controller_mixed_group(st, name, wires, pset, rop, prescale,
+                            postscale, compression) -> int:
+    by_dtype: dict = {}
+    for i, w in enumerate(wires):
+        by_dtype.setdefault(str(w.dtype), []).append(i)
+    subs = []
+    for dt, idxs in by_dtype.items():
+        h = st.engine.controller.submit_allreduce(
+            f"{name}.{dt}", [wires[i] for i in idxs], pset, rop,
+            prescale, postscale, compression, grouped=True)
+        subs.append((h, idxs))
+    umbrella = st.engine.new_handle(name)
+
+    def waiter():
+        out: List[Any] = [None] * len(wires)
+        try:
+            for h, idxs in subs:
+                res = st.engine.synchronize(h)
+                res = res if isinstance(res, list) else [res]
+                for i, r in zip(idxs, res):
+                    out[i] = r
+            umbrella.set_result(out)
+        except BaseException as e:
+            umbrella.set_error(e)
+
+    threading.Thread(target=waiter, daemon=True).start()
+    return umbrella.id
 
 
 def _grouped_by_dtype(tensors, pset, rop, prescale, postscale):
@@ -129,6 +181,10 @@ def allreduce_async(tensor, average=None, name=None, op=None,
     pset = _pset(process_set)
     rop = _resolve_op(op, average)
     _check_inexact_for_average(rop, [tensor])
+    if st.engine.controller is not None:
+        return st.engine.controller.submit_allreduce(
+            name, [tensor], pset, rop, prescale_factor,
+            postscale_factor, compression).id
     wire, ctx = compression.compress(tensor)
 
     def fn():
@@ -172,8 +228,7 @@ def allgather_async(tensor, name: Optional[str] = None,
         sizes = dispatch.exchange_int_vector([t.shape[0]], pset)[:, 0]
         return dispatch.allgather(t, pset, [int(s) for s in sizes])
 
-    h = st.engine.run(name, _nbytes([t]), fn)
-    return h.id
+    return _run(st, name, _nbytes([t]), fn)
 
 
 def allgather(tensor, name=None, process_set=None) -> jax.Array:
@@ -198,8 +253,7 @@ def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
     def fn():
         return dispatch.broadcast(t, set_root, pset)
 
-    h = st.engine.run(name, _nbytes([t]), fn)
-    return h.id
+    return _run(st, name, _nbytes([t]), fn)
 
 
 def broadcast(tensor, root_rank: int, name=None,
@@ -242,8 +296,7 @@ def alltoall_async(tensor, splits: Optional[Sequence[int]] = None,
         out = dispatch.alltoall(t, splits, recv, pset, maxsplit=maxsplit)
         return out, jnp.asarray(recv, jnp.int32)
 
-    h = st.engine.run(name, _nbytes([t]), fn)
-    return h.id
+    return _run(st, name, _nbytes([t]), fn)
 
 
 def alltoall(tensor, splits=None, name=None, process_set=None):
@@ -275,8 +328,7 @@ def reducescatter_async(tensor, op=None, name: Optional[str] = None,
         return dispatch.reducescatter(t, pset, rop, prescale_factor,
                                       postscale_factor)
 
-    h = st.engine.run(name, _nbytes([t]), fn)
-    return h.id
+    return _run(st, name, _nbytes([t]), fn)
 
 
 def reducescatter(tensor, op=None, name=None, prescale_factor=1.0,
@@ -291,22 +343,29 @@ def reducescatter(tensor, op=None, name=None, prescale_factor=1.0,
 # ---------------------------------------------------------------------------
 
 def barrier(process_set: Optional[ProcessSet] = None) -> None:
-    dispatch.barrier(_pset(process_set))
+    st = _require_init()
+    pset = _pset(process_set)
+    if st.engine.controller is not None:
+        name = st.engine.auto_name("barrier")
+        h = st.engine.controller.submit_generic(
+            name, 4, lambda: dispatch.barrier(pset))
+        synchronize(h.id)
+        return
+    dispatch.barrier(pset)
 
 
 def join(device: int = -1) -> int:
-    """Mark this rank as done; requires the negotiated controller
-    (reference: horovod/common/ops/collective_operations.cc JoinOp).
-    Implemented by ops/controller.py when the eager cycle engine is
-    active; raises otherwise because uncoordinated inline dispatch
-    cannot know about ops it did not submit."""
+    """Mark this rank as done submitting; blocks until every rank has
+    joined and returns the last rank to join (reference:
+    horovod/common/ops/collective_operations.cc JoinOp). Requires the
+    negotiated controller (active by default when size > 1, or with
+    HOROVOD_CONTROLLER=native/python)."""
     st = _require_init()
     if st.engine.controller is None:
         raise NotImplementedError(
-            "hvd.join() is not available yet: it needs the negotiated "
-            "cycle controller (ops/controller.py), which is not active "
-            "in this build — inline dispatch cannot participate in ops "
-            "submitted only by other ranks")
+            "hvd.join() needs the negotiated controller: run multi-"
+            "process (it is on by default) or set "
+            "HOROVOD_CONTROLLER=native")
     return st.engine.controller.join()
 
 

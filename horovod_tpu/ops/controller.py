@@ -1,0 +1,397 @@
+"""Negotiated-cycle controller driver: routes eager collectives
+through the control plane so ranks may submit in ANY order.
+
+This is the worker-side half of the reference's background-thread
+design (reference: horovod/common/operations.cc PerformOperation +
+horovod/torch/mpi_ops.py async handles): the C++ core (core/cc/)
+negotiates an identical ordered batch list on every rank; a single
+worker thread here owns ALL collective dispatch (the reference's
+single-background-thread ownership model, SURVEY.md §5.2) and
+launches one fused XLA program per agreed batch. Python never decides
+order — the core does — which is what relaxes JAX's same-program-order
+requirement to Horovod's "submit whenever ready" contract.
+
+Signature format (the Request metadata; reference: message.fbs):
+  allreduce:  "ar|<wiredtype>|<op>|<pset>|<pre>|<post>#s0xs1,...;..."
+  generic:    "g|<name>#"        (never fuses with anything else)
+The part before '#' is the fuse key; the coordinator only packs
+same-key tensors into one batch (same dtype/op/process-set/scales —
+the reference controller's FuseResponses rule).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..common import logging as hlog
+from ..core import native
+from . import dispatch
+from .dispatch import ADASUM, AVERAGE, SUM
+
+
+class JoinError(RuntimeError):
+    pass
+
+
+def allreduce_sig(wire_tensors, rop: int, pset_id: int, prescale: float,
+                  postscale: float) -> str:
+    dt = str(wire_tensors[0].dtype)
+    shapes = ";".join(
+        "x".join(str(d) for d in t.shape) for t in wire_tensors)
+    return f"ar|{dt}|{rop}|{pset_id}|{prescale}|{postscale}#{shapes}"
+
+
+def parse_allreduce_sig(sig: str):
+    head, shapes = sig.split("#", 1)
+    _, dt, rop, pset_id, pre, post = head.split("|")
+    shape_list = []
+    for s in shapes.split(";"):
+        shape_list.append(tuple(int(d) for d in s.split("x") if d))
+    return dt, int(rop), int(pset_id), float(pre), float(post), shape_list
+
+
+class _PendingAllreduce:
+    __slots__ = ("wire", "ctxs", "compression", "pset", "rop",
+                 "prescale", "postscale", "handle", "grouped")
+
+    def __init__(self, wire, ctxs, compression, pset, rop, prescale,
+                 postscale, handle, grouped):
+        self.wire = wire
+        self.ctxs = ctxs
+        self.compression = compression
+        self.pset = pset
+        self.rop = rop
+        self.prescale = prescale
+        self.postscale = postscale
+        self.handle = handle
+        self.grouped = grouped
+
+
+class _PendingGeneric:
+    __slots__ = ("fn", "handle")
+
+    def __init__(self, fn, handle):
+        self.fn = fn
+        self.handle = handle
+
+
+class PythonCore:
+    """In-process stand-in for the native core: same submit/next_batch
+    protocol, single-process only (reference analog: running with one
+    rank, where negotiation degenerates to local FIFO + fusion)."""
+
+    def __init__(self, fusion_threshold: int):
+        self.fusion_threshold = fusion_threshold
+        self._mu = threading.Lock()
+        self._cv = threading.Condition(self._mu)
+        self._pending: List[native.BatchEntry] = []
+        self._joined = False
+        self._shutdown = False
+        self._cycles = 0
+
+    def submit(self, name: str, sig: str, nbytes: int) -> None:
+        with self._cv:
+            self._pending.append(
+                (native.BatchEntry(name, sig, 1, ""), nbytes))
+            self._cv.notify_all()
+
+    def join(self) -> None:
+        with self._cv:
+            self._joined = True
+            self._cv.notify_all()
+
+    def all_joined(self) -> int:
+        with self._mu:
+            return 0 if self._joined else -1
+
+    def cycles(self) -> int:
+        return self._cycles
+
+    def next_batch(self, timeout_s: float):
+        with self._cv:
+            self._cv.wait_for(
+                lambda: self._pending or self._shutdown,
+                timeout=timeout_s)
+            if self._shutdown and not self._pending:
+                return None
+            if not self._pending:
+                return []
+            self._cycles += 1
+            # greedy same-key fusion from the front (mirrors the C++
+            # coordinator's FuseResponses loop)
+            first, _ = self._pending[0]
+            key = first.sig.split("#", 1)[0]
+            batch, total = [], 0
+            while self._pending:
+                e, nb = self._pending[0]
+                if e.sig.split("#", 1)[0] != key:
+                    break
+                if total > 0 and total + nb > self.fusion_threshold:
+                    break
+                batch.append(e)
+                total += nb
+                self._pending.pop(0)
+            return batch
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._shutdown = True
+            self._cv.notify_all()
+
+    def destroy(self) -> None:
+        pass
+
+
+class NegotiatedController:
+    """Owns the pending-op registry + the single dispatch worker."""
+
+    def __init__(self, cfg, topology, engine,
+                 core: Optional[Any] = None):
+        self.cfg = cfg
+        self.topology = topology
+        self.engine = engine
+        self._pending: Dict[str, Any] = {}
+        self._mu = threading.Lock()
+        self._joined = False
+        self._join_event = threading.Event()
+        self._join_result = -1
+        self._error: Optional[BaseException] = None
+
+        use_native = (topology.size > 1 or cfg.controller == "native") \
+            and native.available()
+        if core is not None:
+            self.core = core
+        elif use_native:
+            if topology.size > 1:
+                host, port = self._control_endpoint(cfg)
+            else:
+                host, port = "127.0.0.1", 0  # size 1: no sockets
+            self.core = native.NativeCore(
+                rank=topology.rank, size=topology.size,
+                coord_host=host, coord_port=port,
+                fusion_threshold=cfg.fusion_threshold,
+                cycle_time_ms=cfg.cycle_time_ms,
+                stall_warn_s=(0.0 if cfg.stall_check_disable
+                              else cfg.stall_check_time),
+                stall_kill_s=cfg.stall_shutdown_time,
+                connect_timeout_s=cfg.start_timeout)
+        elif topology.size == 1:
+            self.core = PythonCore(cfg.fusion_threshold)
+        else:
+            raise RuntimeError(
+                "multi-process negotiation requires the native core "
+                "(build horovod_tpu/core/cc with `make`)")
+
+        self._worker = threading.Thread(
+            target=self._worker_loop, name="hvdtpu-controller",
+            daemon=True)
+        self._worker.start()
+
+    @staticmethod
+    def _control_endpoint(cfg):
+        if cfg.control_addr:
+            host, port = cfg.control_addr.rsplit(":", 1)
+            return host, int(port)
+        if not cfg.coordinator_addr:
+            raise RuntimeError(
+                "negotiated controller needs HOROVOD_CONTROL_ADDR or "
+                "HOROVOD_COORDINATOR_ADDR (set by the launcher)")
+        host, port = cfg.coordinator_addr.rsplit(":", 1)
+        return host, int(port) + 1
+
+    # ------------------------------------------------------------------
+    # submission (any thread)
+    # ------------------------------------------------------------------
+
+    def submit_allreduce(self, name: str, tensors: List[Any], pset,
+                         rop: int, prescale: float, postscale: float,
+                         compression, grouped: bool = False) -> Any:
+        h = self.engine.new_handle(name)
+        comp = [compression.compress(jnp.asarray(t)) for t in tensors]
+        wire = [c[0] for c in comp]
+        ctxs = [c[1] for c in comp]
+        sig = allreduce_sig(wire, rop, pset.process_set_id, prescale,
+                            postscale)
+        nbytes = int(sum(np.prod(t.shape) * jnp.dtype(t.dtype).itemsize
+                         for t in wire))
+        with self._mu:
+            if name in self._pending:
+                h.set_error(ValueError(
+                    f"a collective named '{name}' is already pending "
+                    "(names must be unique among in-flight ops, as in "
+                    "the reference)"))
+                return h
+            self._pending[name] = _PendingAllreduce(
+                wire, ctxs, compression, pset, rop, prescale,
+                postscale, h, grouped)
+        if self.engine.timeline is not None:
+            self.engine.timeline.enqueue(name)
+        self.core.submit(name, sig, nbytes)
+        return h
+
+    def submit_generic(self, name: str, nbytes: int,
+                       fn: Callable[[], Any]) -> Any:
+        h = self.engine.new_handle(name)
+        with self._mu:
+            if name in self._pending:
+                h.set_error(ValueError(
+                    f"a collective named '{name}' is already pending"))
+                return h
+            self._pending[name] = _PendingGeneric(fn, h)
+        if self.engine.timeline is not None:
+            self.engine.timeline.enqueue(name)
+        self.core.submit(name, f"g|{name}#", nbytes)
+        return h
+
+    def join(self, timeout_s: Optional[float] = None) -> int:
+        """Declare this rank done (reference: hvd.join()); blocks until
+        every rank joined; returns the last rank to join."""
+        with self._mu:
+            self._joined = True
+        self.core.join()
+        if not self._join_event.wait(timeout_s):
+            raise TimeoutError("hvd.join() timed out")
+        return self._join_result
+
+    # ------------------------------------------------------------------
+    # worker (the single dispatching thread)
+    # ------------------------------------------------------------------
+
+    def _worker_loop(self):
+        try:
+            while True:
+                batch = self.core.next_batch(0.05)
+                if batch is None:
+                    # control plane gone (clean shutdown or lost
+                    # coordinator): fail anything still pending so
+                    # synchronize() raises instead of hanging.
+                    self._fail_pending(RuntimeError(
+                        "collective cannot complete: the controller "
+                        "shut down"))
+                    break
+                if batch:
+                    self._execute(batch)
+                if not self._join_event.is_set():
+                    lastrank = self.core.all_joined()
+                    if lastrank >= 0:
+                        self._join_result = lastrank
+                        self._join_event.set()
+        except BaseException as e:  # pragma: no cover - defensive
+            hlog.error("controller worker died: %s", e)
+            self._error = e
+            self._fail_pending(e)
+            self._join_event.set()
+
+    def _fail_pending(self, err: BaseException) -> None:
+        with self._mu:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for p in pending:
+            p.handle.set_error(err)
+
+    def _execute(self, batch):
+        # error entries: deliver and drop (all ranks got the same ones)
+        live = []
+        for e in batch:
+            if e.error:
+                with self._mu:
+                    p = self._pending.pop(e.name, None)
+                if p is not None:
+                    p.handle.set_error(RuntimeError(e.error))
+                continue
+            live.append(e)
+        if not live:
+            return
+        kind = live[0].sig.split("|", 1)[0]
+        if kind == "ar":
+            self._execute_allreduce_batch(live)
+        else:
+            self._execute_generic(live)
+
+    def _execute_generic(self, entries):
+        for e in entries:
+            with self._mu:
+                p = self._pending.pop(e.name, None)
+            if p is None:
+                # another rank submitted a generic op this (joined)
+                # rank never will: unfabricatable -> error locally.
+                hlog.error("agreed op '%s' was never submitted here",
+                           e.name)
+                continue
+            if self.engine.timeline is not None:
+                self.engine.timeline.dispatched(e.name)
+            try:
+                p.handle.set_result(p.fn())
+            except BaseException as ex:
+                p.handle.set_error(ex)
+
+    def _execute_allreduce_batch(self, entries):
+        """One fused launch for the whole agreed batch (the fusion
+        buffer analog: same fuse key == same dtype/op/pset/scales)."""
+        dt, rop, pset_id, pre, post, _ = parse_allreduce_sig(
+            entries[0].sig)
+        pset = self.engine.pset_table.get(pset_id)
+        active = entries[0].active_ranks
+
+        tensors = []
+        slots = []   # (entry, pending|None, count)
+        for e in entries:
+            with self._mu:
+                p = self._pending.pop(e.name, None)
+            if p is None:
+                # joined rank: participate with zeros of the agreed
+                # shapes (reference: JoinOp zero contribution).
+                _, _, _, _, _, shapes = parse_allreduce_sig(e.sig)
+                zeros = [jnp.zeros(s, dt) for s in shapes]
+                tensors.extend(zeros)
+                slots.append((e, None, len(zeros)))
+            else:
+                tensors.extend(p.wire)
+                slots.append((e, p, len(p.wire)))
+            if self.engine.timeline is not None:
+                self.engine.timeline.dispatched(e.name)
+
+        eff_op, eff_post = rop, post
+        if rop == AVERAGE:
+            # Join-aware average (reference: Join + Average divides by
+            # the contributing ranks). active_ranks is WORLD-level, so
+            # it only applies to the global set; a subset process set
+            # always divides by its own size (join is a global-set
+            # concept, as in the reference).
+            divisor = (active if pset.size == self.topology.size
+                       else pset.size)
+            eff_op, eff_post = SUM, post / max(divisor, 1)
+        try:
+            if rop == ADASUM:
+                from .adasum import adasum_allreduce
+                outs = adasum_allreduce(tensors, pset, pre, post)
+            else:
+                outs = dispatch.allreduce_group(tensors, pset, eff_op,
+                                                pre, eff_post)
+        except BaseException as ex:
+            for e, p, cnt in slots:
+                if p is not None:
+                    p.handle.set_error(ex)
+            return
+        i = 0
+        for e, p, cnt in slots:
+            outs_i = outs[i:i + cnt]
+            i += cnt
+            if p is None:
+                continue
+            res = [p.compression.decompress(o, c)
+                   for o, c in zip(outs_i, p.ctxs)]
+            p.handle.set_result(res if p.grouped else res[0])
+
+    def shutdown(self):
+        self.core.shutdown()
+        self._worker.join(timeout=10)
+        self.core.destroy()
+        with self._mu:
+            for p in self._pending.values():
+                p.handle.set_error(RuntimeError("shutdown"))
+            self._pending.clear()

@@ -94,6 +94,10 @@ def run(command: List[str], np_: int = 1, hosts: Optional[str] = None,
     rank0 = infos[0]
     coord_host = "localhost" if rank0.is_local else rank0.host
     coordinator = f"{coord_host}:{free_port()}"
+    # Second probed port for the native control plane (it must not
+    # guess coordinator_port+1, which was never checked for
+    # availability).
+    control = f"{coord_host}:{free_port()}"
 
     procs: List[subprocess.Popen] = []
     pumps: List[threading.Thread] = []
@@ -103,6 +107,7 @@ def run(command: List[str], np_: int = 1, hosts: Optional[str] = None,
     try:
         for info in infos:
             child_env = build_env(info, coordinator, env)
+            child_env["HOROVOD_CONTROL_ADDR"] = control
             child_env["HOROVOD_START_TIMEOUT"] = str(start_timeout)
             if info.is_local:
                 cmd = command

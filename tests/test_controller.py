@@ -1,0 +1,174 @@
+"""Negotiated-controller tests: single-process native/python cores
+in-proc, plus real multi-process negotiation via the launcher
+(reference: the horovodrun-under-pytest strategy, SURVEY.md §4)."""
+
+import os
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(params=["native", "python"])
+def hvd_ctrl(request):
+    """hvd initialized single-process with a forced controller."""
+    import horovod_tpu as hvd
+    from horovod_tpu.core import native
+    if request.param == "native" and not native.available():
+        pytest.skip("native core not built")
+    hvd.init(config_overrides={"HOROVOD_CONTROLLER": request.param})
+    yield hvd
+    hvd.shutdown()
+
+
+class TestControllerSingleProcess:
+    def test_controller_active(self, hvd_ctrl):
+        from horovod_tpu.common.basics import state
+        assert state().engine.controller is not None
+
+    def test_allreduce_roundtrip(self, hvd_ctrl):
+        out = hvd_ctrl.allreduce(jnp.arange(6.0), name="c0")
+        np.testing.assert_allclose(np.asarray(out), np.arange(6.0))
+
+    def test_grouped_keeps_list(self, hvd_ctrl):
+        outs = hvd_ctrl.grouped_allreduce([jnp.ones(3)], name="c1")
+        assert isinstance(outs, list) and len(outs) == 1
+
+    def test_mixed_dtype_group(self, hvd_ctrl):
+        outs = hvd_ctrl.grouped_allreduce(
+            [jnp.ones(3, jnp.float32), jnp.ones(2, jnp.float64),
+             jnp.ones(4, jnp.float32)],
+            op=hvd_ctrl.Sum, name="c2")
+        assert [o.dtype for o in outs] == [jnp.float32, jnp.float64,
+                                           jnp.float32]
+        for o in outs:
+            np.testing.assert_allclose(np.asarray(o), 1.0)
+
+    def test_generic_ops_via_controller(self, hvd_ctrl):
+        out = hvd_ctrl.broadcast(jnp.arange(4.0), root_rank=0,
+                                 name="c3")
+        np.testing.assert_allclose(np.asarray(out), np.arange(4.0))
+        out = hvd_ctrl.allgather(jnp.ones((2, 2)), name="c4")
+        assert out.shape == (2, 2)
+        hvd_ctrl.barrier()
+
+    def test_join_single(self, hvd_ctrl):
+        assert hvd_ctrl.join() == 0
+
+    def test_duplicate_pending_name(self, hvd_ctrl):
+        """Names must be unique among IN-FLIGHT ops: a duplicate while
+        the first is pending errors; once the first completed, the
+        name is free again (so either outcome is a correct run,
+        depending on worker timing)."""
+        h1 = hvd_ctrl.allreduce_async(jnp.ones(2), name="dup")
+        h2 = hvd_ctrl.allreduce_async(jnp.ones(2), name="dup")
+        np.testing.assert_allclose(
+            np.asarray(hvd_ctrl.synchronize(h1)), 1.0)
+        try:
+            out = hvd_ctrl.synchronize(h2)
+            np.testing.assert_allclose(np.asarray(out), 1.0)
+        except ValueError as e:
+            assert "already pending" in str(e)
+
+    def test_compression_roundtrip(self, hvd_ctrl):
+        from horovod_tpu.ops.compression import Compression
+        x = jnp.arange(8.0, dtype=jnp.float32)
+        out = hvd_ctrl.allreduce(x, name="c5",
+                                 compression=Compression.fp16)
+        np.testing.assert_allclose(np.asarray(out), np.arange(8.0),
+                                   rtol=1e-3)
+
+
+class TestNativeCoreUnit:
+    """Drive the C ABI directly (reference: C++ unit coverage of
+    controller.cc)."""
+
+    def setup_method(self, _):
+        from horovod_tpu.core import native
+        if not native.available():
+            pytest.skip("native core not built")
+
+    def make_core(self, **kw):
+        from horovod_tpu.core.native import NativeCore
+        args = dict(rank=0, size=1, coord_host="127.0.0.1",
+                    coord_port=0, fusion_threshold=1 << 20,
+                    cycle_time_ms=1.0, stall_warn_s=0.0,
+                    stall_kill_s=0.0)
+        args.update(kw)
+        return NativeCore(**args)
+
+    def test_fusion_packs_same_key(self):
+        core = self.make_core()
+        for i in range(4):
+            core.submit(f"t{i}", "ar|f32|1|0|1.0|1.0#8", 32)
+        batch = []
+        deadline = 50
+        while len(batch) < 4 and deadline:
+            b = core.next_batch(0.2)
+            assert b is not None
+            batch += b
+            deadline -= 1
+        names = [e.name for e in batch]
+        assert names == ["t0", "t1", "t2", "t3"]
+        core.shutdown()
+        core.destroy()
+
+    def test_fusion_threshold_splits(self):
+        core = self.make_core(fusion_threshold=64)
+        # 3 x 48 bytes: 48+48 > 64 so at most one per batch
+        for i in range(3):
+            core.submit(f"s{i}", "ar|f32|1|0|1.0|1.0#12", 48)
+        batches = []
+        got = 0
+        while got < 3:
+            b = core.next_batch(0.3)
+            assert b is not None
+            if b:
+                batches.append([e.name for e in b])
+                got += len(b)
+        assert all(len(b) == 1 for b in batches), batches
+        core.shutdown()
+        core.destroy()
+
+    def test_key_change_breaks_batch(self):
+        core = self.make_core()
+        core.submit("a", "ar|f32|1|0|1.0|1.0#4", 16)
+        core.submit("b", "ar|f64|1|0|1.0|1.0#4", 32)
+        seen = []
+        while len(seen) < 2:
+            b = core.next_batch(0.3)
+            assert b is not None
+            if b:
+                seen.append([e.name for e in b])
+        assert seen == [["a"], ["b"]]
+        core.shutdown()
+        core.destroy()
+
+    def test_shutdown_unblocks(self):
+        core = self.make_core()
+        core.shutdown()
+        assert core.next_batch(5.0) is None
+        core.destroy()
+
+
+@pytest.mark.integration
+class TestNegotiationMultiProcess:
+    @pytest.mark.parametrize("np_", [2, 4])
+    def test_negotiation(self, np_):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("XLA_FLAGS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        r = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner", "-np",
+             str(np_), sys.executable,
+             os.path.join("tests", "mp_worker_negotiation.py")],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=240)
+        assert r.returncode == 0, r.stdout + "\n" + r.stderr
+        assert r.stdout.count("NEGOTIATION ALL OK") == np_
