@@ -255,6 +255,11 @@ class NegotiatedController:
         self.core.join()
         if not self._join_event.wait(timeout_s):
             raise TimeoutError("hvd.join() timed out")
+        if self._join_result < 0:
+            raise RuntimeError(
+                "hvd.join() aborted: the controller shut down before "
+                "every rank joined"
+                + (f" ({self._error})" if self._error else ""))
         return self._join_result
 
     # ------------------------------------------------------------------
@@ -266,25 +271,33 @@ class NegotiatedController:
             while True:
                 batch = self.core.next_batch(0.05)
                 if batch is None:
-                    # control plane gone (clean shutdown or lost
-                    # coordinator): fail anything still pending so
-                    # synchronize() raises instead of hanging.
+                    # Control plane gone (clean shutdown or lost
+                    # coordinator). The all-joined sentinel may have
+                    # arrived in the same final flush as the shutdown
+                    # — poll it one last time, then fail anything
+                    # still pending and unblock join() waiters so
+                    # nothing hangs.
+                    self._poll_join()
                     self._fail_pending(RuntimeError(
                         "collective cannot complete: the controller "
                         "shut down"))
+                    self._join_event.set()
                     break
                 if batch:
                     self._execute(batch)
-                if not self._join_event.is_set():
-                    lastrank = self.core.all_joined()
-                    if lastrank >= 0:
-                        self._join_result = lastrank
-                        self._join_event.set()
+                self._poll_join()
         except BaseException as e:  # pragma: no cover - defensive
             hlog.error("controller worker died: %s", e)
             self._error = e
             self._fail_pending(e)
             self._join_event.set()
+
+    def _poll_join(self) -> None:
+        if not self._join_event.is_set():
+            lastrank = self.core.all_joined()
+            if lastrank >= 0:
+                self._join_result = lastrank
+                self._join_event.set()
 
     def _fail_pending(self, err: BaseException) -> None:
         with self._mu:
