@@ -150,6 +150,14 @@ def shutdown() -> None:
             except Exception as e:  # pragma: no cover - best effort
                 hlog.debug("jax.distributed.shutdown failed: %s", e)
             _state._owns_distributed = False
+            # Elastic re-init may come back with a DIFFERENT world
+            # size/coordinator: drop the cached PJRT backends so the
+            # next init() rebuilds the device view.
+            try:
+                import jax.extend.backend as _xb
+                _xb.clear_backends()
+            except Exception as e:  # pragma: no cover
+                hlog.debug("clear_backends failed: %s", e)
         _state.initialized = False
         _state.process_set_table = None
         _state.topology = None

@@ -43,10 +43,23 @@ def run(func: Callable) -> Callable:
 
     @functools.wraps(func)
     def wrapper(state, *args, **kwargs):
+        from .worker import register_with_rendezvous
+        register_with_rendezvous()
         notifications.consume()
+        if state.maybe_load_snapshot():
+            hlog.info("elastic: resumed from snapshot")
         reset_limit = int(os.environ.get("HOROVOD_ELASTIC_RESET_LIMIT", 0))
         resets = 0
+        first = True
         while True:
+            # sync() runs at the top of EVERY attempt (reference:
+            # horovod/torch/elastic/__init__.py run) — this is what
+            # folds freshly-added workers into the broadcast: old
+            # ranks arrive here after re-init, new ranks on first
+            # entry, and the rank-0 state wins for everyone.
+            if not first or os.environ.get("HOROVOD_ELASTIC") == "1":
+                state.sync()
+            first = False
             try:
                 return func(state, *args, **kwargs)
             except HorovodInternalError:
@@ -55,14 +68,11 @@ def run(func: Callable) -> Callable:
                 state.restore()
                 _reinitialize()
                 state.on_reset()
-                state.sync()
-            except HostsUpdatedInterrupt as e:
+            except HostsUpdatedInterrupt:
                 hlog.info("elastic: hosts updated — re-initializing")
                 notifications.consume()
                 _reinitialize()
                 state.on_reset()
-                if not e.skip_sync:
-                    state.sync()
             resets += 1
             if reset_limit and resets >= reset_limit:
                 raise RuntimeError(

@@ -29,11 +29,8 @@ class HorovodInternalError(Exception):
 
 
 class HostsUpdatedInterrupt(Exception):
-    """Membership changed gracefully; re-initialize without restore."""
-
-    def __init__(self, skip_sync: bool = False):
-        super().__init__()
-        self.skip_sync = skip_sync
+    """Membership changed gracefully; re-initialize without restore
+    (state.sync() then runs at the top of the next attempt)."""
 
 
 def _to_host(tree: Any) -> Any:
@@ -67,7 +64,12 @@ class State:
         change notification (wired up by elastic/run.py)."""
         from . import notifications
         if notifications.pending():
-            raise HostsUpdatedInterrupt(skip_sync=False)
+            raise HostsUpdatedInterrupt()
+
+    def maybe_load_snapshot(self) -> bool:
+        """Load a persisted snapshot if this state has one (JaxState
+        with snapshot_path). Returns True if loaded."""
+        return False
 
     # subclass responsibilities
     def save(self) -> None:
@@ -118,16 +120,60 @@ class JaxState(ObjectState):
     a dead slice cannot take the snapshot with it.
     """
 
-    def __init__(self, params: Any = None, opt_state: Any = None, **kwargs):
+    def __init__(self, params: Any = None, opt_state: Any = None,
+                 snapshot_path: Optional[str] = None, **kwargs):
         self.params = params
         self.opt_state = opt_state
         self._tree_attrs = ["params", "opt_state"]
+        # Optional durable snapshot: on TPU a hard worker failure kills
+        # the whole gang (the coordination service fatally terminates
+        # survivors), so in-memory commits alone cannot recover from
+        # it. When set, rank 0 persists each commit to disk and a
+        # restarted gang resumes from it (slice-level recovery; the
+        # reference's in-memory model covers only survivor recovery).
+        self._snapshot_path = snapshot_path
+        # Writes stay disarmed until maybe_load_snapshot() ran —
+        # otherwise the initial save() during construction would
+        # clobber the very snapshot a restarted gang needs to load.
+        self._snapshot_armed = False
         super().__init__(**kwargs)
 
     def save(self) -> None:
         super().save()
         self._tree_saved = {k: _to_host(getattr(self, k))
                             for k in self._tree_attrs}
+        if self._snapshot_path and self._snapshot_armed:
+            self._write_snapshot()
+
+    def _write_snapshot(self) -> None:
+        import horovod_tpu as hvd
+        if hvd.is_initialized() and hvd.rank() != 0:
+            return
+        import os
+        import pickle
+        tmp = self._snapshot_path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump({"known": dict(self._saved),
+                         "trees": dict(self._tree_saved)}, f)
+        os.replace(tmp, self._snapshot_path)
+
+    def maybe_load_snapshot(self) -> bool:
+        import os
+        import pickle
+        if not self._snapshot_path:
+            return False
+        self._snapshot_armed = True
+        if not os.path.exists(self._snapshot_path):
+            return False
+        with open(self._snapshot_path, "rb") as f:
+            snap = pickle.load(f)
+        for k, v in snap["known"].items():
+            setattr(self, k, v)
+        for k, v in snap["trees"].items():
+            setattr(self, k, jax.tree_util.tree_map(jnp.asarray, v)
+                    if v is not None else None)
+        self.save()
+        return True
 
     def restore(self) -> None:
         super().restore()

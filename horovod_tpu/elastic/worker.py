@@ -69,6 +69,27 @@ def start_listener() -> int:
     return _listener.port
 
 
+def register_with_rendezvous() -> None:
+    """Start the notification listener (once) and register its port
+    with the driver's rendezvous so membership changes get pushed here
+    (reference: WorkerNotificationManager.init + registration)."""
+    addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "")
+    if not addr:
+        return
+    port = start_listener()
+    me = os.environ.get("HOROVOD_HOSTNAME", socket.gethostname())
+    lr = os.environ.get("HOROVOD_LOCAL_RANK", "0")
+    url = f"http://{addr}/notify/{me}/{lr}"
+    req = urllib.request.Request(
+        url, data=json.dumps({"port": port}).encode(), method="PUT")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            resp.read()
+        hlog.debug("elastic: registered notify port %d", port)
+    except OSError as e:
+        hlog.warning("elastic: notify registration failed: %s", e)
+
+
 def refresh_env_from_rendezvous() -> None:
     """Re-read rank/size/coordinator assignment from the rendezvous
     KV server after a membership change. No-op outside elastic runs."""

@@ -1,0 +1,62 @@
+"""Host discovery for elastic jobs.
+
+Reference: horovod/runner/elastic/discovery.py — HostDiscovery /
+HostDiscoveryScript: the user provides an executable that prints one
+"hostname:slots" line per available host; the driver polls it and
+diffs the result to detect added/removed hosts.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from typing import Dict, List
+
+from ..hosts import HostSlots, parse_hosts
+
+
+class HostDiscovery:
+    def find_available_hosts_and_slots(self) -> List[HostSlots]:
+        raise NotImplementedError
+
+
+class FixedHosts(HostDiscovery):
+    """Static host list (elastic machinery with a fixed world)."""
+
+    def __init__(self, hosts: str, np_: int):
+        self._hosts = parse_hosts(hosts, 0) if hosts else \
+            [HostSlots("localhost", np_)]
+
+    def find_available_hosts_and_slots(self) -> List[HostSlots]:
+        return list(self._hosts)
+
+
+class HostDiscoveryScript(HostDiscovery):
+    """Runs the user script; its stdout lines are "host:slots"
+    (reference: HostDiscoveryScript; same output contract)."""
+
+    def __init__(self, script: str, timeout: float = 30.0):
+        self.script = script
+        self.timeout = timeout
+
+    def find_available_hosts_and_slots(self) -> List[HostSlots]:
+        r = subprocess.run([self.script], capture_output=True,
+                           text=True, timeout=self.timeout, shell=False)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"discovery script {self.script} failed "
+                f"(rc={r.returncode}): {r.stderr.strip()}")
+        out: List[HostSlots] = []
+        for line in r.stdout.splitlines():
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if ":" in line:
+                h, s = line.rsplit(":", 1)
+                out.append(HostSlots(h.strip(), int(s)))
+            else:
+                out.append(HostSlots(line, 1))
+        return out
+
+
+def hosts_key(hosts: List[HostSlots]) -> Dict[str, int]:
+    return {h.host: h.slots for h in hosts}

@@ -1,0 +1,321 @@
+"""Elastic driver: dynamic membership for the launcher.
+
+Reference: horovod/runner/elastic/driver.py — ElasticDriver: polls
+host discovery, assigns ranks, updates the rendezvous, notifies
+workers on membership changes, and handles worker failures.
+
+TPU adaptation of the recovery model (SURVEY.md §5.3): the JAX
+coordination service FATALLY TERMINATES surviving processes when a
+peer dies (verified behavior), so the reference's survivor-side
+HorovodInternalError recovery cannot apply to hard failures. Two
+paths instead:
+
+  * graceful resize (discovery change): processes stay alive — the
+    driver re-publishes assignments with a fresh coordinator port and
+    pokes each worker's notification listener; workers raise
+    HostsUpdatedInterrupt at the next commit boundary, tear down
+    jax.distributed in-process, re-read their assignment from the
+    rendezvous, and re-init with the new world (reference parity).
+  * hard failure (a worker dies): the gang is restarted on the
+    latest discovered hosts — the driver kills stragglers (the
+    coordination service usually already has), re-assigns, and
+    relaunches; training resumes from the last committed host-side
+    snapshot (elastic.State commit), which is slice-level recovery as
+    it actually works on TPU pods.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ...common import logging as hlog
+from ..hosts import HostSlots, RankInfo, assign_ranks
+from ..launch import _prefix_pump, _ssh_command, free_port
+from .discovery import HostDiscovery, hosts_key
+from .rendezvous import RendezvousServer
+
+import os
+
+
+class _Slot:
+    def __init__(self, info: RankInfo, proc: subprocess.Popen):
+        self.info = info
+        self.proc = proc
+        self.pumps: List[threading.Thread] = []
+
+
+class ElasticDriver:
+    def __init__(self, command: List[str], discovery: HostDiscovery,
+                 min_np: int = 1, max_np: int = 0,
+                 poll_interval: float = 1.0,
+                 reset_limit: int = 0,
+                 elastic_timeout: float = 600.0,
+                 env: Optional[Dict[str, str]] = None,
+                 verbose: bool = False):
+        self.command = command
+        self.discovery = discovery
+        self.min_np = min_np
+        self.max_np = max_np
+        self.poll_interval = poll_interval
+        self.reset_limit = reset_limit
+        self.elastic_timeout = elastic_timeout
+        self.base_env = dict(env if env is not None else os.environ)
+        self.verbose = verbose
+
+        self.rendezvous = RendezvousServer()
+        self.epoch = 0
+        self.resets = 0
+        self.slots: Dict[Tuple[str, int], _Slot] = {}
+        self._io_lock = threading.Lock()
+        self.blacklist: Dict[str, float] = {}  # host -> until timestamp
+        self.blacklist_window = 60.0
+
+    # ------------------------------------------------------------------
+
+    def _discover(self) -> List[HostSlots]:
+        hosts = self.discovery.find_available_hosts_and_slots()
+        now = time.time()
+        live = [h for h in hosts
+                if self.blacklist.get(h.host, 0) < now]
+        return live
+
+    def _world_np(self, hosts: List[HostSlots]) -> int:
+        total = sum(h.slots for h in hosts)
+        if self.max_np:
+            total = min(total, self.max_np)
+        return total
+
+    def _assignments(self, hosts: List[HostSlots]
+                     ) -> Tuple[List[RankInfo], Dict]:
+        np_ = self._world_np(hosts)
+        infos = assign_ranks(hosts, np_)
+        rank0 = infos[0]
+        coord_host = "localhost" if rank0.is_local else rank0.host
+        coordinator = f"{coord_host}:{free_port()}"
+        control = f"{coord_host}:{free_port()}"
+        table = {}
+        for info in infos:
+            env = info.env()
+            env["HOROVOD_COORDINATOR_ADDR"] = coordinator
+            env["HOROVOD_CONTROL_ADDR"] = control
+            env["HOROVOD_HOSTNAME"] = info.host
+            env["HOROVOD_RENDEZVOUS_ADDR"] = \
+                f"{self._my_addr(info)}:{self.rendezvous.port}"
+            table[(info.host, info.local_rank)] = env
+        return infos, table
+
+    def _my_addr(self, info: RankInfo) -> str:
+        return "localhost" if info.is_local else socket.getfqdn()
+
+    # ------------------------------------------------------------------
+
+    def _spawn(self, info: RankInfo, env_add: Dict[str, str]) -> _Slot:
+        child_env = dict(self.base_env)
+        child_env.update(env_add)
+        child_env["HOROVOD_ELASTIC"] = "1"
+        child_env["HOROVOD_START_TIMEOUT"] = str(self.elastic_timeout)
+        if info.is_local:
+            cmd = self.command
+            popen_env = child_env
+        else:
+            cmd = _ssh_command(info, self.command, child_env, None)
+            popen_env = dict(os.environ)
+        if self.verbose:
+            print(f"[elastic] spawn rank {info.rank} on {info.host}",
+                  file=sys.stderr)
+        p = subprocess.Popen(cmd, env=popen_env,
+                             stdout=subprocess.PIPE,
+                             stderr=subprocess.PIPE)
+        slot = _Slot(info, p)
+        tag = f"{info.rank}"
+        t1 = threading.Thread(target=_prefix_pump,
+                              args=(p.stdout, tag, sys.stdout,
+                                    self._io_lock), daemon=True)
+        t2 = threading.Thread(target=_prefix_pump,
+                              args=(p.stderr, tag, sys.stderr,
+                                    self._io_lock), daemon=True)
+        t1.start(); t2.start()
+        slot.pumps = [t1, t2]
+        return slot
+
+    def _notify_workers(self) -> None:
+        """Poke every registered notification listener (reference:
+        WorkerNotificationService HostsUpdatedRequest)."""
+        for (host, lr), port in self.rendezvous.notify_ports().items():
+            if port <= 0:
+                continue
+            try:
+                with socket.create_connection((host, port),
+                                              timeout=5) as s:
+                    s.sendall(json.dumps(
+                        {"epoch": self.epoch}).encode())
+                    s.recv(16)
+            except OSError as e:
+                hlog.debug("elastic: notify %s:%d failed: %s", host,
+                           lr, e)
+
+    def _publish_epoch(self, hosts: List[HostSlots]
+                       ) -> Tuple[List[RankInfo], Dict]:
+        self.epoch += 1
+        infos, table = self._assignments(hosts)
+        self.rendezvous.publish(self.epoch, table)
+        return infos, table
+
+    def _reconcile(self, infos: List[RankInfo], table: Dict) -> None:
+        """Start missing slot processes; stop processes whose slot
+        disappeared."""
+        wanted = {(i.host, i.local_rank): i for i in infos}
+        # stop removed
+        for key in list(self.slots):
+            if key not in wanted:
+                slot = self.slots.pop(key)
+                if slot.proc.poll() is None:
+                    hlog.info("elastic: removing rank on %s:%d", *key)
+                    slot.proc.terminate()
+                self.rendezvous.drop_notify(key)
+        # start missing
+        for key, info in wanted.items():
+            cur = self.slots.get(key)
+            if cur is None or cur.proc.poll() is not None:
+                self.slots[key] = self._spawn(info, dict(table[key]))
+
+    # ------------------------------------------------------------------
+
+    def run(self) -> int:
+        deadline0 = time.time() + self.elastic_timeout
+        while True:
+            hosts = self._discover()
+            if self._world_np(hosts) >= self.min_np:
+                break
+            if time.time() > deadline0:
+                print("[elastic] timed out waiting for min hosts",
+                      file=sys.stderr)
+                return 1
+            time.sleep(self.poll_interval)
+
+        current = hosts_key(hosts)
+        infos, table = self._publish_epoch(hosts)
+        self._reconcile(infos, table)
+
+        try:
+            return self._monitor(current)
+        finally:
+            for slot in self.slots.values():
+                if slot.proc.poll() is None:
+                    slot.proc.kill()
+            self.rendezvous.stop()
+
+    def _monitor(self, current: Dict[str, int]) -> int:
+        last_poll = 0.0
+        while True:
+            time.sleep(0.1)
+
+            # 1) process exits
+            exited = {k: s for k, s in self.slots.items()
+                      if s.proc.poll() is not None}
+            if exited:
+                codes = {k: s.proc.returncode for k, s in exited.items()}
+                if all(c == 0 for c in codes.values()) and \
+                        len(exited) == len(self.slots):
+                    return 0  # clean completion
+                bad = {k: c for k, c in codes.items() if c != 0}
+                if bad:
+                    self.resets += 1
+                    hlog.warning(
+                        "elastic: worker failure(s) %s (reset %d)",
+                        bad, self.resets)
+                    if self.reset_limit and \
+                            self.resets > self.reset_limit:
+                        print("[elastic] reset limit reached",
+                              file=sys.stderr)
+                        return max(bad.values())
+                    # Blacklist failing hosts — but never below
+                    # min_np capacity (a single-host job must restart
+                    # on the same host, not starve out the window).
+                    for host in {k[0] for k in bad}:
+                        proposed = dict(self.blacklist)
+                        proposed[host] = time.time() + \
+                            self.blacklist_window
+                        try:
+                            avail = (self.discovery
+                                     .find_available_hosts_and_slots())
+                        except Exception as e:
+                            hlog.warning(
+                                "elastic: discovery failed during "
+                                "failure handling: %s", e)
+                            avail = []
+                        remaining = [
+                            h for h in avail
+                            if proposed.get(h.host, 0) < time.time()]
+                        if self._world_np(remaining) >= self.min_np:
+                            self.blacklist = proposed
+                        else:
+                            hlog.info(
+                                "elastic: not blacklisting %s (would "
+                                "drop below min_np)", host)
+                    self._gang_restart()
+                    try:
+                        current = hosts_key(self._discover())
+                    except Exception as e:
+                        hlog.warning(
+                            "elastic: discovery failed after "
+                            "restart: %s", e)
+                    continue
+
+            # 2) discovery changes
+            now = time.time()
+            if now - last_poll >= self.poll_interval:
+                last_poll = now
+                try:
+                    hosts = self._discover()
+                except Exception as e:
+                    hlog.warning("elastic: discovery failed: %s", e)
+                    continue
+                key = hosts_key(hosts)
+                if key != current and \
+                        self._world_np(hosts) >= self.min_np:
+                    hlog.info("elastic: membership change %s -> %s",
+                              current, key)
+                    current = key
+                    infos, table = self._publish_epoch(hosts)
+                    self._reconcile(infos, table)
+                    self._notify_workers()
+
+    def _gang_restart(self) -> None:
+        """Hard-failure recovery: kill the remaining gang and relaunch
+        on the latest discovered hosts (see module docstring for why
+        survivors cannot be kept on TPU)."""
+        for key, slot in list(self.slots.items()):
+            if slot.proc.poll() is None:
+                slot.proc.terminate()
+        deadline = time.time() + 10
+        for slot in self.slots.values():
+            while slot.proc.poll() is None and time.time() < deadline:
+                time.sleep(0.05)
+            if slot.proc.poll() is None:
+                slot.proc.kill()
+        self.slots.clear()
+        waited = time.time() + self.elastic_timeout
+        hosts = []
+        while True:
+            try:
+                hosts = self._discover()
+            except Exception as e:
+                hlog.warning(
+                    "elastic: discovery failed during restart: %s", e)
+                hosts = []
+            if self._world_np(hosts) >= self.min_np:
+                break
+            if time.time() > waited:
+                raise RuntimeError(
+                    "elastic: below min_np after failure and no new "
+                    "hosts appeared within the timeout")
+            time.sleep(self.poll_interval)
+        infos, table = self._publish_epoch(hosts)
+        self._reconcile(infos, table)

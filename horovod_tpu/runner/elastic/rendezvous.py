@@ -1,0 +1,110 @@
+"""HTTP rendezvous server for elastic jobs.
+
+Reference: horovod/runner/http/http_server.py — RendezvousServer /
+KVStoreHandler: a tiny HTTP KV store the workers poll for their rank
+assignment after membership changes; also collects worker
+notification-listener registrations (reference:
+WorkerNotificationService registration in runner/elastic/worker.py).
+
+Endpoints:
+  GET /rank/<host>/<local_rank>  -> JSON env assignment for that slot
+                                    (404 while unassigned)
+  GET /world                     -> {"epoch": N, "size": M}
+  PUT /notify/<host>/<local_rank> body={"port": p} -> register the
+                                    worker's notification listener
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+
+class _State:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.epoch = 0
+        self.size = 0
+        # (host, local_rank) -> env dict
+        self.assignments: Dict[Tuple[str, int], Dict[str, str]] = {}
+        # (host, local_rank) -> notify port
+        self.notify_ports: Dict[Tuple[str, int], int] = {}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    state: _State = None  # injected
+
+    def log_message(self, *args):  # silence default stderr spam
+        pass
+
+    def _json(self, code: int, obj) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        parts = [p for p in self.path.split("/") if p]
+        st = self.state
+        if len(parts) == 3 and parts[0] == "rank":
+            key = (parts[1], int(parts[2]))
+            with st.lock:
+                env = st.assignments.get(key)
+            if env is None:
+                self._json(404, {"error": "unassigned"})
+            else:
+                self._json(200, env)
+        elif parts == ["world"]:
+            with st.lock:
+                self._json(200, {"epoch": st.epoch, "size": st.size})
+        else:
+            self._json(404, {"error": "not found"})
+
+    def do_PUT(self):
+        parts = [p for p in self.path.split("/") if p]
+        st = self.state
+        if len(parts) == 3 and parts[0] == "notify":
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n).decode() or "{}")
+            key = (parts[1], int(parts[2]))
+            with st.lock:
+                st.notify_ports[key] = int(body.get("port", 0))
+            self._json(200, {"ok": True})
+        else:
+            self._json(404, {"error": "not found"})
+
+
+class RendezvousServer:
+    def __init__(self, port: int = 0):
+        self._state = _State()
+        handler = type("Handler", (_Handler,), {"state": self._state})
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="hvd-rendezvous",
+            daemon=True)
+        self._thread.start()
+
+    def publish(self, epoch: int,
+                assignments: Dict[Tuple[str, int], Dict[str, str]]
+                ) -> None:
+        with self._state.lock:
+            self._state.epoch = epoch
+            self._state.size = len(assignments)
+            self._state.assignments = dict(assignments)
+
+    def notify_ports(self) -> Dict[Tuple[str, int], int]:
+        with self._state.lock:
+            return dict(self._state.notify_ports)
+
+    def drop_notify(self, key: Tuple[str, int]) -> None:
+        with self._state.lock:
+            self._state.notify_ports.pop(key, None)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -195,6 +195,20 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--check-build", action="store_true",
                    help="print the capability matrix and exit")
+    # elastic (reference: horovodrun --host-discovery-script /
+    # --min-num-proc / --max-num-proc)
+    p.add_argument("--host-discovery-script", default=None,
+                   help="executable printing 'host:slots' lines; "
+                        "enables elastic mode")
+    p.add_argument("--min-num-proc", type=int, default=None,
+                   help="lower bound on world size in elastic mode "
+                        "(default: -np, so a job never silently runs "
+                        "smaller than requested)")
+    p.add_argument("--max-num-proc", type=int, default=0)
+    p.add_argument("--host-change-detection-interval", type=float,
+                   default=1.0)
+    p.add_argument("--reset-limit", type=int, default=0)
+    p.add_argument("--elastic-timeout", type=float, default=600.0)
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command")
     return p
@@ -212,6 +226,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not command:
         print("error: no command given", file=sys.stderr)
         return 2
+    if args.host_discovery_script:
+        from .elastic import ElasticDriver, HostDiscoveryScript
+        min_np = args.min_num_proc if args.min_num_proc is not None \
+            else args.num_proc
+        driver = ElasticDriver(
+            command,
+            HostDiscoveryScript(args.host_discovery_script),
+            min_np=min_np, max_np=args.max_num_proc,
+            poll_interval=args.host_change_detection_interval,
+            reset_limit=args.reset_limit,
+            elastic_timeout=args.elastic_timeout,
+            verbose=args.verbose)
+        return driver.run()
     return run(command, np_=args.num_proc, hosts=args.hosts,
                output_filename=args.output_filename,
                ssh_port=args.ssh_port,

@@ -1,0 +1,71 @@
+"""Elastic training worker for integration tests: a toy training loop
+under hvd.elastic.run that logs (epoch-world-size, step) progress to a
+file per rank, commits every step, and exits after N total steps
+(reference: the elastic integration scripts in test/integration/
+elastic_common.py — progress-logging training driven by a rewritable
+discovery script)."""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+LOG = os.environ["ELASTIC_TEST_LOG"]
+TOTAL_STEPS = int(os.environ.get("ELASTIC_TEST_STEPS", "40"))
+STEP_SLEEP = float(os.environ.get("ELASTIC_TEST_SLEEP", "0.2"))
+
+
+def log_line(msg):
+    with open(f"{LOG}.{os.environ.get('HOROVOD_RANK', '?')}", "a") as f:
+        f.write(msg + "\n")
+
+
+DIE_AT = int(os.environ.get("ELASTIC_TEST_DIE_AT", "0"))
+
+
+def main():
+    hvd.init()
+    state = hvd.elastic.JaxState(
+        params={"w": jnp.zeros((2,))}, step=0,
+        snapshot_path=f"{LOG}_snapshot.bin")
+
+    @hvd.elastic.run
+    def train(state):
+        while state.step < TOTAL_STEPS:
+            # one "training step": an allreduce so failures/resizes
+            # surface as collective errors
+            g = hvd.allreduce(jnp.ones((2,)) * (state.step + 1),
+                              name="grad")
+            state.params["w"] = state.params["w"] + np.asarray(g)
+            state.step += 1
+            log_line(f"step {state.step} world {hvd.size()} "
+                     f"rank {hvd.rank()}")
+            # failure injection (once): rank 1 dies hard at DIE_AT
+            marker = f"{LOG}_died.marker"
+            if (DIE_AT and state.step == DIE_AT and hvd.rank() == 1
+                    and not os.path.exists(marker)):
+                with open(marker, "w") as f:
+                    f.write("died\n")
+                os._exit(17)
+            state.check_host_updates()
+            state.commit()
+            time.sleep(STEP_SLEEP)
+
+    train(state)
+    log_line(f"done world {hvd.size()} rank {hvd.rank()} "
+             f"w0 {float(state.params['w'][0]):.1f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()
