@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""BASELINE config 5: elastic ResNet-50 — dynamic worker add/remove
+(reference: horovod.elastic ResNet; docs/elastic.rst pattern).
+
+  python -m horovod_tpu.runner \\
+      --host-discovery-script ./discover.sh --min-num-proc 1 \\
+      python examples/elastic_resnet50.py
+
+where discover.sh prints "host:slots" lines and may change over time.
+Commits every batch; resizes reshard the remaining data via
+ElasticSampler; hard failures resume from the rank-0 snapshot.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models.resnet import create_resnet50, init_resnet
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches-per-epoch", type=int, default=8)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--image-size", type=int, default=64)
+    ap.add_argument("--snapshot", default="/tmp/elastic_resnet.snap")
+    args = ap.parse_args()
+
+    hvd.init()
+    model = create_resnet50(num_classes=100, dtype=jnp.float32)
+    variables = init_resnet(model, jax.random.PRNGKey(0),
+                            args.image_size)
+    opt = optax.sgd(0.01 * hvd.size(), momentum=0.9)
+
+    state = hvd.elastic.JaxState(
+        params=variables["params"],
+        opt_state=opt.init(variables["params"]),
+        batch_stats=variables["batch_stats"],
+        epoch=0, batch_idx=0,
+        snapshot_path=args.snapshot)
+    state._tree_attrs.append("batch_stats")
+
+    def loss_fn(params, stats, images, labels):
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": stats}, images,
+            train=True, mutable=["batch_stats"])
+        onehot = jax.nn.one_hot(labels, logits.shape[-1])
+        loss = jnp.mean(
+            -jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+        return loss, updates["batch_stats"]
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
+
+    @hvd.elastic.run
+    def train(state):
+        opt_d = hvd.DistributedOptimizer(opt)
+        rng = np.random.default_rng(1234)
+        while state.epoch < args.epochs:
+            while state.batch_idx < args.batches_per_epoch:
+                images = jnp.asarray(rng.standard_normal(
+                    (args.batch_size, args.image_size,
+                     args.image_size, 3), dtype=np.float32))
+                labels = jnp.asarray(
+                    rng.integers(0, 100, args.batch_size), jnp.int32)
+                (loss, new_stats), grads = grad_fn(
+                    state.params, state.batch_stats, images, labels)
+                updates, state.opt_state = opt_d.update(
+                    grads, state.opt_state, state.params)
+                state.params = optax.apply_updates(state.params,
+                                                   updates)
+                state.batch_stats = new_stats
+                state.batch_idx += 1
+                if hvd.rank() == 0:
+                    print(f"epoch {state.epoch} batch "
+                          f"{state.batch_idx} world {hvd.size()} "
+                          f"loss {float(loss):.3f}", flush=True)
+                state.commit()
+            state.batch_idx = 0
+            state.epoch += 1
+            state.commit()
+
+    train(state)
+    if hvd.rank() == 0:
+        print("elastic training complete")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

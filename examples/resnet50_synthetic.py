@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""BASELINE config 2: ResNet-50 synthetic benchmark — pure allreduce
+throughput (reference: examples/pytorch/pytorch_synthetic_benchmark.py).
+
+Single host: every local device joins the data mesh; on a pod, run one
+process per host via the launcher and the mesh spans all chips. This
+is the same code path bench.py measures.
+
+  python examples/resnet50_synthetic.py --batch-size 128 --num-iters 30
+"""
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.models.resnet import create_resnet50, init_resnet
+from horovod_tpu.parallel import build_train_step
+from horovod_tpu.parallel.mesh import data_parallel_mesh
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=32,
+                    help="per-device batch (reference default: 32)")
+    ap.add_argument("--num-iters", type=int, default=10)
+    ap.add_argument("--num-warmup", type=int, default=3)
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--fp32", action="store_true",
+                    help="float32 compute instead of bfloat16")
+    args = ap.parse_args()
+
+    hvd.init()
+    mesh = data_parallel_mesh()
+    n = mesh.devices.size
+    global_batch = args.batch_size * n
+
+    model = create_resnet50(
+        dtype=jnp.float32 if args.fp32 else jnp.bfloat16)
+    variables = init_resnet(model, jax.random.PRNGKey(0),
+                            args.image_size)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    def loss_fn(params, batch):
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch["batch_stats"]},
+            batch["images"], train=True, mutable=["batch_stats"])
+        onehot = jax.nn.one_hot(batch["labels"], logits.shape[-1])
+        loss = jnp.mean(
+            -jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+        return loss, updates["batch_stats"]
+
+    opt = optax.sgd(0.0125 * n, momentum=0.9)
+    opt_state = opt.init(params)
+    step = build_train_step(
+        loss_fn, opt, mesh,
+        batch_spec={"images": P("data"), "labels": P("data"),
+                    "batch_stats": P()},
+        loss_has_aux=True, donate=True)
+
+    rng = np.random.default_rng(0)
+    sh = NamedSharding(mesh, P("data"))
+    images = jax.device_put(
+        jnp.asarray(rng.standard_normal(
+            (global_batch, args.image_size, args.image_size, 3),
+            dtype=np.float32)), sh)
+    labels = jax.device_put(
+        jnp.asarray(rng.integers(0, 1000, global_batch), jnp.int32), sh)
+    batch_stats = jax.device_put(
+        batch_stats, NamedSharding(mesh, P()))
+
+    def one(params, opt_state, batch_stats):
+        b = {"images": images, "labels": labels,
+             "batch_stats": batch_stats}
+        params, opt_state, m = step(params, opt_state, b)
+        return params, opt_state, m["aux"], m["loss"]
+
+    for _ in range(args.num_warmup):
+        params, opt_state, batch_stats, loss = one(params, opt_state,
+                                                   batch_stats)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        params, opt_state, batch_stats, loss = one(params, opt_state,
+                                                   batch_stats)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_sec = global_batch * args.num_iters / dt
+    if hvd.rank() == 0:
+        print(f"Model: ResNet50, batch {args.batch_size}/device, "
+              f"{n} device(s)")
+        print(f"Img/sec total: {img_sec:.1f}")
+        print(f"Img/sec per device: {img_sec / n:.1f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

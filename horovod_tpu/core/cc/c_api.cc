@@ -89,4 +89,8 @@ void hvd_core_shutdown(void* h) {
   static_cast<Controller*>(h)->Shutdown();
 }
 
+void hvd_core_set_fusion_threshold(void* h, long long bytes) {
+  static_cast<Controller*>(h)->SetFusionThreshold(bytes);
+}
+
 }  // extern "C"

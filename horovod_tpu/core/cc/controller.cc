@@ -18,6 +18,7 @@ std::string FuseKey(const std::string& sig) {
 }  // namespace
 
 Controller::Controller(const ControllerOptions& opts) : opts_(opts) {
+  fusion_threshold_.store(opts.fusion_threshold);
   if (opts_.size > 1) {
     if (opts_.rank == 0) {
       listen_fd_ = ListenOn(opts_.coord_port, opts_.size + 4);
@@ -246,7 +247,7 @@ void Controller::RunCoordinatorCycle() {
         if (jt == tensors_.end()) break;
         const TensorState& st = jt->second;
         if (FuseKey(st.sig) != key) break;
-        if (bytes > 0 && bytes + st.nbytes > opts_.fusion_threshold)
+        if (bytes > 0 && bytes + st.nbytes > fusion_threshold_.load())
           break;
         Entry e;
         e.name = ready_order_[j];

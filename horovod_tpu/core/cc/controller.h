@@ -83,6 +83,11 @@ class Controller {
   int AllJoined();
 
   void Shutdown();
+  // Live-tunable fusion threshold (reference: ParameterManager
+  // adjusting HOROVOD_FUSION_THRESHOLD online). Coordinator-side.
+  void SetFusionThreshold(int64_t bytes) {
+    fusion_threshold_.store(bytes);
+  }
   bool ok() const { return ok_; }
   const std::string& last_error() const { return last_error_; }
   int64_t cycles() const { return cycles_; }
@@ -103,6 +108,7 @@ class Controller {
   void CheckStalls(double now);
 
   ControllerOptions opts_;
+  std::atomic<int64_t> fusion_threshold_{64 << 20};
   std::atomic<bool> shutdown_{false};
   bool ok_ = true;
   std::string last_error_;

@@ -86,6 +86,8 @@ def load():
                 ctypes.c_double]
             lib.hvd_core_next_batch.restype = ctypes.c_longlong
             lib.hvd_core_shutdown.argtypes = [ctypes.c_void_p]
+            lib.hvd_core_set_fusion_threshold.argtypes = [
+                ctypes.c_void_p, ctypes.c_longlong]
             _lib = lib
     return _lib
 
@@ -167,6 +169,9 @@ class NativeCore:
                                   int(act.decode() or -1),
                                   err.decode()))
         return out
+
+    def set_fusion_threshold(self, nbytes: int) -> None:
+        self._lib.hvd_core_set_fusion_threshold(self._h, int(nbytes))
 
     def shutdown(self) -> None:
         if self._h is not None:

@@ -22,8 +22,10 @@ the reference controller's FuseResponses rule).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -137,6 +139,10 @@ class PythonCore:
                 self._pending.pop(0)
             return batch
 
+    def set_fusion_threshold(self, nbytes: int) -> None:
+        with self._cv:
+            self.fusion_threshold = int(nbytes)
+
     def shutdown(self) -> None:
         with self._cv:
             self._shutdown = True
@@ -160,6 +166,7 @@ class NegotiatedController:
         self._join_event = threading.Event()
         self._join_result = -1
         self._error: Optional[BaseException] = None
+        self._pushed_fusion = cfg.fusion_threshold
 
         use_native = (topology.size > 1 or cfg.controller == "native") \
             and native.available()
@@ -368,6 +375,9 @@ class NegotiatedController:
             if self.engine.timeline is not None:
                 self.engine.timeline.dispatched(e.name)
 
+        tuner = self.engine.autotuner
+        t0 = time.perf_counter() if tuner is not None else 0.0
+
         eff_op, eff_post = rop, post
         if rop == AVERAGE:
             # Join-aware average (reference: Join + Average divides by
@@ -390,6 +400,20 @@ class NegotiatedController:
                 if p is not None:
                     p.handle.set_error(ex)
             return
+        if tuner is not None:
+            # Autotune scores bytes-reduced/sec (reference:
+            # ParameterManager): needs completion time, so block only
+            # when tuning; then propagate the (possibly stepped)
+            # fusion threshold into the negotiation core.
+            jax.block_until_ready(outs)
+            nbytes = int(sum(
+                np.prod(t.shape) * jnp.dtype(t.dtype).itemsize
+                for t in tensors))
+            tuner.record(nbytes, time.perf_counter() - t0)
+            if tuner.fusion_threshold != self._pushed_fusion:
+                self._pushed_fusion = tuner.fusion_threshold
+                self.core.set_fusion_threshold(self._pushed_fusion)
+
         i = 0
         for e, p, cnt in slots:
             outs_i = outs[i:i + cnt]
