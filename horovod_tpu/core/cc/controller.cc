@@ -19,13 +19,13 @@ std::string FuseKey(const std::string& sig) {
 
 Controller::Controller(const ControllerOptions& opts) : opts_(opts) {
   fusion_threshold_.store(opts.fusion_threshold);
+  cycle_time_ms_.store(opts.cycle_time_ms);
   if (opts_.size > 1) {
     if (opts_.rank == 0) {
       listen_fd_ = ListenOn(opts_.coord_port, opts_.size + 4);
       if (listen_fd_ < 0) {
-        ok_ = false;
-        last_error_ = "failed to listen on control port " +
-                      std::to_string(opts_.coord_port);
+        SetError("failed to listen on control port " +
+                 std::to_string(opts_.coord_port));
         return;
       }
       worker_fds_.assign(opts_.size, -1);
@@ -34,10 +34,9 @@ Controller::Controller(const ControllerOptions& opts) : opts_(opts) {
       coord_fd_ = ConnectTo(opts_.coord_host, opts_.coord_port,
                             opts_.connect_timeout_s);
       if (coord_fd_ < 0) {
-        ok_ = false;
-        last_error_ = "failed to connect to controller at " +
-                      opts_.coord_host + ":" +
-                      std::to_string(opts_.coord_port);
+        SetError("failed to connect to controller at " +
+                 opts_.coord_host + ":" +
+                 std::to_string(opts_.coord_port));
         return;
       }
       Buf hello;
@@ -52,6 +51,14 @@ Controller::Controller(const ControllerOptions& opts) : opts_(opts) {
 }
 
 Controller::~Controller() { Shutdown(); }
+
+void Controller::SetError(const std::string& msg) {
+  {
+    std::lock_guard<std::mutex> lk(err_mu_);
+    last_error_ = msg;
+  }
+  ok_.store(false);
+}
 
 void Controller::Abort() {
   bool expected = false;
@@ -94,11 +101,23 @@ void Controller::Shutdown() {
 
 void Controller::Submit(const std::string& name, const std::string& sig,
                         int64_t nbytes) {
-  std::lock_guard<std::mutex> lk(submit_mu_);
   Request r;
-  r.name = name;
-  r.sig = sig;
-  r.nbytes = nbytes;
+  // Response-cache hit (reference: ResponseCache::Lookup): a
+  // previously-negotiated (name, sig) collapses to its 5-byte id.
+  // Only worth it on ranks that serialize over the wire; rank 0's
+  // requests go to its own coordinator without serialization.
+  if (opts_.rank != 0 && opts_.cache_capacity > 0) {
+    std::lock_guard<std::mutex> clk(cache_mu_);
+    auto it = submit_cache_.find(name);
+    if (it != submit_cache_.end() && it->second.sig == sig)
+      r.cache_id = it->second.id;
+  }
+  if (r.cache_id == 0) {
+    r.name = name;
+    r.sig = sig;
+    r.nbytes = nbytes;
+  }
+  std::lock_guard<std::mutex> lk(submit_mu_);
   pending_.push_back(std::move(r));
 }
 
@@ -146,12 +165,13 @@ void Controller::CycleLoop() {
       if (opts_.rank == 0 || opts_.size == 1) {
         CoordinatorIngest(0, std::move(mine));
       } else {
-        if (!SendMsg(coord_fd_, MsgType::kReady,
-                     SerializeRequests(mine)) &&
+        std::string payload = SerializeRequests(mine);
+        control_bytes_sent_.fetch_add(
+            static_cast<int64_t>(payload.size()));
+        if (!SendMsg(coord_fd_, MsgType::kReady, payload) &&
             !shutdown_.load()) {
           HVD_LOG(kError, "lost connection to controller");
-          ok_ = false;
-          last_error_ = "lost connection to controller";
+          SetError("lost connection to controller");
           Abort();  // never Shutdown() from our own thread
           return;
         }
@@ -160,7 +180,7 @@ void Controller::CycleLoop() {
     if (opts_.rank == 0) RunCoordinatorCycle();
     cycles_.fetch_add(1);
     std::this_thread::sleep_for(std::chrono::duration<double>(
-        opts_.cycle_time_ms / 1000.0));
+        cycle_time_ms_.load() / 1000.0));
   }
 }
 
@@ -172,6 +192,20 @@ void Controller::CoordinatorIngest(int rank, std::vector<Request> reqs) {
   std::lock_guard<std::mutex> lk(coord_mu_);
   double now = NowSeconds();
   for (auto& r : reqs) {
+    if (r.cache_id != 0) {
+      // Cache hit: expand the 5-byte announcement back to the full
+      // request (reference: ResponseCache::Get in the coordinator's
+      // cache-coordination path).
+      auto ct = coord_cache_.find(r.cache_id);
+      if (ct == coord_cache_.end()) {
+        HVD_LOG(kWarning, "rank %d sent unknown cache id %u", rank,
+                r.cache_id);
+        continue;
+      }
+      r.name = ct->second.name;
+      r.sig = ct->second.sig;
+      r.nbytes = ct->second.nbytes;
+    }
     if (r.join) {
       if (joined_ranks_.insert(rank).second) last_joined_rank_ = rank;
       continue;
@@ -245,7 +279,7 @@ void Controller::RunCoordinatorCycle() {
       while (j < ready_order_.size()) {
         auto jt = tensors_.find(ready_order_[j]);
         if (jt == tensors_.end()) break;
-        const TensorState& st = jt->second;
+        TensorState& st = jt->second;
         if (FuseKey(st.sig) != key) break;
         if (bytes > 0 && bytes + st.nbytes > fusion_threshold_.load())
           break;
@@ -255,7 +289,42 @@ void Controller::RunCoordinatorCycle() {
         e.batch_id = bid;
         e.active_ranks =
             opts_.size - static_cast<int>(joined_ranks_.size());
+        // Generic ops (broadcast/allgather/alltoall/barrier, sig
+        // prefix "g|") cannot zero-fill a joined rank's contribution
+        // the way allreduce can; agreeing them with a rank absent
+        // would leave the submitters blocked inside a global XLA
+        // collective the joined rank never launches. The reference
+        // rejects join with non-allreduce ops; do the same, cleanly.
+        if (st.error.empty() && !joined_ranks_.empty() &&
+            st.sig.rfind("g|", 0) == 0) {
+          st.error = "hvd.join() is only supported with "
+                     "allreduce-style ops: op '" + e.name +
+                     "' was agreed while " +
+                     std::to_string(joined_ranks_.size()) +
+                     " rank(s) had joined";
+        }
         e.error = st.error;
+        if (st.fully_ready_at >= st.first_seen)
+          e.negotiate_us = static_cast<uint32_t>(
+              (st.fully_ready_at - st.first_seen) * 1e6);
+        // Assign a response-cache id the first time a name is agreed
+        // (capacity-bounded; ids never reused so caches cannot go
+        // stale). Every rank learns the mapping from the broadcast.
+        if (opts_.cache_capacity > 0 && e.error.empty()) {
+          auto idit = coord_cache_ids_.find(e.name);
+          if (idit != coord_cache_ids_.end()) {
+            e.cache_id = idit->second;
+            CachedTensor& c = coord_cache_[e.cache_id];
+            c.sig = st.sig;  // track latest sig (worker compares)
+            c.nbytes = st.nbytes;
+          } else if (coord_cache_.size() <
+                     static_cast<size_t>(opts_.cache_capacity)) {
+            e.cache_id = next_cache_id_++;
+            coord_cache_ids_.emplace(e.name, e.cache_id);
+            coord_cache_.emplace(
+                e.cache_id, CachedTensor{e.name, st.sig, st.nbytes});
+          }
+        }
         out.push_back(std::move(e));
         bytes += st.nbytes;
         tensors_.erase(jt);
@@ -330,6 +399,14 @@ void Controller::BroadcastEntries(const std::vector<Entry>& entries) {
 }
 
 void Controller::DeliverEntries(const std::vector<Entry>& entries) {
+  // Learn response-cache assignments from the coordinator's broadcast
+  // (reference: workers updating their ResponseCache from responses).
+  if (opts_.rank != 0 && opts_.cache_capacity > 0) {
+    std::lock_guard<std::mutex> lk(cache_mu_);
+    for (const auto& e : entries)
+      if (e.cache_id != 0)
+        submit_cache_[e.name] = CacheSlot{e.cache_id, e.sig};
+  }
   std::lock_guard<std::mutex> lk(ready_mu_);
   for (const auto& e : entries) {
     if (e.name == kAllJoined) {
@@ -417,8 +494,7 @@ void Controller::WorkerReaderLoop() {
     }
     if (!clean && !joined) {
       HVD_LOG(kWarning, "controller connection lost");
-      ok_ = false;
-      last_error_ = "controller connection lost";
+      SetError("controller connection lost");
     }
     // Either way the control plane is gone: stop the core so
     // NextBatch() returns shutdown and pending ops fail fast instead

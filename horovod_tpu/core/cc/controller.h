@@ -22,11 +22,13 @@
 //  * No MPI/gloo: transport is plain sockets; bootstrap address comes
 //    from the launcher (HOROVOD_CONTROL_ADDR).
 //  * No FlatBuffers: dependency-free length-prefixed binary format.
-//  * No response cache: signature consistency is checked within each
-//    negotiation round, and re-submitting a name with new metadata
-//    (dynamic loss-scale factors) renegotiates cleanly. The
-//    reference's bit-vector cache is a bandwidth optimization that is
-//    unnecessary at our control-plane message sizes (a few KB/cycle).
+//  * Response cache uses coordinator-assigned u32 ids instead of the
+//    reference's bit-vector AND-exchange: once a (name, sig) has been
+//    agreed, workers announce readiness with a 5-byte id instead of
+//    re-serializing name+sig+shape each cycle. Ids are never reused
+//    (capacity bounds insertion, not eviction), so worker caches
+//    cannot go stale; a sig change (e.g. dynamic loss-scale factors)
+//    misses the cache and renegotiates cleanly.
 #pragma once
 
 #include <condition_variable>
@@ -55,6 +57,9 @@ struct ControllerOptions {
   double stall_warn_s = 60.0;
   double stall_kill_s = 0.0;     // 0 = never
   double connect_timeout_s = 30.0;
+  // Response cache capacity (reference: HOROVOD_CACHE_CAPACITY,
+  // response_cache.cc). 0 disables caching entirely.
+  int cache_capacity = 1024;
 };
 
 // Sentinel entry name broadcast when every rank has joined
@@ -88,9 +93,20 @@ class Controller {
   void SetFusionThreshold(int64_t bytes) {
     fusion_threshold_.store(bytes);
   }
-  bool ok() const { return ok_; }
-  const std::string& last_error() const { return last_error_; }
+  // Live-tunable cycle time (the other half of the reference
+  // ParameterManager's search space).
+  void SetCycleTime(double ms) { cycle_time_ms_.store(ms); }
+  bool ok() const { return ok_.load(); }
+  // Returns a copy: the string may be rewritten by controller threads
+  // (lost connection, reader errors) concurrently with this read.
+  std::string last_error() const {
+    std::lock_guard<std::mutex> lk(err_mu_);
+    return last_error_;
+  }
   int64_t cycles() const { return cycles_; }
+  // Control-plane bytes this rank sent for ready announcements —
+  // observable proof the response cache shrinks steady-state traffic.
+  int64_t control_bytes_sent() const { return control_bytes_sent_; }
 
  private:
   void CycleLoop();
@@ -98,6 +114,7 @@ class Controller {
   // call from the controller's own threads (Shutdown() joins and must
   // only run on an external thread).
   void Abort();
+  void SetError(const std::string& msg);
   void CoordinatorIngest(int rank, std::vector<Request> reqs);
   void RunCoordinatorCycle();
   void BroadcastEntries(const std::vector<Entry>& entries);
@@ -109,14 +126,28 @@ class Controller {
 
   ControllerOptions opts_;
   std::atomic<int64_t> fusion_threshold_{64 << 20};
+  std::atomic<double> cycle_time_ms_{1.0};
   std::atomic<bool> shutdown_{false};
-  bool ok_ = true;
+  std::atomic<bool> ok_{true};
+  mutable std::mutex err_mu_;
   std::string last_error_;
   std::atomic<int64_t> cycles_{0};
+  std::atomic<int64_t> control_bytes_sent_{0};
 
   // --- frontend pending queue (reference: TensorQueue) ---
   std::mutex submit_mu_;
   std::vector<Request> pending_;
+
+  // --- response cache, worker side (reference: response_cache.cc) ---
+  // name -> (coordinator-assigned id, signature). Populated from
+  // delivered entries; consulted at submit time so steady-state
+  // announcements shrink to 5 bytes.
+  struct CacheSlot {
+    uint32_t id = 0;
+    std::string sig;
+  };
+  std::mutex cache_mu_;
+  std::unordered_map<std::string, CacheSlot> submit_cache_;
 
   // --- agreed batches awaiting execution ---
   std::mutex ready_mu_;
@@ -138,6 +169,19 @@ class Controller {
   std::map<std::string, TensorState> tensors_;  // pending negotiation
   std::vector<std::string> ready_order_;        // fully-ready FIFO
   std::set<int> joined_ranks_;
+  // Response cache, coordinator side: id -> full request metadata, so
+  // cached 5-byte announcements expand back losslessly. Ids are
+  // assigned once per name (capacity-bounded, never reused), so
+  // worker caches can never go stale — a sig change makes the worker
+  // miss (sig compared at submit) and the full path renegotiates.
+  struct CachedTensor {
+    std::string name;
+    std::string sig;
+    int64_t nbytes = 0;
+  };
+  std::unordered_map<uint32_t, CachedTensor> coord_cache_;
+  std::unordered_map<std::string, uint32_t> coord_cache_ids_;
+  uint32_t next_cache_id_ = 1;
   int last_joined_rank_ = -1;
   bool join_announced_ = false;
   int32_t next_batch_id_ = 1;

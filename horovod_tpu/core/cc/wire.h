@@ -35,11 +35,18 @@ enum class MsgType : uint8_t {
 };
 
 // One pending-tensor announcement (reference: Request).
+//
+// cache_id != 0 marks a response-cache hit (reference:
+// horovod/common/response_cache.cc bit-vector exchange): the worker
+// sends just the coordinator-assigned id instead of name+sig+nbytes,
+// shrinking steady-state control traffic from ~O(name+sig) bytes per
+// tensor to 5 bytes per tensor.
 struct Request {
   std::string name;
   std::string sig;    // "dtype|op|shape" signature for consistency checks
   int64_t nbytes = 0;
   bool join = false;  // a Join pseudo-request (reference: RequestType JOIN)
+  uint32_t cache_id = 0;  // response-cache hit marker (0 = full request)
 };
 
 // One agreed execution entry (reference: Response). Batches are runs
@@ -51,6 +58,11 @@ struct Entry {
   int32_t active_ranks = 0;  // non-joined ranks at agreement time
                              // (join-aware Average divides by this)
   std::string error;  // non-empty => deliver error to caller
+  uint32_t cache_id = 0;     // coordinator-assigned response-cache id
+                             // (0 = not cached); workers learn the
+                             // name->id mapping from delivered entries
+  uint32_t negotiate_us = 0;  // coordinator-measured submit->agreed
+                              // time (feeds the timeline NEGOTIATE lane)
 };
 
 class Buf {
@@ -160,6 +172,13 @@ inline std::string SerializeRequests(const std::vector<Request>& reqs) {
   Buf b;
   b.PutU32(static_cast<uint32_t>(reqs.size()));
   for (const auto& r : reqs) {
+    // Cached requests collapse to the 5-byte {u8 tag, u32 id} form.
+    if (r.cache_id != 0) {
+      b.PutU8(1);
+      b.PutU32(r.cache_id);
+      continue;
+    }
+    b.PutU8(0);
     b.PutStr(r.name);
     b.PutStr(r.sig);
     b.PutU64(static_cast<uint64_t>(r.nbytes));
@@ -176,6 +195,13 @@ inline bool ParseRequests(const std::string& d, std::vector<Request>* out) {
   out->reserve(n);
   for (uint32_t i = 0; i < n; ++i) {
     Request r;
+    uint8_t cached;
+    if (!rd.GetU8(&cached)) return false;
+    if (cached) {
+      if (!rd.GetU32(&r.cache_id)) return false;
+      out->push_back(std::move(r));
+      continue;
+    }
     uint64_t nb;
     uint8_t j;
     if (!rd.GetStr(&r.name) || !rd.GetStr(&r.sig) || !rd.GetU64(&nb) ||
@@ -197,6 +223,8 @@ inline std::string SerializeEntries(const std::vector<Entry>& es) {
     b.PutU32(static_cast<uint32_t>(e.batch_id));
     b.PutU32(static_cast<uint32_t>(e.active_ranks));
     b.PutStr(e.error);
+    b.PutU32(e.cache_id);
+    b.PutU32(e.negotiate_us);
   }
   return b.data();
 }
@@ -211,7 +239,8 @@ inline bool ParseEntries(const std::string& d, std::vector<Entry>* out) {
     Entry e;
     uint32_t bid, act;
     if (!rd.GetStr(&e.name) || !rd.GetStr(&e.sig) || !rd.GetU32(&bid) ||
-        !rd.GetU32(&act) || !rd.GetStr(&e.error))
+        !rd.GetU32(&act) || !rd.GetStr(&e.error) ||
+        !rd.GetU32(&e.cache_id) || !rd.GetU32(&e.negotiate_us))
       return false;
     e.batch_id = static_cast<int32_t>(bid);
     e.active_ranks = static_cast<int32_t>(act);

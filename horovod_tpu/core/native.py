@@ -2,7 +2,8 @@
 core (core/cc/libhvdtpu_core.so).
 
 The C++ core provides the tensor queue, rank-0 negotiation
-coordinator over TCP, fusion planner, response cache and stall
+coordinator over TCP, fusion planner, response cache (id-based
+steady-state announcements, HOROVOD_CACHE_CAPACITY) and stall
 inspector — the TPU-native equivalents of the reference's
 horovod/common/ C++ core (reference: operations.cc, controller.cc,
 tensor_queue.cc, fusion_buffer_manager.cc, response_cache.cc,
@@ -67,12 +68,14 @@ def load():
             lib.hvd_core_create.argtypes = [
                 ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
                 ctypes.c_int, ctypes.c_longlong, ctypes.c_double,
-                ctypes.c_double, ctypes.c_double, ctypes.c_double]
+                ctypes.c_double, ctypes.c_double, ctypes.c_double,
+                ctypes.c_int]
             lib.hvd_core_destroy.argtypes = [ctypes.c_void_p]
             lib.hvd_core_ok.argtypes = [ctypes.c_void_p]
             lib.hvd_core_ok.restype = ctypes.c_int
-            lib.hvd_core_last_error.argtypes = [ctypes.c_void_p]
-            lib.hvd_core_last_error.restype = ctypes.c_char_p
+            lib.hvd_core_last_error.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong]
+            lib.hvd_core_last_error.restype = ctypes.c_longlong
             lib.hvd_core_submit.argtypes = [
                 ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
                 ctypes.c_longlong]
@@ -88,6 +91,10 @@ def load():
             lib.hvd_core_shutdown.argtypes = [ctypes.c_void_p]
             lib.hvd_core_set_fusion_threshold.argtypes = [
                 ctypes.c_void_p, ctypes.c_longlong]
+            lib.hvd_core_set_cycle_time.argtypes = [
+                ctypes.c_void_p, ctypes.c_double]
+            lib.hvd_core_control_bytes.argtypes = [ctypes.c_void_p]
+            lib.hvd_core_control_bytes.restype = ctypes.c_longlong
             _lib = lib
     return _lib
 
@@ -97,18 +104,21 @@ def available() -> bool:
 
 
 class BatchEntry:
-    __slots__ = ("name", "sig", "active_ranks", "error")
+    __slots__ = ("name", "sig", "active_ranks", "error",
+                 "negotiate_us")
 
     def __init__(self, name: str, sig: str, active_ranks: int,
-                 error: str):
+                 error: str, negotiate_us: int = 0):
         self.name = name
         self.sig = sig
         self.active_ranks = active_ranks
         self.error = error
+        self.negotiate_us = negotiate_us
 
     def __repr__(self):
         return (f"BatchEntry({self.name}, {self.sig}, "
-                f"act={self.active_ranks}, err={self.error!r})")
+                f"act={self.active_ranks}, err={self.error!r}, "
+                f"neg_us={self.negotiate_us})")
 
 
 class NativeCore:
@@ -120,7 +130,8 @@ class NativeCore:
     def __init__(self, rank: int, size: int, coord_host: str,
                  coord_port: int, fusion_threshold: int,
                  cycle_time_ms: float, stall_warn_s: float,
-                 stall_kill_s: float, connect_timeout_s: float = 30.0):
+                 stall_kill_s: float, connect_timeout_s: float = 30.0,
+                 cache_capacity: int = 1024):
         lib = load()
         if lib is None:
             raise RuntimeError("native core not built")
@@ -128,13 +139,18 @@ class NativeCore:
         self._h = lib.hvd_core_create(
             rank, size, coord_host.encode(), coord_port,
             fusion_threshold, cycle_time_ms, stall_warn_s,
-            stall_kill_s, connect_timeout_s)
+            stall_kill_s, connect_timeout_s, cache_capacity)
         self._buf = ctypes.create_string_buffer(self.BUF_SIZE)
         if not lib.hvd_core_ok(self._h):
-            err = lib.hvd_core_last_error(self._h).decode()
+            err = self.last_error()
             lib.hvd_core_destroy(self._h)
             self._h = None
             raise RuntimeError(f"native core init failed: {err}")
+
+    def last_error(self) -> str:
+        buf = ctypes.create_string_buffer(4096)
+        n = self._lib.hvd_core_last_error(self._h, buf, 4096)
+        return buf.raw[:n].decode(errors="replace")
 
     def submit(self, name: str, sig: str, nbytes: int) -> None:
         self._lib.hvd_core_submit(self._h, name.encode(), sig.encode(),
@@ -155,23 +171,40 @@ class NativeCore:
         """None on shutdown; [] on timeout; else one agreed batch."""
         n = self._lib.hvd_core_next_batch(self._h, self._buf,
                                           self.BUF_SIZE, timeout_s)
+        if n <= -2:
+            # Buffer too small: the core retained the serialized batch
+            # (peek-then-pop), so grow and retry — never drop an
+            # agreed batch this rank's peers will execute.
+            self.BUF_SIZE = -n
+            self._buf = ctypes.create_string_buffer(self.BUF_SIZE)
+            n = self._lib.hvd_core_next_batch(self._h, self._buf,
+                                              self.BUF_SIZE, timeout_s)
         if n == -1:
             return None
-        if n == -2:
-            raise RuntimeError("native core batch exceeded buffer")
+        if n < 0:
+            raise RuntimeError(
+                "native core batch exceeded buffer after regrow")
         if n == 0:
             return []
         raw = self._buf.raw[:n]
         out = []
         for part in raw.split(ENTRY_SEP):
-            name, sig, act, err = part.split(FIELD_SEP, 3)
+            name, sig, act, neg_us, err = part.split(FIELD_SEP, 4)
             out.append(BatchEntry(name.decode(), sig.decode(),
                                   int(act.decode() or -1),
-                                  err.decode()))
+                                  err.decode(),
+                                  int(neg_us.decode() or 0)))
         return out
 
     def set_fusion_threshold(self, nbytes: int) -> None:
         self._lib.hvd_core_set_fusion_threshold(self._h, int(nbytes))
+
+    def set_cycle_time(self, ms: float) -> None:
+        self._lib.hvd_core_set_cycle_time(self._h, float(ms))
+
+    def control_bytes(self) -> int:
+        """Ready-announcement bytes this rank sent (0 on rank 0)."""
+        return self._lib.hvd_core_control_bytes(self._h)
 
     def shutdown(self) -> None:
         if self._h is not None:

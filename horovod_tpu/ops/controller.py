@@ -143,6 +143,14 @@ class PythonCore:
         with self._cv:
             self.fusion_threshold = int(nbytes)
 
+    def set_cycle_time(self, ms: float) -> None:
+        # Single-process core has no cycle sleep; accepted for API
+        # parity with NativeCore so the autotuner can push blindly.
+        self.cycle_time_ms = float(ms)
+
+    def control_bytes(self) -> int:
+        return 0  # nothing crosses a wire in-process
+
     def shutdown(self) -> None:
         with self._cv:
             self._shutdown = True
@@ -167,7 +175,21 @@ class NegotiatedController:
         self._join_result = -1
         self._error: Optional[BaseException] = None
         self._pushed_fusion = cfg.fusion_threshold
+        self._pushed_cycle = cfg.cycle_time_ms
+        self._last_cycle_mark = -1
 
+        if cfg.controller == "python" and topology.size > 1 and \
+                core is None:
+            # The in-process python core cannot negotiate across
+            # processes; honoring the knob silently with the native
+            # core would mislead (round-1 advisory).
+            raise RuntimeError(
+                "HOROVOD_CONTROLLER=python drives negotiation "
+                "in-process and is single-process only; with size "
+                f"{topology.size} use HOROVOD_CONTROLLER=native (or "
+                "auto), which requires the C++ core "
+                "(horovod_tpu/core/cc, built automatically when a "
+                "toolchain is present)")
         use_native = (topology.size > 1 or cfg.controller == "native") \
             and native.available()
         if core is not None:
@@ -185,7 +207,8 @@ class NegotiatedController:
                 stall_warn_s=(0.0 if cfg.stall_check_disable
                               else cfg.stall_check_time),
                 stall_kill_s=cfg.stall_shutdown_time,
-                connect_timeout_s=cfg.start_timeout)
+                connect_timeout_s=cfg.start_timeout,
+                cache_capacity=cfg.cache_capacity)
         elif topology.size == 1:
             self.core = PythonCore(cfg.fusion_threshold)
         else:
@@ -236,7 +259,7 @@ class NegotiatedController:
                 wire, ctxs, compression, pset, rop, prescale,
                 postscale, h, grouped)
         if self.engine.timeline is not None:
-            self.engine.timeline.enqueue(name)
+            self.engine.timeline.negotiate_start(name)
         self.core.submit(name, sig, nbytes)
         return h
 
@@ -250,7 +273,7 @@ class NegotiatedController:
                 return h
             self._pending[name] = _PendingGeneric(fn, h)
         if self.engine.timeline is not None:
-            self.engine.timeline.enqueue(name)
+            self.engine.timeline.negotiate_start(name)
         self.core.submit(name, f"g|{name}#", nbytes)
         return h
 
@@ -314,18 +337,46 @@ class NegotiatedController:
             p.handle.set_error(err)
 
     def _execute(self, batch):
+        tl = self.engine.timeline
+        local = set()
+        if tl is not None:
+            # The batch was just agreed: NEGOTIATE ends for every
+            # locally-submitted entry (a joined rank executing a
+            # zero-fill entry never opened a NEGOTIATE span — skip it
+            # to keep lanes balanced). The core measured the
+            # coordinator-side duration in e.negotiate_us; lanes use
+            # local clocks. Mark the cycle boundary if requested.
+            with self._mu:
+                local = {e.name for e in batch
+                         if e.name in self._pending}
+            cyc = self.core.cycles()
+            if cyc != self._last_cycle_mark:
+                self._last_cycle_mark = cyc
+                tl.cycle(cyc)
+            for e in batch:
+                if e.name in local:
+                    tl.negotiate_end(e.name,
+                                     negotiate_us=e.negotiate_us)
         # error entries: deliver and drop (all ranks got the same ones)
         live = []
         for e in batch:
             if e.error:
                 with self._mu:
                     p = self._pending.pop(e.name, None)
+                if tl is not None and e.name in local:
+                    tl.error_marker(e.name)
                 if p is not None:
                     p.handle.set_error(RuntimeError(e.error))
                 continue
             live.append(e)
         if not live:
             return
+        if tl is not None:
+            marked = [e for e in live if e.name in local]
+            for e in marked:
+                tl.enqueue(e.name)
+            if len(live) > 1 and marked:
+                tl.fuse(marked[0].name, len(live))
         kind = live[0].sig.split("|", 1)[0]
         if kind == "ar":
             self._execute_allreduce_batch(live)
@@ -339,6 +390,8 @@ class NegotiatedController:
             if p is None:
                 # another rank submitted a generic op this (joined)
                 # rank never will: unfabricatable -> error locally.
+                # (The coordinator errors generic ops agreed while
+                # ranks had joined, so this is a defensive path.)
                 hlog.error("agreed op '%s' was never submitted here",
                            e.name)
                 continue
@@ -348,6 +401,10 @@ class NegotiatedController:
                 p.handle.set_result(p.fn())
             except BaseException as ex:
                 p.handle.set_error(ex)
+                # synchronize() raises without reaching timeline.done,
+                # so close the DISPATCH span here on the error path.
+                if self.engine.timeline is not None:
+                    self.engine.timeline.done(e.name, error=True)
 
     def _execute_allreduce_batch(self, entries):
         """One fused launch for the whole agreed batch (the fusion
@@ -372,8 +429,8 @@ class NegotiatedController:
             else:
                 tensors.extend(p.wire)
                 slots.append((e, p, len(p.wire)))
-            if self.engine.timeline is not None:
-                self.engine.timeline.dispatched(e.name)
+                if self.engine.timeline is not None:
+                    self.engine.timeline.dispatched(e.name)
 
         tuner = self.engine.autotuner
         t0 = time.perf_counter() if tuner is not None else 0.0
@@ -399,6 +456,8 @@ class NegotiatedController:
             for e, p, cnt in slots:
                 if p is not None:
                     p.handle.set_error(ex)
+                    if self.engine.timeline is not None:
+                        self.engine.timeline.done(e.name, error=True)
             return
         if tuner is not None:
             # Autotune scores bytes-reduced/sec (reference:
@@ -413,6 +472,13 @@ class NegotiatedController:
             if tuner.fusion_threshold != self._pushed_fusion:
                 self._pushed_fusion = tuner.fusion_threshold
                 self.core.set_fusion_threshold(self._pushed_fusion)
+            if tuner.cycle_time_ms != self._pushed_cycle:
+                # The other half of the search space: the negotiation
+                # cycle period (reference: ParameterManager tuning
+                # HOROVOD_CYCLE_TIME). Only rank 0's coordinator paces
+                # agreement, but every rank's drain loop follows it.
+                self._pushed_cycle = tuner.cycle_time_ms
+                self.core.set_cycle_time(self._pushed_cycle)
 
         i = 0
         for e, p, cnt in slots:
@@ -423,6 +489,8 @@ class NegotiatedController:
             res = [p.compression.decompress(o, c)
                    for o, c in zip(outs_i, p.ctxs)]
             p.handle.set_result(res if p.grouped else res[0])
+            # success: Engine.synchronize closes the DISPATCH span
+            # when the caller collects the handle.
 
     def shutdown(self):
         self.core.shutdown()

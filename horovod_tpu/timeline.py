@@ -65,8 +65,18 @@ class Timeline:
     def negotiate_start(self, name: str) -> None:
         self._emit(name, "NEGOTIATE", "B")
 
-    def negotiate_end(self, name: str) -> None:
-        self._emit(name, "NEGOTIATE", "E")
+    def negotiate_end(self, name: str, negotiate_us: int = 0) -> None:
+        """Closes the NEGOTIATE span. negotiate_us (if provided) is
+        the coordinator-measured submit->agreed duration carried on
+        the batch entry wire format — the lane itself uses this
+        rank's local clock, so the arg is attached for diagnosis."""
+        if self._closed:
+            return
+        ev = {"name": "NEGOTIATE", "ph": "E", "pid": 0,
+              "tid": self._tid(name), "ts": self._ts_us()}
+        if negotiate_us:
+            ev["args"] = {"coordinator_negotiate_us": negotiate_us}
+        self._q.put(ev)
 
     def fuse(self, name: str, bucket: int) -> None:
         if self._closed:
@@ -85,6 +95,11 @@ class Timeline:
         """Close the QUEUE span for an op that failed before dispatch,
         keeping the trace well-formed."""
         self._emit(name, "QUEUE", "E")
+        self.error_marker(name)
+
+    def error_marker(self, name: str) -> None:
+        """Instant ERROR marker without closing any span (used for
+        negotiation-time errors, where no QUEUE span is open)."""
         if self._closed:
             return
         self._q.put({"name": "ERROR", "ph": "i", "pid": 0,
