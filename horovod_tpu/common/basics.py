@@ -123,6 +123,15 @@ def init(config_overrides: Optional[Dict[str, Any]] = None,
             _state.autotuner = Autotuner(cfg)
             _state.engine.attach_autotuner(_state.autotuner)
 
+        # Hierarchical allreduce (reference: HOROVOD_HIERARCHICAL_
+        # ALLREDUCE / NCCLHierarchicalAllreduce): factor the process
+        # axis as (slice over DCN) x (chip-within-slice over ICI)
+        # using the launcher-detected local_size.
+        from ..ops import dispatch as _dispatch
+        _dispatch.set_hierarchical(
+            _state.topology.local_size
+            if cfg.hierarchical_allreduce else 0)
+
         _state.initialized = True
         hlog.info("horovod_tpu initialized: rank=%d size=%d local_rank=%d "
                   "local_size=%d cross_rank=%d cross_size=%d devices=%d",
@@ -161,6 +170,8 @@ def shutdown() -> None:
         _state.initialized = False
         _state.process_set_table = None
         _state.topology = None
+        from ..ops import dispatch as _dispatch
+        _dispatch.set_hierarchical(0)
 
 
 atexit.register(shutdown)

@@ -58,15 +58,19 @@ def _is_bool(x) -> bool:
 # Global-array assembly: one shard per member process.
 # ---------------------------------------------------------------------------
 
-def to_global(x: jax.Array, pset: ProcessSet) -> jax.Array:
+def to_global(x: jax.Array, pset: ProcessSet, mesh=None,
+              spec=None) -> jax.Array:
     """Lift this process's tensor into a global array sharded one-row-per-
     process over the set's mesh (the frontier between the per-rank world
     and the SPMD world; analog of handing a tensor to the reference's
-    background thread)."""
+    background thread). `mesh`/`spec` override the default 1-D
+    ('proc',) layout — the hierarchical path shards the process axis
+    over ('cross', 'local') instead."""
     x = _as_local(x)
     local = jax.device_put(x[None], pset.my_device)
     shape = (pset.size,) + tuple(x.shape)
-    sharding = NamedSharding(pset.mesh, P("proc"))
+    sharding = NamedSharding(pset.mesh if mesh is None else mesh,
+                             P("proc") if spec is None else spec)
     return jax.make_array_from_single_device_arrays(shape, sharding, [local])
 
 
@@ -151,6 +155,115 @@ def _allreduce_kernel(mesh, n: int, op: int, prescale: float,
     fn = jax.shard_map(body, mesh=mesh,
                        in_specs=tuple(P("proc") for _ in sig),
                        out_specs=tuple(P("proc") for _ in sig))
+    return jax.jit(fn)
+
+
+# --- hierarchical allreduce (reference: NCCLHierarchicalAllreduce,
+# horovod/common/ops/nccl_operations.cc — NCCL within the node + MPI
+# across nodes, HOROVOD_HIERARCHICAL_ALLREDUCE). TPU mapping: the
+# 'local' mesh axis is chip-within-slice (ICI, high bandwidth), the
+# 'cross' axis is slice-over-DCN. reduce-scatter rides ICI, the
+# cross-slice allreduce moves only 1/local_size of the bytes over DCN,
+# and the allgather rides ICI again — the classic hierarchical
+# decomposition. ---------------------------------------------------------
+
+# Module-level switch set at init from HOROVOD_HIERARCHICAL_ALLREDUCE +
+# the detected topology (local_size = processes per host/slice).
+_hier_local_size = 0
+
+
+def set_hierarchical(local_size: int) -> None:
+    """Enable hierarchical allreduce with the given within-slice
+    process count; 0 disables (flat single-phase psum)."""
+    global _hier_local_size
+    _hier_local_size = int(local_size)
+
+
+def hierarchical_local_size() -> int:
+    return _hier_local_size
+
+
+def _slice_aligned(ranks: Sequence[int], L: int) -> bool:
+    """True if `ranks` factor into full, contiguous, slice-aligned
+    groups of L (each group [base, base+L) with base % L == 0) — the
+    precondition for the ('cross', 'local') mesh to reflect real
+    ICI-within / DCN-across boundaries."""
+    if L <= 1 or len(ranks) % L != 0 or len(ranks) == L:
+        return False
+    for i, r in enumerate(ranks):
+        base = ranks[i - i % L]
+        if base % L != 0 or r != base + i % L:
+            return False
+    return True
+
+
+def _hier_mesh(pset: ProcessSet):
+    """2-D ('cross', 'local') mesh for the set, or None when the knob
+    is off or the set's ranks aren't slice-aligned. Cache consulted
+    before the O(ranks) alignment scan — this runs per dispatched
+    batch."""
+    L = _hier_local_size
+    cached = getattr(pset, "_hier_mesh_cache", None)
+    if cached is not None and cached[0] == L:
+        return cached[1]
+    if not _slice_aligned(pset.ranks, L):
+        return None
+    from jax.sharding import Mesh
+    from ..common.topology import process_mesh_devices
+    devs = np.array(process_mesh_devices(pset.ranks)).reshape(
+        pset.size // L, L)
+    mesh = Mesh(devs, axis_names=("cross", "local"))
+    pset._hier_mesh_cache = (L, mesh)
+    return mesh
+
+
+@functools.lru_cache(maxsize=None)
+def _allreduce_kernel_hier(mesh, n: int, op: int, prescale: float,
+                           postscale: float, sig: Tuple):
+    """Hierarchical fused allreduce over a ('cross', 'local') mesh:
+    reduce-scatter(local) -> psum(cross) -> all-gather(local). Only
+    sum-family ops decompose this way; min/max/product take the flat
+    kernel."""
+    shapes = [s for s, _ in sig]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    total = sum(sizes)
+    local_n = mesh.shape["local"]
+    pad = (-total) % local_n
+
+    def body(*blocks):
+        flats = [b.reshape(-1) for b in blocks]
+        concat = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+        if prescale != 1.0:
+            concat = concat * jnp.asarray(prescale, concat.dtype)
+        if pad:
+            concat = jnp.pad(concat, (0, pad))
+        # Phase 1 (ICI): each chip ends with 1/local_n of the
+        # slice-local reduction.
+        chunk = lax.psum_scatter(concat, "local", scatter_dimension=0,
+                                 tiled=True)
+        # Phase 2 (DCN): cross-slice reduce of the shard only —
+        # 1/local_n of the bytes cross the slow links.
+        chunk = lax.psum(chunk, "cross")
+        # Phase 3 (ICI): reassemble the full vector within the slice.
+        red = lax.all_gather(chunk, "local", tiled=True)
+        if pad:
+            red = red[:total]
+        if op == AVERAGE:
+            red = red / jnp.asarray(n, red.dtype)
+        if postscale != 1.0:
+            red = red * jnp.asarray(postscale, red.dtype)
+        outs = []
+        off = 0
+        for s, sz in zip(shapes, sizes):
+            outs.append(red[off:off + sz].reshape((1,) + s))
+            off += sz
+        return tuple(outs)
+
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=tuple(P(("cross", "local"))
+                                      for _ in sig),
+                       out_specs=tuple(P(("cross", "local"))
+                                       for _ in sig))
     return jax.jit(fn)
 
 
@@ -257,9 +370,17 @@ def allreduce_group(tensors: List[jax.Array], pset: ProcessSet, op: int,
         return [t * jnp.asarray(scale, t.dtype) if scale != 1.0 else t
                 for t in tensors]
     sig = _sig(tensors)
-    kern = _allreduce_kernel(pset.mesh, n, op, float(prescale),
-                             float(postscale), sig)
-    gins = [to_global(t, pset) for t in tensors]
+    mesh2 = _hier_mesh(pset) if op in (SUM, AVERAGE, ADASUM) else None
+    if mesh2 is not None:
+        kern = _allreduce_kernel_hier(mesh2, n, op, float(prescale),
+                                      float(postscale), sig)
+        spec = P(("cross", "local"))
+        gins = [to_global(t, pset, mesh=mesh2, spec=spec)
+                for t in tensors]
+    else:
+        kern = _allreduce_kernel(pset.mesh, n, op, float(prescale),
+                                 float(postscale), sig)
+        gins = [to_global(t, pset) for t in tensors]
     gouts = kern(*gins)
     return [local_shard(g) for g in gouts]
 
