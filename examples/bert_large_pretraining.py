@@ -29,6 +29,11 @@ import jax.numpy as jnp
 import optax
 
 import horovod_tpu as hvd
+from horovod_tpu.callbacks import (BroadcastParametersCallback,
+                                   CallbackContext, CallbackList,
+                                   LearningRateWarmupCallback,
+                                   MetricAverageCallback,
+                                   lr_scale_schedule)
 from horovod_tpu.models import transformer as tfm
 from horovod_tpu.ops.compression import Compression
 
@@ -37,14 +42,19 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--full", action="store_true",
                     help="real BERT-Large dimensions")
-    ap.add_argument("--steps", type=int, default=5)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=3,
+                    help="steps per epoch")
     ap.add_argument("--batch-size", type=int, default=4)
     ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--warmup-epochs", type=int, default=1)
     ap.add_argument("--num-groups", type=int, default=0,
                     help="explicit fusion group count (0 = one "
                          "grouped submission; the negotiation core "
                          "re-buckets by HOROVOD_FUSION_THRESHOLD)")
     args = ap.parse_args()
+    if args.steps < 1 or args.epochs < 1:
+        ap.error("--steps and --epochs must be >= 1")
 
     hvd.init()
     if args.full:
@@ -60,33 +70,57 @@ def main():
             dtype=jnp.float32, tp_axis=None, sp_axis=None,
             ep_axis=None)
 
-    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
-    params = hvd.broadcast_parameters(params, root_rank=0)
+    params = tfm.init_params(cfg, jax.random.PRNGKey(hvd.rank()))
+
+    # Reference-style callback-driven loop (reference:
+    # horovod/_keras/callbacks.py usage in the BERT config): single-
+    # worker base lr; the warmup callback ramps lr_scale to size over
+    # --warmup-epochs; the broadcast callback makes initialization
+    # consistent (params were deliberately seeded per-rank above);
+    # metric averaging reduces the epoch loss across ranks.
+    ctx = CallbackContext(params=params)
+    cbs = CallbackList([
+        BroadcastParametersCallback(root_rank=0),
+        LearningRateWarmupCallback(warmup_epochs=args.warmup_epochs,
+                                   verbose=True),
+        MetricAverageCallback(),
+    ])
 
     # fp16 gradient compression + grouped fusion: the config's point.
+    # LR = eager schedule reading the callback-controlled scale.
     opt = hvd.DistributedOptimizer(
-        optax.adamw(1e-4 * hvd.size()),
+        optax.adamw(lr_scale_schedule(ctx, 1e-4)),
         compression=Compression.fp16,
         num_groups=args.num_groups)
-    opt_state = opt.init(params)
+
+    cbs.on_train_begin(ctx)          # broadcast initial params
+    ctx.opt_state = opt.init(ctx.params)
 
     grad_fn = jax.jit(jax.value_and_grad(
         lambda p, b: tfm.loss_fn(cfg, p, b)))
 
     key = jax.random.PRNGKey(hvd.rank())
-    for step in range(args.steps):
-        key, k = jax.random.split(key)
-        tokens = jax.random.randint(
-            k, (args.batch_size, args.seq_len), 0, cfg.vocab,
-            jnp.int32)
-        batch = {"tokens": tokens,
-                 "targets": jnp.roll(tokens, -1, axis=1)}
-        loss, grads = grad_fn(params, batch)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+    for epoch in range(args.epochs):
+        cbs.on_epoch_begin(epoch, ctx)
+        epoch_loss = 0.0
+        for step in range(args.steps):
+            key, k = jax.random.split(key)
+            tokens = jax.random.randint(
+                k, (args.batch_size, args.seq_len), 0, cfg.vocab,
+                jnp.int32)
+            batch = {"tokens": tokens,
+                     "targets": jnp.roll(tokens, -1, axis=1)}
+            loss, grads = grad_fn(ctx.params, batch)
+            updates, ctx.opt_state = opt.update(
+                grads, ctx.opt_state, ctx.params)
+            ctx.params = optax.apply_updates(ctx.params, updates)
+            epoch_loss += float(loss)
+        metrics = cbs.on_epoch_end(
+            epoch, {"loss": epoch_loss / args.steps}, ctx)
         if hvd.rank() == 0:
             n_tensors = len(jax.tree_util.tree_leaves(grads))
-            print(f"step {step}: loss {float(loss):.3f} "
+            print(f"epoch {epoch}: avg loss {metrics['loss']:.3f} "
+                  f"lr_scale={ctx.lr_scale:.2f} "
                   f"({n_tensors} gradient tensors fused via fp16)")
     hvd.shutdown()
 

@@ -42,6 +42,7 @@ from .optim.functions import (  # noqa: F401
     allreduce_parameters,
 )
 from . import elastic  # noqa: F401
+from . import callbacks  # noqa: F401
 
 __version__ = "0.1.0"
 

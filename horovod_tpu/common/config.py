@@ -46,9 +46,10 @@ KNOBS: List[Knob] = [
     Knob("HOROVOD_HIERARCHICAL_ALLREDUCE", _parse_bool, False,
          "Use hierarchical allreduce: reduce-scatter over ICI within a "
          "slice, allreduce over DCN across slices, allgather over ICI."),
-    Knob("HOROVOD_BATCH_D2D_MEMCOPIES", _parse_bool, True,
-         "Batch bucket gather/scatter copies into single fused XLA "
-         "executables rather than per-tensor dispatches."),
+    # (HOROVOD_BATCH_D2D_MEMCOPIES and HOROVOD_NUM_NCCL_STREAMS have no
+    # TPU analog — XLA fuses bucket gather/scatter copies and owns the
+    # launch lanes. Deliberately NOT declared: a knob that silently
+    # does nothing is worse than an unknown-variable warning.)
     # -- controller / backends ----------------------------------------------
     Knob("HOROVOD_CONTROLLER", str, "auto",
          "Control-plane implementation: 'native' (C++ core), 'python' "
@@ -118,9 +119,6 @@ KNOBS: List[Knob] = [
     Knob("HOROVOD_START_TIMEOUT", float, 30.0,
          "Seconds each rank waits for the coordination service to come "
          "up at init before aborting (set by hvdrun --start-timeout)."),
-    Knob("HOROVOD_NUM_STREAMS", int, 1,
-         "Number of independent collective launch lanes for the eager "
-         "engine (the reference's HOROVOD_NUM_NCCL_STREAMS analog)."),
 ]
 
 _KNOBS_BY_ENV: Dict[str, Knob] = {k.env: k for k in KNOBS}
@@ -187,7 +185,6 @@ class Config:
         "control_addr": "HOROVOD_CONTROL_ADDR",
         "control_timeout": "HOROVOD_GLOO_TIMEOUT_SECONDS",
         "start_timeout": "HOROVOD_START_TIMEOUT",
-        "num_streams": "HOROVOD_NUM_STREAMS",
     }
 
     def __getattr__(self, name: str) -> Any:

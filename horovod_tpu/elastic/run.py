@@ -50,16 +50,15 @@ def run(func: Callable) -> Callable:
             hlog.info("elastic: resumed from snapshot")
         reset_limit = int(os.environ.get("HOROVOD_ELASTIC_RESET_LIMIT", 0))
         resets = 0
-        first = True
         while True:
-            # sync() runs at the top of EVERY attempt (reference:
-            # horovod/torch/elastic/__init__.py run) — this is what
-            # folds freshly-added workers into the broadcast: old
-            # ranks arrive here after re-init, new ranks on first
-            # entry, and the rank-0 state wins for everyone.
-            if not first or os.environ.get("HOROVOD_ELASTIC") == "1":
-                state.sync()
-            first = False
+            # sync() runs at the top of EVERY attempt, including the
+            # first (reference: horovod/torch/elastic/__init__.py run)
+            # — this is what folds freshly-added workers into the
+            # broadcast AND corrects divergent per-rank initial state
+            # (rank-dependent init, stale local snapshots) even when
+            # the script was launched with the plain non-elastic
+            # launcher.
+            state.sync()
             try:
                 return func(state, *args, **kwargs)
             except HorovodInternalError:
