@@ -75,8 +75,9 @@ long long hvd_core_last_error(void* h, char* buf, long long bufsize) {
 }
 
 void hvd_core_submit(void* h, const char* name, const char* sig,
-                     long long nbytes) {
-  static_cast<CoreHandle*>(h)->ctrl.Submit(name, sig, nbytes);
+                     long long nbytes, const char* meta) {
+  static_cast<CoreHandle*>(h)->ctrl.Submit(name, sig, nbytes,
+                                           meta ? meta : "");
 }
 
 void hvd_core_join(void* h) {
@@ -102,7 +103,7 @@ long long hvd_core_control_bytes(void* h) {
 // agreed order must be executed on every rank).
 // Batch encoding: entries joined by '\x1e', fields by '\x1f':
 //   name '\x1f' sig '\x1f' active_ranks '\x1f' negotiate_us
-//   '\x1f' error
+//   '\x1f' meta '\x1f' error
 long long hvd_core_next_batch(void* h, char* buf, long long bufsize,
                               double timeout_s) {
   CoreHandle* ch = static_cast<CoreHandle*>(h);
@@ -120,6 +121,8 @@ long long hvd_core_next_batch(void* h, char* buf, long long bufsize,
       out += std::to_string(entries[i].active_ranks);
       out.push_back('\x1f');
       out += std::to_string(entries[i].negotiate_us);
+      out.push_back('\x1f');
+      out += entries[i].meta;
       out.push_back('\x1f');
       out += entries[i].error;
     }

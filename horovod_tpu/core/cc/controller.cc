@@ -22,7 +22,19 @@ Controller::Controller(const ControllerOptions& opts) : opts_(opts) {
   cycle_time_ms_.store(opts.cycle_time_ms);
   if (opts_.size > 1) {
     if (opts_.rank == 0) {
-      listen_fd_ = ListenOn(opts_.coord_port, opts_.size + 4);
+      // Bounded bind retry: the launcher probes the port before
+      // handing it out (TOCTOU), and elastic restarts can race the
+      // previous epoch's listener tearing down. Workers retry their
+      // connect within connect_timeout_s, so a few seconds of bind
+      // retries here removes the flake without masking a genuinely
+      // taken port.
+      double deadline =
+          NowSeconds() + std::min(opts_.connect_timeout_s / 2.0, 10.0);
+      do {
+        listen_fd_ = ListenOn(opts_.coord_port, opts_.size + 4);
+        if (listen_fd_ < 0) usleep(200000);
+      } while (listen_fd_ < 0 && NowSeconds() < deadline &&
+               !shutdown_.load());
       if (listen_fd_ < 0) {
         SetError("failed to listen on control port " +
                  std::to_string(opts_.coord_port));
@@ -100,13 +112,15 @@ void Controller::Shutdown() {
 }
 
 void Controller::Submit(const std::string& name, const std::string& sig,
-                        int64_t nbytes) {
+                        int64_t nbytes, const std::string& meta) {
   Request r;
   // Response-cache hit (reference: ResponseCache::Lookup): a
   // previously-negotiated (name, sig) collapses to its 5-byte id.
   // Only worth it on ranks that serialize over the wire; rank 0's
   // requests go to its own coordinator without serialization.
-  if (opts_.rank != 0 && opts_.cache_capacity > 0) {
+  // Requests carrying metadata (uneven allgather sizes / alltoall
+  // splits — values that vary per call) always go the full path.
+  if (opts_.rank != 0 && opts_.cache_capacity > 0 && meta.empty()) {
     std::lock_guard<std::mutex> clk(cache_mu_);
     auto it = submit_cache_.find(name);
     if (it != submit_cache_.end() && it->second.sig == sig)
@@ -116,6 +130,7 @@ void Controller::Submit(const std::string& name, const std::string& sig,
     r.name = name;
     r.sig = sig;
     r.nbytes = nbytes;
+    r.meta = meta;
   }
   std::lock_guard<std::mutex> lk(submit_mu_);
   pending_.push_back(std::move(r));
@@ -221,6 +236,7 @@ void Controller::CoordinatorIngest(int rank, std::vector<Request> reqs) {
       st.nbytes = r.nbytes;
       st.first_seen = now;
       st.ready_ranks.insert(rank);
+      if (!r.meta.empty()) st.metas[rank] = r.meta;
       tensors_.emplace(r.name, std::move(st));
     } else {
       TensorState& st = it->second;
@@ -231,6 +247,7 @@ void Controller::CoordinatorIngest(int rank, std::vector<Request> reqs) {
                    "'s '" + r.sig + "'";
       }
       st.ready_ranks.insert(rank);
+      if (!r.meta.empty()) st.metas[rank] = r.meta;
     }
     TensorState& st = tensors_[r.name];
     // Ready when every non-joined rank has submitted. Joined ranks
@@ -304,6 +321,18 @@ void Controller::RunCoordinatorCycle() {
                      " rank(s) had joined";
         }
         e.error = st.error;
+        // Aggregate per-rank metadata into the agreed entry
+        // (reference: the controller assembling uneven allgather
+        // sizes from the Requests into the Response).
+        if (!st.metas.empty()) {
+          std::string agg;
+          for (int rr = 0; rr < opts_.size; ++rr) {
+            if (rr) agg.push_back(';');
+            auto mi = st.metas.find(rr);
+            if (mi != st.metas.end()) agg += mi->second;
+          }
+          e.meta = std::move(agg);
+        }
         if (st.fully_ready_at >= st.first_seen)
           e.negotiate_us = static_cast<uint32_t>(
               (st.fully_ready_at - st.first_seen) * 1e6);

@@ -75,7 +75,7 @@ class Controller {
   // "dtype|op|shape..." and doubles as the fuse key prefix
   // (everything before the first '#').
   void Submit(const std::string& name, const std::string& sig,
-              int64_t nbytes);
+              int64_t nbytes, const std::string& meta = "");
   // Announce this rank is done submitting (reference: hvd.join()).
   void Join();
 
@@ -160,6 +160,7 @@ class Controller {
     std::string sig;
     int64_t nbytes = 0;
     std::set<int> ready_ranks;
+    std::map<int, std::string> metas;  // per-rank request metadata
     double first_seen = 0.0;
     double fully_ready_at = 0.0;
     bool error_sent = false;

@@ -47,6 +47,13 @@ struct Request {
   int64_t nbytes = 0;
   bool join = false;  // a Join pseudo-request (reference: RequestType JOIN)
   uint32_t cache_id = 0;  // response-cache hit marker (0 = full request)
+  // Per-rank metadata the coordinator aggregates into the agreed
+  // entry (reference: Request carrying tensor shapes so the
+  // controller can size uneven allgathers). Used for uneven
+  // allgather row counts / alltoall split vectors; must not contain
+  // ';'. Non-empty meta bypasses the response cache (it varies per
+  // call).
+  std::string meta;
 };
 
 // One agreed execution entry (reference: Response). Batches are runs
@@ -63,6 +70,8 @@ struct Entry {
                              // name->id mapping from delivered entries
   uint32_t negotiate_us = 0;  // coordinator-measured submit->agreed
                               // time (feeds the timeline NEGOTIATE lane)
+  std::string meta;  // ';'-joined per-world-rank request metadata
+                     // (empty slots for ranks that sent none)
 };
 
 class Buf {
@@ -183,6 +192,7 @@ inline std::string SerializeRequests(const std::vector<Request>& reqs) {
     b.PutStr(r.sig);
     b.PutU64(static_cast<uint64_t>(r.nbytes));
     b.PutU8(r.join ? 1 : 0);
+    b.PutStr(r.meta);
   }
   return b.data();
 }
@@ -205,7 +215,7 @@ inline bool ParseRequests(const std::string& d, std::vector<Request>* out) {
     uint64_t nb;
     uint8_t j;
     if (!rd.GetStr(&r.name) || !rd.GetStr(&r.sig) || !rd.GetU64(&nb) ||
-        !rd.GetU8(&j))
+        !rd.GetU8(&j) || !rd.GetStr(&r.meta))
       return false;
     r.nbytes = static_cast<int64_t>(nb);
     r.join = j != 0;
@@ -225,6 +235,7 @@ inline std::string SerializeEntries(const std::vector<Entry>& es) {
     b.PutStr(e.error);
     b.PutU32(e.cache_id);
     b.PutU32(e.negotiate_us);
+    b.PutStr(e.meta);
   }
   return b.data();
 }
@@ -240,7 +251,8 @@ inline bool ParseEntries(const std::string& d, std::vector<Entry>* out) {
     uint32_t bid, act;
     if (!rd.GetStr(&e.name) || !rd.GetStr(&e.sig) || !rd.GetU32(&bid) ||
         !rd.GetU32(&act) || !rd.GetStr(&e.error) ||
-        !rd.GetU32(&e.cache_id) || !rd.GetU32(&e.negotiate_us))
+        !rd.GetU32(&e.cache_id) || !rd.GetU32(&e.negotiate_us) ||
+        !rd.GetStr(&e.meta))
       return false;
     e.batch_id = static_cast<int32_t>(bid);
     e.active_ranks = static_cast<int32_t>(act);

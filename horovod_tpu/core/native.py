@@ -78,7 +78,7 @@ def load():
             lib.hvd_core_last_error.restype = ctypes.c_longlong
             lib.hvd_core_submit.argtypes = [
                 ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
-                ctypes.c_longlong]
+                ctypes.c_longlong, ctypes.c_char_p]
             lib.hvd_core_join.argtypes = [ctypes.c_void_p]
             lib.hvd_core_all_joined.argtypes = [ctypes.c_void_p]
             lib.hvd_core_all_joined.restype = ctypes.c_int
@@ -105,20 +105,25 @@ def available() -> bool:
 
 class BatchEntry:
     __slots__ = ("name", "sig", "active_ranks", "error",
-                 "negotiate_us")
+                 "negotiate_us", "meta")
 
     def __init__(self, name: str, sig: str, active_ranks: int,
-                 error: str, negotiate_us: int = 0):
+                 error: str, negotiate_us: int = 0, meta: str = ""):
         self.name = name
         self.sig = sig
         self.active_ranks = active_ranks
         self.error = error
         self.negotiate_us = negotiate_us
+        self.meta = meta
+
+    def metas(self) -> List[str]:
+        """Per-world-rank request metadata (';'-joined on the wire)."""
+        return self.meta.split(";") if self.meta else []
 
     def __repr__(self):
         return (f"BatchEntry({self.name}, {self.sig}, "
                 f"act={self.active_ranks}, err={self.error!r}, "
-                f"neg_us={self.negotiate_us})")
+                f"neg_us={self.negotiate_us}, meta={self.meta!r})")
 
 
 class NativeCore:
@@ -152,9 +157,10 @@ class NativeCore:
         n = self._lib.hvd_core_last_error(self._h, buf, 4096)
         return buf.raw[:n].decode(errors="replace")
 
-    def submit(self, name: str, sig: str, nbytes: int) -> None:
+    def submit(self, name: str, sig: str, nbytes: int,
+               meta: str = "") -> None:
         self._lib.hvd_core_submit(self._h, name.encode(), sig.encode(),
-                                  nbytes)
+                                  nbytes, meta.encode())
 
     def join(self) -> None:
         self._lib.hvd_core_join(self._h)
@@ -189,11 +195,12 @@ class NativeCore:
         raw = self._buf.raw[:n]
         out = []
         for part in raw.split(ENTRY_SEP):
-            name, sig, act, neg_us, err = part.split(FIELD_SEP, 4)
+            name, sig, act, neg_us, meta, err = part.split(FIELD_SEP, 5)
             out.append(BatchEntry(name.decode(), sig.decode(),
                                   int(act.decode() or -1),
                                   err.decode(),
-                                  int(neg_us.decode() or 0)))
+                                  int(neg_us.decode() or 0),
+                                  meta.decode()))
         return out
 
     def set_fusion_threshold(self, nbytes: int) -> None:

@@ -16,6 +16,7 @@ import urllib.request
 from typing import Optional
 
 from ..common import logging as hlog
+from ..runner import secret as _secret
 from . import notifications
 
 _listener: Optional["NotificationListener"] = None
@@ -44,7 +45,17 @@ class NotificationListener:
                 return
             try:
                 data = conn.recv(65536)
-                info = json.loads(data.decode()) if data else None
+                msg = json.loads(data.decode()) if data else {}
+                payload = msg.get("payload", "")
+                if not _secret.verify(_secret.from_env(),
+                                      payload.encode(),
+                                      msg.get("sig", "")):
+                    hlog.warning(
+                        "elastic: rejected unsigned/missigned "
+                        "notification poke")
+                    conn.sendall(b"denied")
+                    continue
+                info = json.loads(payload) if payload else None
                 hlog.info("elastic: hosts-updated notification: %s", info)
                 notifications.notify(info)
                 conn.sendall(b"ok")
@@ -79,9 +90,12 @@ def register_with_rendezvous() -> None:
     port = start_listener()
     me = os.environ.get("HOROVOD_HOSTNAME", socket.gethostname())
     lr = os.environ.get("HOROVOD_LOCAL_RANK", "0")
-    url = f"http://{addr}/notify/{me}/{lr}"
+    path = f"/notify/{me}/{lr}"
+    body = json.dumps({"port": port}).encode()
     req = urllib.request.Request(
-        url, data=json.dumps({"port": port}).encode(), method="PUT")
+        f"http://{addr}{path}", data=body, method="PUT",
+        headers={_secret.HEADER: _secret.sign(
+            _secret.from_env(), path.encode() + body)})
     try:
         with urllib.request.urlopen(req, timeout=10) as resp:
             resp.read()
@@ -98,8 +112,12 @@ def refresh_env_from_rendezvous() -> None:
         return
     me = os.environ.get("HOROVOD_HOSTNAME", socket.gethostname())
     lr = os.environ.get("HOROVOD_LOCAL_RANK", "0")
-    url = f"http://{addr}/rank/{me}/{lr}"
-    with urllib.request.urlopen(url, timeout=30) as resp:
+    path = f"/rank/{me}/{lr}"
+    req = urllib.request.Request(
+        f"http://{addr}{path}",
+        headers={_secret.HEADER: _secret.sign(
+            _secret.from_env(), path.encode())})
+    with urllib.request.urlopen(req, timeout=30) as resp:
         assignment = json.loads(resp.read().decode())
     for k, v in assignment.items():
         os.environ[k] = str(v)

@@ -224,11 +224,23 @@ def allgather_async(tensor, name: Optional[str] = None,
     if t.ndim == 0:
         t = t[None]
 
+    if st.engine.controller is not None:
+        # Uneven first-dim sizes ride the negotiation Request metadata
+        # and come back aggregated on the agreed entry (reference: the
+        # controller sizing uneven allgathers from Request shapes) —
+        # no separate data-plane exchange, no host sync per call.
+        def fn_meta(metas):
+            sizes = [int(metas[r]) for r in pset.ranks]
+            return dispatch.allgather(t, pset, sizes)
+
+        return st.engine.controller.submit_generic(
+            name, _nbytes([t]), fn_meta, meta=str(t.shape[0])).id
+
     def fn():
         sizes = dispatch.exchange_int_vector([t.shape[0]], pset)[:, 0]
         return dispatch.allgather(t, pset, [int(s) for s in sizes])
 
-    return _run(st, name, _nbytes([t]), fn)
+    return st.engine.run(name, _nbytes([t]), fn).id
 
 
 def allgather(tensor, name=None, process_set=None) -> jax.Array:
@@ -286,6 +298,23 @@ def alltoall_async(tensor, splits: Optional[Sequence[int]] = None,
     if sum(splits) != t.shape[0]:
         raise ValueError("splits must sum to the first dimension")
 
+    if st.engine.controller is not None:
+        # Split vectors ride the negotiation metadata (see
+        # allgather_async): fn receives every rank's splits.
+        def fn_meta(metas):
+            me = pset.rank()
+            mat = [[int(x) for x in metas[r].split(",")]
+                   for r in pset.ranks]
+            recv = [mat[src][me] for src in range(n)]
+            maxsplit = max(max(max(row) for row in mat), 1)
+            out = dispatch.alltoall(t, splits, recv, pset,
+                                    maxsplit=maxsplit)
+            return out, jnp.asarray(recv, jnp.int32)
+
+        return st.engine.controller.submit_generic(
+            name, _nbytes([t]), fn_meta,
+            meta=",".join(str(s) for s in splits)).id
+
     def fn():
         mat = dispatch.exchange_int_vector(splits, pset)   # (n, n)
         me = pset.rank()
@@ -296,7 +325,7 @@ def alltoall_async(tensor, splits: Optional[Sequence[int]] = None,
         out = dispatch.alltoall(t, splits, recv, pset, maxsplit=maxsplit)
         return out, jnp.asarray(recv, jnp.int32)
 
-    return _run(st, name, _nbytes([t]), fn)
+    return st.engine.run(name, _nbytes([t]), fn).id
 
 
 def alltoall(tensor, splits=None, name=None, process_set=None):

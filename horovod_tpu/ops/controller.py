@@ -74,11 +74,12 @@ class _PendingAllreduce:
 
 
 class _PendingGeneric:
-    __slots__ = ("fn", "handle")
+    __slots__ = ("fn", "handle", "wants_meta")
 
-    def __init__(self, fn, handle):
+    def __init__(self, fn, handle, wants_meta=False):
         self.fn = fn
         self.handle = handle
+        self.wants_meta = wants_meta  # fn takes the per-rank metas list
 
 
 class PythonCore:
@@ -95,10 +96,12 @@ class PythonCore:
         self._shutdown = False
         self._cycles = 0
 
-    def submit(self, name: str, sig: str, nbytes: int) -> None:
+    def submit(self, name: str, sig: str, nbytes: int,
+               meta: str = "") -> None:
         with self._cv:
+            # single process: the aggregated meta is just our own
             self._pending.append(
-                (native.BatchEntry(name, sig, 1, ""), nbytes))
+                (native.BatchEntry(name, sig, 1, "", 0, meta), nbytes))
             self._cv.notify_all()
 
     def join(self) -> None:
@@ -264,17 +267,25 @@ class NegotiatedController:
         return h
 
     def submit_generic(self, name: str, nbytes: int,
-                       fn: Callable[[], Any]) -> Any:
+                       fn: Callable[..., Any],
+                       meta: Optional[str] = None) -> Any:
+        """Submit a non-allreduce op. With `meta` set, the string is
+        carried in the Request, aggregated per-rank by the
+        coordinator, and `fn` is called with the list of all ranks'
+        metas — the negotiation-level metadata exchange the reference
+        uses for uneven allgather sizing (no separate data-plane
+        collective needed)."""
         h = self.engine.new_handle(name)
         with self._mu:
             if name in self._pending:
                 h.set_error(ValueError(
                     f"a collective named '{name}' is already pending"))
                 return h
-            self._pending[name] = _PendingGeneric(fn, h)
+            self._pending[name] = _PendingGeneric(
+                fn, h, wants_meta=meta is not None)
         if self.engine.timeline is not None:
             self.engine.timeline.negotiate_start(name)
-        self.core.submit(name, f"g|{name}#", nbytes)
+        self.core.submit(name, f"g|{name}#", nbytes, meta or "")
         return h
 
     def join(self, timeout_s: Optional[float] = None) -> int:
@@ -398,7 +409,10 @@ class NegotiatedController:
             if self.engine.timeline is not None:
                 self.engine.timeline.dispatched(e.name)
             try:
-                p.handle.set_result(p.fn())
+                if p.wants_meta:
+                    p.handle.set_result(p.fn(e.metas()))
+                else:
+                    p.handle.set_result(p.fn())
             except BaseException as ex:
                 p.handle.set_error(ex)
                 # synchronize() raises without reaching timeline.done,

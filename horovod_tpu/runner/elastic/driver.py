@@ -35,6 +35,7 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ...common import logging as hlog
+from .. import secret as _secret
 from ..hosts import HostSlots, RankInfo, assign_ranks
 from ..launch import _prefix_pump, _ssh_command, free_port
 from .discovery import HostDiscovery, hosts_key
@@ -68,7 +69,11 @@ class ElasticDriver:
         self.base_env = dict(env if env is not None else os.environ)
         self.verbose = verbose
 
-        self.rendezvous = RendezvousServer()
+        # Per-job HMAC key: signs rendezvous HTTP requests and the
+        # driver->worker notification pokes (reference:
+        # runner/common/util/secret.py).
+        self.secret = _secret.make_secret()
+        self.rendezvous = RendezvousServer(secret=self.secret)
         self.epoch = 0
         self.resets = 0
         self.slots: Dict[Tuple[str, int], _Slot] = {}
@@ -107,6 +112,7 @@ class ElasticDriver:
             env["HOROVOD_HOSTNAME"] = info.host
             env["HOROVOD_RENDEZVOUS_ADDR"] = \
                 f"{self._my_addr(info)}:{self.rendezvous.port}"
+            env[_secret.ENV_VAR] = self.secret
             table[(info.host, info.local_rank)] = env
         return infos, table
 
@@ -153,8 +159,12 @@ class ElasticDriver:
             try:
                 with socket.create_connection((host, port),
                                               timeout=5) as s:
-                    s.sendall(json.dumps(
-                        {"epoch": self.epoch}).encode())
+                    payload = json.dumps({"epoch": self.epoch})
+                    s.sendall(json.dumps({
+                        "payload": payload,
+                        "sig": _secret.sign(self.secret,
+                                            payload.encode()),
+                    }).encode())
                     s.recv(16)
             except OSError as e:
                 hlog.debug("elastic: notify %s:%d failed: %s", host,

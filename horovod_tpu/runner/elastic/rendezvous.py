@@ -12,6 +12,13 @@ Endpoints:
   GET /world                     -> {"epoch": N, "size": M}
   PUT /notify/<host>/<local_rank> body={"port": p} -> register the
                                     worker's notification listener
+
+Every request must carry an HMAC of the path (GET) or path+body (PUT)
+in the X-HVD-Auth header, keyed on the launcher-generated job secret
+(reference: horovod/runner/common/util/secret.py — the reference's
+launcher RPCs are HMAC-authenticated the same way). Unsigned or
+missigned requests get 403 — rank assignments and notification
+registrations are not writable by arbitrary network peers.
 """
 
 from __future__ import annotations
@@ -21,12 +28,15 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
+from .. import secret as _secret
+
 
 class _State:
     def __init__(self):
         self.lock = threading.Lock()
         self.epoch = 0
         self.size = 0
+        self.secret = ""
         # (host, local_rank) -> env dict
         self.assignments: Dict[Tuple[str, int], Dict[str, str]] = {}
         # (host, local_rank) -> notify port
@@ -47,7 +57,15 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _authorized(self, body: bytes = b"") -> bool:
+        sig = self.headers.get(_secret.HEADER, "")
+        return _secret.verify(self.state.secret,
+                              self.path.encode() + body, sig)
+
     def do_GET(self):
+        if not self._authorized():
+            self._json(403, {"error": "bad or missing signature"})
+            return
         parts = [p for p in self.path.split("/") if p]
         st = self.state
         if len(parts) == 3 and parts[0] == "rank":
@@ -65,11 +83,15 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(404, {"error": "not found"})
 
     def do_PUT(self):
+        n = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(n)
+        if not self._authorized(raw):
+            self._json(403, {"error": "bad or missing signature"})
+            return
         parts = [p for p in self.path.split("/") if p]
         st = self.state
         if len(parts) == 3 and parts[0] == "notify":
-            n = int(self.headers.get("Content-Length", 0))
-            body = json.loads(self.rfile.read(n).decode() or "{}")
+            body = json.loads(raw.decode() or "{}")
             key = (parts[1], int(parts[2]))
             with st.lock:
                 st.notify_ports[key] = int(body.get("port", 0))
@@ -79,8 +101,9 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class RendezvousServer:
-    def __init__(self, port: int = 0):
+    def __init__(self, port: int = 0, secret: str = ""):
         self._state = _State()
+        self._state.secret = secret
         handler = type("Handler", (_Handler,), {"state": self._state})
         self._httpd = ThreadingHTTPServer(("0.0.0.0", port), handler)
         self.port = self._httpd.server_address[1]

@@ -26,6 +26,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from . import secret as _secret
 from .hosts import RankInfo, assign_ranks, parse_hosts
 
 # Env vars forwarded to workers in addition to explicitly-set ones
@@ -104,11 +105,17 @@ def run(command: List[str], np_: int = 1, hosts: Optional[str] = None,
     lock = threading.Lock()
     sinks = []
 
+    # Per-job HMAC key, forwarded to every rank (HOROVOD_ prefix is in
+    # the ssh export list); any launcher-side service a worker talks to
+    # authenticates with it (reference: secret.py in the reference
+    # launcher, used by its driver/task/rendezvous RPCs).
+    job_secret = _secret.make_secret()
     try:
         for info in infos:
             child_env = build_env(info, coordinator, env)
             child_env["HOROVOD_CONTROL_ADDR"] = control
             child_env["HOROVOD_START_TIMEOUT"] = str(start_timeout)
+            child_env[_secret.ENV_VAR] = job_secret
             if info.is_local:
                 cmd = command
                 popen_env = child_env
@@ -212,6 +219,13 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command")
     return p
+
+
+def cli() -> None:
+    """Console-script entry point (`hvdrun`, installed by
+    pyproject.toml; reference: the horovodrun entry point in
+    setup.py)."""
+    sys.exit(main())
 
 
 def main(argv: Optional[List[str]] = None) -> int:

@@ -1,0 +1,36 @@
+"""Build hook: prebuild the native control-plane core into the wheel.
+
+Reference analog: Horovod's cmake-driven build_ext in setup.py
+(SURVEY.md §2.5), scaled to this project's single dependency-free
+shared library. Metadata lives in pyproject.toml; this file only adds
+the best-effort `make` so installed environments don't need a compiler
+at runtime (horovod_tpu/core/native.py still falls back to a lazy
+in-tree build when the .so is absent).
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildWithNativeCore(build_py):
+    def run(self):
+        ccdir = Path(__file__).parent / "horovod_tpu" / "core" / "cc"
+        try:
+            r = subprocess.run(["make", "-C", str(ccdir)],
+                               capture_output=True, timeout=600)
+            if r.returncode != 0:
+                print("warning: native core prebuild failed "
+                      "(runtime lazy build will retry):\n"
+                      + r.stderr.decode(errors="replace"),
+                      file=sys.stderr)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            print(f"warning: native core prebuild skipped: {e}",
+                  file=sys.stderr)
+        super().run()
+
+
+setup(cmdclass={"build_py": BuildWithNativeCore})
