@@ -1,0 +1,266 @@
+"""Launcher-side driver service: task registration, NIC routability
+probing, coordinator-address election, and remote worker launch.
+
+Reference: horovod/runner/driver/driver_service.py
+(HorovodRunDriverService + _run_probe: start task servers on every
+host over ssh, wait for them to register with their NIC addresses,
+probe which interfaces are mutually routable, and only then launch the
+per-rank commands with the working interface pinned). TPU redesign:
+the probe's product is the **coordinator address** — the rank-0 host
+address every worker can route to, handed to
+`jax.distributed.initialize` and the native control plane — plus the
+common interface set exported as HOROVOD_IFACE for diagnostics. The
+data plane needs no NIC pinning (ICI/DCN via PJRT), so the gloo-iface
+machinery collapses to this one election.
+"""
+
+from __future__ import annotations
+
+import shlex
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..common import logging as hlog
+from . import network
+from . import secret as _secret
+from .hosts import RankInfo
+from .service import BasicClient, BasicService
+
+
+class TaskRecord:
+    def __init__(self, host_id: str, peer_addr: str, port: int,
+                 addrs: Dict[str, List[str]]):
+        self.host_id = host_id
+        self.peer_addr = peer_addr      # source addr of the register call
+        self.port = port                # task service port
+        self.addrs = addrs              # iface -> [ip, ...]
+        self.routable: List[str] = []   # driver-reachable ips
+
+    def candidates(self) -> List[str]:
+        """Addresses to try for this host, most-specific first: the
+        address it registered from, then every advertised NIC."""
+        seen, out = set(), []
+        for a in [self.peer_addr] + \
+                [ip for lst in self.addrs.values() for ip in lst]:
+            if a not in seen:
+                seen.add(a)
+                out.append(a)
+        return out
+
+
+class DriverService:
+    """The launcher's registration/exit-collection RPC endpoint."""
+
+    def __init__(self, secret: str, num_hosts: int):
+        self._secret = secret
+        self._num_hosts = num_hosts
+        self.tasks: Dict[str, TaskRecord] = {}
+        self._exit_codes: Dict[int, int] = {}      # rank -> code
+        self._cv = threading.Condition()
+        self.service = BasicService("driver", secret)
+        self.service.handle("register", self._on_register)
+        self.service.handle("task_exit", self._on_task_exit)
+
+    @property
+    def port(self) -> int:
+        return self.service.port
+
+    def _on_register(self, req: dict, peer) -> dict:
+        rec = TaskRecord(str(req["host_id"]), peer[0],
+                         int(req["port"]), req.get("addrs", {}))
+        with self._cv:
+            self.tasks[rec.host_id] = rec
+            self._cv.notify_all()
+        hlog.info("driver: task %s registered from %s (service port %d)",
+                  rec.host_id, rec.peer_addr, rec.port)
+        return {"ok": True}
+
+    def _on_task_exit(self, req: dict, peer) -> dict:
+        with self._cv:
+            self._exit_codes[int(req["rank"])] = int(req["code"])
+            self._cv.notify_all()
+        return {"ok": True}
+
+    # -- lifecycle -----------------------------------------------------
+
+    def wait_for_registration(self, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while len(self.tasks) < self._num_hosts:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    missing = self._num_hosts - len(self.tasks)
+                    raise TimeoutError(
+                        f"driver: {missing} task service(s) failed to "
+                        f"register within {timeout:.0f}s "
+                        f"(got: {sorted(self.tasks)})")
+                self._cv.wait(timeout=min(left, 1.0))
+
+    def probe(self, timeout: float = 2.0) -> None:
+        """Driver→task reachability: mark which of each task's
+        addresses the launcher can open (reference: _run_probe).
+        Probed with one thread per host so launch startup pays the
+        slowest host, not the sum of every dead address timeout."""
+        def probe_one(rec: TaskRecord) -> None:
+            rec.routable = [
+                a for a in rec.candidates()
+                if network.probe(a, rec.port, timeout)
+            ]
+        threads = [threading.Thread(target=probe_one, args=(rec,),
+                                    daemon=True)
+                   for rec in self.tasks.values()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for rec in self.tasks.values():
+            if not rec.routable:
+                raise RuntimeError(
+                    f"driver: host {rec.host_id} registered but none of "
+                    f"its addresses {rec.candidates()} accept "
+                    "connections from the launcher")
+
+    def common_interfaces(self) -> List[str]:
+        """Interface names advertised by every host — the reference's
+        common-NIC set handed to gloo; here informational
+        (HOROVOD_IFACE)."""
+        names: Optional[set] = None
+        for rec in self.tasks.values():
+            s = set(rec.addrs)
+            names = s if names is None else (names & s)
+        return sorted(names or [])
+
+    def elect_coordinator(self, rank0_host_id: str,
+                          timeout: float = 2.0) -> str:
+        """Pick a rank-0-host address every OTHER task can route to:
+        ask each task to TCP-probe rank 0's candidate addresses
+        against its task-service port, and take the first address in
+        rank 0's preference order that everyone reached."""
+        rank0 = self.tasks[rank0_host_id]
+        cands = [a for a in rank0.routable] or rank0.candidates()
+        alive: Dict[str, int] = {a: 0 for a in cands}
+        others = [r for r in self.tasks.values()
+                  if r.host_id != rank0_host_id]
+        lock = threading.Lock()
+
+        def ask(rec: TaskRecord) -> None:
+            cli = BasicClient(rec.routable[0], rec.port, self._secret,
+                              timeout=10.0)
+            reply = cli.try_request({
+                "type": "probe",
+                "targets": [[a, rank0.port] for a in cands],
+                "timeout": timeout,
+            }) or {}
+            got = {a for a, _ in reply.get("reachable", [])}
+            with lock:
+                for a in got:
+                    if a in alive:
+                        alive[a] += 1
+
+        threads = [threading.Thread(target=ask, args=(r,), daemon=True)
+                   for r in others]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for a in cands:
+            if alive[a] == len(others):
+                return a
+        raise RuntimeError(
+            f"driver: no rank-0 address in {cands} is reachable from "
+            "every host — check firewalls/interfaces")
+
+    def run_ranks(self, command: List[str], cwd: str,
+                  by_host: Dict[str, List[Tuple[RankInfo,
+                                                Dict[str, str]]]],
+                  output_filename: Optional[str] = None) -> None:
+        for host_id, ranks in by_host.items():
+            rec = self.tasks[host_id]
+            cli = BasicClient(rec.routable[0], rec.port, self._secret,
+                              timeout=30.0)
+            reply = cli.request({
+                "type": "run",
+                "command": command,
+                "cwd": cwd,
+                "output": output_filename,
+                "ranks": [{"rank": info.rank, "env": env}
+                          for info, env in ranks],
+            })
+            if not reply or not reply.get("ok"):
+                raise RuntimeError(
+                    f"driver: host {host_id} refused run: {reply}")
+
+    def exit_codes(self) -> Dict[int, int]:
+        with self._cv:
+            return dict(self._exit_codes)
+
+    def wait(self, num_ranks: int, poll: float = 0.5,
+             liveness=None) -> int:
+        """Block until every rank reported an exit code; on the first
+        nonzero, shut all tasks down and return it. `liveness` (if
+        given) is polled between waits and may return a nonzero exit
+        code to abort on — the launcher uses it to detect a task
+        service that died before reporting its ranks (ssh drop, host
+        crash), which would otherwise hang this wait forever."""
+        dead_rc: Optional[int] = None
+        while True:
+            with self._cv:
+                if len(self._exit_codes) >= num_ranks:
+                    break
+                if any(c for c in self._exit_codes.values()):
+                    break
+                self._cv.wait(timeout=poll)
+            if liveness is not None:
+                dead_rc = liveness()
+                if dead_rc is not None:
+                    break
+        codes = self.exit_codes()
+        bad = [(r, c) for r, c in sorted(codes.items()) if c != 0]
+        if bad:
+            hlog.error("driver: rank %d exited with code %d; "
+                       "shutting down remaining ranks",
+                       bad[0][0], bad[0][1])
+            self.shutdown_tasks()
+            return bad[0][1]
+        if dead_rc is not None and len(codes) < num_ranks:
+            hlog.error("driver: a task service died before its ranks "
+                       "reported (have %d/%d exit codes); aborting",
+                       len(codes), num_ranks)
+            self.shutdown_tasks()
+            return dead_rc
+        return 0
+
+    def shutdown_tasks(self) -> None:
+        for rec in self.tasks.values():
+            if rec.routable:
+                BasicClient(rec.routable[0], rec.port, self._secret,
+                            timeout=5.0).try_request({"type": "shutdown"})
+
+    def close(self) -> None:
+        self.service.close()
+
+
+def spawn_task_service(host: str, host_id: str, driver_addrs: str,
+                       job_secret: str, cwd: str,
+                       ssh_port: Optional[int] = None,
+                       is_local: bool = False) -> subprocess.Popen:
+    """Start a task service on `host` (subprocess locally, ssh
+    remotely) — reference: the driver ssh'ing task servers onto every
+    host before launch. The remote path reuses launch._ssh_command so
+    secret handling (stdin, never argv) has a single implementation."""
+    from .launch import _ssh_command, _write_secret_stdin
+    inner = [sys.executable, "-m", "horovod_tpu.runner.task_service",
+             host_id, driver_addrs]
+    if is_local:
+        import os
+        env = dict(os.environ)
+        env[_secret.ENV_VAR] = job_secret
+        return subprocess.Popen(inner, env=env, cwd=cwd)
+    cmd = _ssh_command(host, inner, {"PYTHONPATH": cwd}, ssh_port,
+                       secret_on_stdin=True)
+    p = subprocess.Popen(cmd, stdin=subprocess.PIPE)
+    _write_secret_stdin(p, job_secret)
+    return p

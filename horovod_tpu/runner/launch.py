@@ -60,18 +60,42 @@ def build_env(info: RankInfo, coordinator: str,
     return env
 
 
-def _ssh_command(info: RankInfo, command: List[str],
-                 env: Dict[str, str], ssh_port: Optional[int]) -> List[str]:
+def _ssh_command(host: str, command: List[str],
+                 env: Dict[str, str], ssh_port: Optional[int],
+                 secret_on_stdin: bool = False) -> List[str]:
+    """Build the remote exec command. The job secret NEVER rides the
+    argv (argv is world-readable via /proc on the remote host, which
+    would hand the HMAC key to any local user): with secret_on_stdin
+    the remote shell reads it from the ssh stdin pipe instead, and the
+    caller feeds it with _write_secret_stdin after spawn. This is THE
+    ssh assembly point — every remote spawn (static launch, elastic
+    driver, task services) goes through it so secret handling has one
+    implementation."""
     exports = " ".join(
         f"{k}={shlex.quote(v)}" for k, v in sorted(env.items())
-        if k.startswith(FORWARD_PREFIXES))
-    remote = f"cd {shlex.quote(os.getcwd())} && env {exports} " + \
+        if k.startswith(FORWARD_PREFIXES) and k != _secret.ENV_VAR)
+    prefix = ""
+    if secret_on_stdin:
+        prefix = (f"IFS= read -r {_secret.ENV_VAR}; "
+                  f"export {_secret.ENV_VAR}; ")
+    remote = f"{prefix}cd {shlex.quote(os.getcwd())} && env {exports} " + \
         " ".join(shlex.quote(c) for c in command)
     cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
     if ssh_port:
         cmd += ["-p", str(ssh_port)]
-    cmd += [info.host, remote]
+    cmd += [host, remote]
     return cmd
+
+
+def _write_secret_stdin(p: subprocess.Popen, secret: str) -> None:
+    """Feed the job secret to a remote child started with
+    secret_on_stdin. A child that died instantly is tolerated — its
+    exit surfaces through the caller's normal failure path."""
+    try:
+        p.stdin.write((secret + "\n").encode())
+        p.stdin.close()
+    except OSError:
+        pass
 
 
 def run(command: List[str], np_: int = 1, hosts: Optional[str] = None,
@@ -120,14 +144,19 @@ def run(command: List[str], np_: int = 1, hosts: Optional[str] = None,
                 cmd = command
                 popen_env = child_env
             else:
-                cmd = _ssh_command(info, command, child_env, ssh_port)
+                cmd = _ssh_command(info.host, command, child_env,
+                                   ssh_port, secret_on_stdin=True)
                 popen_env = dict(os.environ)
             if verbose:
                 print(f"[launcher] rank {info.rank} on {info.host}: "
                       f"{' '.join(cmd)}", file=sys.stderr)
             p = subprocess.Popen(cmd, env=popen_env,
+                                 stdin=(None if info.is_local
+                                        else subprocess.PIPE),
                                  stdout=subprocess.PIPE,
                                  stderr=subprocess.PIPE)
+            if not info.is_local:
+                _write_secret_stdin(p, job_secret)
             procs.append(p)
             if output_filename:
                 fo = open(f"{output_filename}.{info.rank}.out", "wb")
@@ -183,6 +212,108 @@ def _file_pump(stream, f):
     stream.close()
 
 
+def run_with_driver(command: List[str], np_: int = 1,
+                    hosts: Optional[str] = None,
+                    env: Optional[Dict[str, str]] = None,
+                    output_filename: Optional[str] = None,
+                    ssh_port: Optional[int] = None,
+                    start_timeout: float = 30.0,
+                    verbose: bool = False) -> int:
+    """Probed launch path (reference: horovodrun's default flow through
+    driver_service.py): start a task service on every host, wait for
+    registration, probe NIC routability, elect the coordinator address
+    every worker can route to, then launch ranks through the task
+    services. Worker output flows back through each task service's ssh
+    pipe with rank prefixes; exit codes come back as task_exit RPCs.
+    """
+    from . import driver_service as ds
+
+    if not command:
+        raise ValueError("no command to run")
+    hostslots = parse_hosts(hosts, np_)
+    infos = assign_ranks(hostslots, np_)
+    job_secret = _secret.make_secret()
+    host_ids = []                       # distinct hosts, rank order
+    for info in infos:
+        if info.host not in host_ids:
+            host_ids.append(info.host)
+
+    driver = ds.DriverService(job_secret, num_hosts=len(host_ids))
+    task_procs: List[subprocess.Popen] = []
+    try:
+        # Candidate driver addresses a task may reach us on: loopback
+        # (local tasks) + every local NIC, all on the driver port.
+        from . import network
+        cand = ",".join(f"{a}:{driver.port}"
+                        for a in network.flat_addresses(
+                            include_loopback=True))
+        from .hosts import LOCALHOSTS
+        for hid in host_ids:
+            is_local = hid in LOCALHOSTS
+            task_procs.append(ds.spawn_task_service(
+                hid, hid, cand, job_secret, os.getcwd(),
+                ssh_port=ssh_port, is_local=is_local))
+        driver.wait_for_registration(timeout=start_timeout)
+        driver.probe()
+        ifaces = driver.common_interfaces()
+        rank0_host = infos[0].host
+        if len(host_ids) > 1:
+            coord_addr = driver.elect_coordinator(rank0_host)
+        else:
+            coord_addr = "localhost"
+        if verbose:
+            print(f"[launcher] driver: hosts={host_ids} "
+                  f"common_ifaces={ifaces} coordinator={coord_addr}",
+                  file=sys.stderr)
+
+        coordinator = f"{coord_addr}:{free_port()}"
+        control = f"{coord_addr}:{free_port()}"
+        base = {k: v for k, v in (env or os.environ).items()
+                if k.startswith(FORWARD_PREFIXES)}
+        by_host: Dict[str, list] = {}
+        for info in infos:
+            child = dict(base)
+            child.update(info.env())
+            child["HOROVOD_COORDINATOR_ADDR"] = coordinator
+            child["HOROVOD_CONTROL_ADDR"] = control
+            child["HOROVOD_START_TIMEOUT"] = str(start_timeout)
+            # No HOROVOD_SECRET here: the run RPC crosses the network
+            # unencrypted; each task service injects its own copy
+            # (received at spawn over ssh stdin) into the worker env.
+            if ifaces:
+                child["HOROVOD_IFACE"] = ",".join(ifaces)
+            by_host.setdefault(info.host, []).append((info, child))
+        # output_filename: files are written on each RANK's host by its
+        # task service (remote ranks' logs stay remote).
+        driver.run_ranks(command, os.getcwd(), by_host,
+                         output_filename=output_filename)
+
+        def liveness() -> Optional[int]:
+            # A task service that exited while any of its ranks has no
+            # reported exit code means the ssh pipe / host died — abort
+            # instead of waiting forever for task_exit RPCs.
+            have = driver.exit_codes()
+            for hid, p in zip(host_ids, task_procs):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                ranks = [info.rank for info, _ in by_host.get(hid, [])]
+                if any(r not in have for r in ranks):
+                    return rc if rc != 0 else 1
+            return None
+
+        return driver.wait(num_ranks=len(infos), liveness=liveness)
+    finally:
+        driver.shutdown_tasks()
+        driver.close()
+        deadline = time.monotonic() + 10.0
+        for p in task_procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
 def make_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
         prog="hvdrun",
@@ -199,6 +330,11 @@ def make_parser() -> argparse.ArgumentParser:
                         "stdout/stderr")
     p.add_argument("--ssh-port", type=int, default=None)
     p.add_argument("--start-timeout", type=float, default=30.0)
+    p.add_argument("--driver", action="store_true",
+                   help="launch through per-host task services with "
+                        "NIC routability probing (reference: the "
+                        "driver/task service flow in horovodrun); "
+                        "default is direct ssh exec")
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--check-build", action="store_true",
                    help="print the capability matrix and exit")
@@ -216,9 +352,116 @@ def make_parser() -> argparse.ArgumentParser:
                    default=1.0)
     p.add_argument("--reset-limit", type=int, default=0)
     p.add_argument("--elastic-timeout", type=float, default=600.0)
+
+    # Tuning/diagnostic flags mirroring HOROVOD_* env knobs, forwarded
+    # to every rank (reference: horovodrun's ~80-flag surface in
+    # runner/launch.py parse_args — each maps 1:1 onto the env var the
+    # core reads, exactly as the reference forwards them).
+    tune = p.add_argument_group(
+        "tuning knobs (forwarded to workers as HOROVOD_* env)")
+    tune.add_argument("--fusion-threshold-bytes", type=int, default=None,
+                      dest="fusion_threshold",
+                      help="tensor-fusion bucket size in bytes "
+                           "(HOROVOD_FUSION_THRESHOLD; 0 disables)")
+    tune.add_argument("--cycle-time-ms", type=float, default=None,
+                      help="background engine cycle time "
+                           "(HOROVOD_CYCLE_TIME)")
+    tune.add_argument("--cache-capacity", type=int, default=None,
+                      help="response-cache entries, 0 disables "
+                           "(HOROVOD_CACHE_CAPACITY)")
+    tune.add_argument("--hierarchical-allreduce", action="store_true",
+                      default=None,
+                      help="ICI reduce-scatter + DCN allreduce + ICI "
+                           "allgather (HOROVOD_HIERARCHICAL_ALLREDUCE)")
+    tune.add_argument("--timeline-filename", default=None,
+                      help="Chrome-trace JSON output path, rank 0 "
+                           "(HOROVOD_TIMELINE)")
+    tune.add_argument("--timeline-mark-cycles", action="store_true",
+                      default=None,
+                      help="mark engine cycles in the timeline "
+                           "(HOROVOD_TIMELINE_MARK_CYCLES)")
+    tune.add_argument("--autotune", action="store_true", default=None,
+                      help="enable online autotuning "
+                           "(HOROVOD_AUTOTUNE)")
+    tune.add_argument("--autotune-log-file", default=None,
+                      help="CSV of autotune samples "
+                           "(HOROVOD_AUTOTUNE_LOG)")
+    tune.add_argument("--autotune-warmup-samples", type=int,
+                      default=None,
+                      help="HOROVOD_AUTOTUNE_WARMUP_SAMPLES")
+    tune.add_argument("--autotune-steps-per-sample", type=int,
+                      default=None,
+                      help="HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE")
+    tune.add_argument("--no-stall-check", action="store_true",
+                      default=None,
+                      help="disable the stall inspector "
+                           "(HOROVOD_STALL_CHECK_DISABLE)")
+    tune.add_argument("--stall-check-time-seconds", type=float,
+                      default=None,
+                      help="HOROVOD_STALL_CHECK_TIME_SECONDS")
+    tune.add_argument("--stall-shutdown-time-seconds", type=float,
+                      default=None,
+                      help="HOROVOD_STALL_SHUTDOWN_TIME_SECONDS")
+    tune.add_argument("--log-level", default=None,
+                      choices=["trace", "debug", "info", "warning",
+                               "error", "fatal"],
+                      help="HOROVOD_LOG_LEVEL")
+    tune.add_argument("--log-hide-timestamp", action="store_true",
+                      default=None,
+                      help="drop timestamps from log lines "
+                           "(HOROVOD_LOG_TIMESTAMP=0)")
+    tune.add_argument("--gloo-timeout-seconds", type=float, default=None,
+                      help="control-plane message timeout "
+                           "(HOROVOD_GLOO_TIMEOUT_SECONDS; name kept "
+                           "from the reference)")
+    tune.add_argument("--controller", default=None,
+                      choices=["auto", "native", "python"],
+                      help="control-plane implementation "
+                           "(HOROVOD_CONTROLLER)")
     p.add_argument("command", nargs=argparse.REMAINDER,
                    help="training command")
     return p
+
+
+# (flag attribute name, env var, formatter) for the tuning group.
+_FLAG_ENV_MAP = [
+    ("fusion_threshold", "HOROVOD_FUSION_THRESHOLD", str),
+    ("cycle_time_ms", "HOROVOD_CYCLE_TIME", str),
+    ("cache_capacity", "HOROVOD_CACHE_CAPACITY", str),
+    ("hierarchical_allreduce", "HOROVOD_HIERARCHICAL_ALLREDUCE",
+     lambda v: "1"),
+    ("timeline_filename", "HOROVOD_TIMELINE", str),
+    ("timeline_mark_cycles", "HOROVOD_TIMELINE_MARK_CYCLES",
+     lambda v: "1"),
+    ("autotune", "HOROVOD_AUTOTUNE", lambda v: "1"),
+    ("autotune_log_file", "HOROVOD_AUTOTUNE_LOG", str),
+    ("autotune_warmup_samples", "HOROVOD_AUTOTUNE_WARMUP_SAMPLES", str),
+    ("autotune_steps_per_sample", "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE",
+     str),
+    ("no_stall_check", "HOROVOD_STALL_CHECK_DISABLE", lambda v: "1"),
+    ("stall_check_time_seconds", "HOROVOD_STALL_CHECK_TIME_SECONDS",
+     str),
+    ("stall_shutdown_time_seconds",
+     "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", str),
+    ("log_level", "HOROVOD_LOG_LEVEL", str),
+    ("log_hide_timestamp", "HOROVOD_LOG_TIMESTAMP", lambda v: "0"),
+    ("gloo_timeout_seconds", "HOROVOD_GLOO_TIMEOUT_SECONDS", str),
+    ("controller", "HOROVOD_CONTROLLER", str),
+]
+
+
+def env_from_flags(args: argparse.Namespace,
+                   base: Optional[Dict[str, str]] = None
+                   ) -> Dict[str, str]:
+    """Worker env = launcher env + every explicitly-set tuning flag
+    rendered to its HOROVOD_* variable (reference: horovodrun flags
+    forwarded as env in gloo_run/mpi_run -x)."""
+    env = dict(base if base is not None else os.environ)
+    for attr, var, fmt in _FLAG_ENV_MAP:
+        val = getattr(args, attr, None)
+        if val is not None:
+            env[var] = fmt(val)
+    return env
 
 
 def cli() -> None:
@@ -240,6 +483,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not command:
         print("error: no command given", file=sys.stderr)
         return 2
+    env = env_from_flags(args)
     if args.host_discovery_script:
         from .elastic import ElasticDriver, HostDiscoveryScript
         min_np = args.min_num_proc if args.min_num_proc is not None \
@@ -251,9 +495,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             poll_interval=args.host_change_detection_interval,
             reset_limit=args.reset_limit,
             elastic_timeout=args.elastic_timeout,
+            env=env,
             verbose=args.verbose)
         return driver.run()
+    if args.driver:
+        return run_with_driver(
+            command, np_=args.num_proc, hosts=args.hosts,
+            env=env, output_filename=args.output_filename,
+            ssh_port=args.ssh_port,
+            start_timeout=args.start_timeout, verbose=args.verbose)
     return run(command, np_=args.num_proc, hosts=args.hosts,
+               env=env,
                output_filename=args.output_filename,
                ssh_port=args.ssh_port,
                start_timeout=args.start_timeout, verbose=args.verbose)

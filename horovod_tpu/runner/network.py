@@ -1,0 +1,81 @@
+"""Local network-interface enumeration and routability probing.
+
+Reference: horovod/runner/util/network.py (get_local_host_addresses,
+resolve_host_address, and the driver's routed-interface matching in
+runner/driver/driver_service.py _run_probe). The reference probes which
+NICs are mutually routable between the driver and every task server so
+gloo/NCCL can be pinned to a working interface; here the same probe
+picks the coordinator bind address for `jax.distributed.initialize`
+and the native control plane, and exports HOROVOD_IFACE for
+diagnostics.
+
+No psutil/netifaces dependency: interfaces are read from `ip -o -4
+addr show` (Linux, always present in the target image) with a
+getaddrinfo + UDP-connect fallback.
+"""
+
+from __future__ import annotations
+
+import socket
+import subprocess
+from typing import Dict, List, Optional, Tuple
+
+
+def local_addresses() -> Dict[str, List[str]]:
+    """Map interface name -> IPv4 addresses, loopback excluded
+    (reference: get_local_host_addresses)."""
+    out: Dict[str, List[str]] = {}
+    try:
+        r = subprocess.run(["ip", "-o", "-4", "addr", "show"],
+                           capture_output=True, text=True, timeout=10)
+        for line in r.stdout.splitlines():
+            # "2: eth0    inet 10.0.0.5/24 brd ..." — fields are
+            # index, iface, "inet", addr/prefix.
+            parts = line.split()
+            if len(parts) < 4 or parts[2] != "inet":
+                continue
+            iface, addr = parts[1], parts[3].split("/")[0]
+            if iface == "lo" or addr.startswith("127."):
+                continue
+            out.setdefault(iface, []).append(addr)
+    except (OSError, subprocess.TimeoutExpired):
+        pass
+    if not out:
+        # Fallback: whatever address a UDP connect to a public IP
+        # would source from (no packet is sent).
+        try:
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.connect(("8.8.8.8", 53))
+                out["default"] = [s.getsockname()[0]]
+        except OSError:
+            pass
+    return out
+
+
+def flat_addresses(include_loopback: bool = False) -> List[str]:
+    addrs = [a for lst in local_addresses().values() for a in lst]
+    if include_loopback:
+        addrs.append("127.0.0.1")
+    return addrs
+
+
+def probe(addr: str, port: int, timeout: float = 2.0) -> bool:
+    """TCP-connect reachability check (reference: the driver's probe of
+    each task address)."""
+    try:
+        with socket.create_connection((addr, port), timeout=timeout):
+            return True
+    except OSError:
+        return False
+
+
+def reachable(addrs: List[Tuple[str, int]],
+              timeout: float = 2.0) -> List[Tuple[str, int]]:
+    return [(a, p) for a, p in addrs if probe(a, p, timeout)]
+
+
+def resolve_host_address(host: str) -> Optional[str]:
+    try:
+        return socket.gethostbyname(host)
+    except OSError:
+        return None

@@ -1,0 +1,186 @@
+"""HMAC-authenticated TCP RPC for launcher-side services.
+
+Reference: horovod/runner/common/service/__init__.py (BasicService /
+BasicClient — length-prefixed pickled messages authenticated with the
+per-job secret from secret.py) and horovod/runner/common/util/network.py
+(Wire). Redesigned: JSON instead of pickle (no code execution on the
+wire), 4-byte big-endian length prefix, every frame carries an
+HMAC-SHA256 signature over the payload under the job secret
+(secret.py), unauthenticated frames are dropped with a "denied" reply.
+
+Used by the driver service (driver_service.py) and the per-host task
+services (task_service.py) that the launcher starts over ssh before
+spawning worker ranks.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from ..common import logging as hlog
+from . import secret as _secret
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 16 << 20
+
+
+class WireError(RuntimeError):
+    pass
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise WireError("connection closed mid-frame")
+        buf += chunk
+    return buf
+
+
+def send_frame(sock: socket.socket, secret: str, obj: Any) -> None:
+    payload = json.dumps(obj).encode()
+    frame = json.dumps({
+        "payload": payload.decode(),
+        "sig": _secret.sign(secret, payload),
+    }).encode()
+    sock.sendall(_LEN.pack(len(frame)) + frame)
+
+
+def recv_frame(sock: socket.socket, secret: str) -> Any:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > MAX_FRAME:
+        raise WireError(f"frame too large ({n} bytes)")
+    msg = json.loads(_recv_exact(sock, n).decode())
+    payload = msg.get("payload", "")
+    if not _secret.verify(secret, payload.encode(), msg.get("sig", "")):
+        raise WireError("bad signature")
+    return json.loads(payload) if payload else None
+
+
+class BasicService:
+    """Threaded TCP server dispatching signed JSON requests.
+
+    Handlers are registered per message ``type``; each receives the
+    decoded request dict and the peer address and returns a JSON-able
+    reply object. A request that fails signature verification gets a
+    ``{"error": "denied"}`` reply and is never dispatched.
+    """
+
+    def __init__(self, name: str, secret: str, port: int = 0):
+        self.name = name
+        self._secret = secret
+        self._handlers: Dict[str, Callable[[dict, Tuple[str, int]], Any]] = {}
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port))
+        self._sock.listen(64)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._accept_loop, name=f"hvd-{name}", daemon=True)
+        self._thread.start()
+
+    def handle(self, msg_type: str,
+               fn: Callable[[dict, Tuple[str, int]], Any]) -> None:
+        self._handlers[msg_type] = fn
+
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                conn, peer = self._sock.accept()
+            except OSError:
+                return
+            if self._stop:  # the close() wake-up connection
+                conn.close()
+                return
+            t = threading.Thread(target=self._serve_one,
+                                 args=(conn, peer), daemon=True)
+            t.start()
+
+    def _serve_one(self, conn: socket.socket,
+                   peer: Tuple[str, int]) -> None:
+        with conn:
+            try:
+                # Bound the read: a peer that connects and sends
+                # nothing (or a truncated header) must not pin this
+                # handler thread forever.
+                conn.settimeout(30.0)
+                req = recv_frame(conn, self._secret)
+            except socket.timeout:
+                hlog.warning("%s service: request from %s timed out",
+                             self.name, peer[0])
+                return
+            except WireError as e:
+                hlog.warning("%s service: rejected request from %s: %s",
+                             self.name, peer[0], e)
+                try:
+                    send_frame(conn, self._secret, {"error": "denied"})
+                except OSError:
+                    pass
+                return
+            mtype = (req or {}).get("type", "")
+            fn = self._handlers.get(mtype)
+            if fn is None:
+                reply: Any = {"error": f"unknown type {mtype!r}"}
+            else:
+                try:
+                    reply = fn(req, peer)
+                except Exception as e:  # noqa: BLE001 — reply, don't die
+                    hlog.error("%s service: handler %s failed: %s",
+                               self.name, mtype, e)
+                    reply = {"error": str(e)}
+            try:
+                send_frame(conn, self._secret, reply)
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._stop = True
+        # Closing the listening fd does NOT interrupt a blocked
+        # accept() on Linux — the thread would sit on the stale fd
+        # number forever, and when the kernel REUSES that fd for a
+        # later listener, the zombie thread steals the new service's
+        # connections (observed: a fresh driver service losing
+        # task_exit RPCs to a closed one). Wake it with a dummy
+        # connection, then join before closing the socket.
+        try:
+            with socket.create_connection(("127.0.0.1", self.port),
+                                          timeout=1):
+                pass
+        except OSError:
+            pass
+        self._thread.join(timeout=2)
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class BasicClient:
+    """One-shot request/response client for a BasicService."""
+
+    def __init__(self, addr: str, port: int, secret: str,
+                 timeout: float = 10.0):
+        self._addr = (addr, port)
+        self._secret = secret
+        self._timeout = timeout
+
+    def request(self, obj: dict) -> Any:
+        with socket.create_connection(self._addr,
+                                      timeout=self._timeout) as s:
+            send_frame(s, self._secret, obj)
+            reply = recv_frame(s, self._secret)
+        if isinstance(reply, dict) and reply.get("error") == "denied":
+            raise WireError("request denied (bad signature)")
+        return reply
+
+    def try_request(self, obj: dict) -> Optional[Any]:
+        try:
+            return self.request(obj)
+        except (OSError, WireError):
+            return None

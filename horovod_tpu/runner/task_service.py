@@ -1,0 +1,196 @@
+"""Per-host task service: registers with the launcher's driver
+service, answers probe requests, and execs worker ranks on command.
+
+Reference: horovod/runner/task/task_service.py +
+runner/common/service/task_service.py (HorovodRunTaskService — one per
+host, started over ssh by the driver before any worker runs; it
+reports the host's NIC addresses, participates in the routability
+probe, then runs the per-rank commands). Redesigned on the JSON/HMAC
+RPC in service.py; worker stdout/stderr is pumped to the task
+service's own stdout/stderr with rank prefixes so it flows back
+through the launcher's ssh pipe, and per-rank exit codes are pushed to
+the driver as `task_exit` messages.
+
+Run as:  python -m horovod_tpu.runner.task_service <host_id> <driver_addrs>
+with HOROVOD_SECRET in the env (driver_addrs = comma-separated
+host:port candidates for the driver service; the first reachable one
+wins).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from . import network
+from . import secret as _secret
+from .service import BasicClient, BasicService
+
+
+class TaskService:
+    def __init__(self, host_id: str, driver_addrs: List[Tuple[str, int]],
+                 secret: str):
+        self.host_id = host_id
+        self._secret = secret
+        self._driver_addrs = driver_addrs
+        self._driver: Optional[BasicClient] = None
+        self._procs: List[subprocess.Popen] = []
+        self._ranks: List[int] = []
+        self._done = threading.Event()
+        self._lock = threading.Lock()
+        self.service = BasicService(f"task[{host_id}]", secret)
+        self.service.handle("ping", lambda req, peer: {"ok": True})
+        self.service.handle("probe", self._on_probe)
+        self.service.handle("run", self._on_run)
+        self.service.handle("shutdown", self._on_shutdown)
+
+    # -- registration --------------------------------------------------
+
+    def register(self, timeout: float = 30.0) -> None:
+        """Find a reachable driver address and register this host's
+        interfaces + service port (reference: task servers registering
+        back with HorovodRunDriverService)."""
+        deadline = time.monotonic() + timeout
+        last_err = "no driver addresses"
+        while time.monotonic() < deadline:
+            for addr, port in self._driver_addrs:
+                cli = BasicClient(addr, port, self._secret, timeout=5.0)
+                reply = cli.try_request({
+                    "type": "register",
+                    "host_id": self.host_id,
+                    "port": self.service.port,
+                    "addrs": network.local_addresses(),
+                })
+                if reply and reply.get("ok"):
+                    self._driver = cli
+                    return
+                last_err = f"driver at {addr}:{port} not reachable"
+            time.sleep(0.25)
+        raise RuntimeError(f"task {self.host_id}: registration failed: "
+                           f"{last_err}")
+
+    # -- handlers ------------------------------------------------------
+
+    def _on_probe(self, req: dict, peer) -> dict:
+        """Report which of the given (addr, port) endpoints this host
+        can open a TCP connection to — the driver uses this to pick a
+        coordinator address every worker can route to."""
+        targets = [(str(a), int(p)) for a, p in req.get("targets", [])]
+        ok = network.reachable(targets,
+                               timeout=float(req.get("timeout", 2.0)))
+        return {"reachable": ok}
+
+    def _on_run(self, req: dict, peer) -> dict:
+        command = [str(c) for c in req["command"]]
+        cwd = req.get("cwd") or None
+        # With output set, each rank's streams go to
+        # <output>.<rank>.{out,err} on THIS host (the rank's host)
+        # instead of back through the ssh pipe — the --driver analog
+        # of hvdrun --output-filename.
+        output = req.get("output") or None
+        with self._lock:
+            if self._procs:
+                return {"error": "already running"}
+            for rankspec in req["ranks"]:
+                env = dict(os.environ)
+                env.update({str(k): str(v)
+                            for k, v in rankspec["env"].items()})
+                # The job secret never rides the run RPC (cleartext
+                # TCP); inject this task's own copy, received at
+                # spawn time via ssh stdin / local env.
+                if self._secret:
+                    env[_secret.ENV_VAR] = self._secret
+                rank = int(rankspec["rank"])
+                if output:
+                    fo = open(f"{output}.{rank}.out", "wb")
+                    fe = open(f"{output}.{rank}.err", "wb")
+                    p = subprocess.Popen(command, env=env, cwd=cwd,
+                                         stdout=fo, stderr=fe)
+                    fo.close(); fe.close()
+                else:
+                    p = subprocess.Popen(command, env=env, cwd=cwd,
+                                         stdout=subprocess.PIPE,
+                                         stderr=subprocess.PIPE)
+                    for stream, sink in ((p.stdout, sys.stdout),
+                                         (p.stderr, sys.stderr)):
+                        threading.Thread(target=self._pump,
+                                         args=(stream, rank, sink),
+                                         daemon=True).start()
+                self._procs.append(p)
+                self._ranks.append(rank)
+                threading.Thread(target=self._wait_one,
+                                 args=(p, rank), daemon=True).start()
+        return {"ok": True, "started": len(self._procs)}
+
+    def _on_shutdown(self, req: dict, peer) -> dict:
+        with self._lock:
+            for p in self._procs:
+                if p.poll() is None:
+                    p.terminate()
+        self._done.set()
+        return {"ok": True}
+
+    # -- worker plumbing ----------------------------------------------
+
+    @staticmethod
+    def _pump(stream, rank: int, sink) -> None:
+        for raw in iter(stream.readline, b""):
+            line = raw.decode("utf-8", "replace")
+            sink.write(f"[{rank}]{line}")
+            sink.flush()
+        stream.close()
+
+    def _wait_one(self, p: subprocess.Popen, rank: int) -> None:
+        rc = p.wait()
+        if self._driver is not None:
+            self._driver.try_request({
+                "type": "task_exit",
+                "host_id": self.host_id,
+                "rank": rank,
+                "code": rc,
+            })
+        with self._lock:
+            if all(q.poll() is not None for q in self._procs):
+                self._done.set()
+
+    def serve_forever(self, idle_timeout: float = 600.0) -> int:
+        """Block until all workers exited (or shutdown); returns the
+        first nonzero worker exit code, else 0. idle_timeout bounds a
+        driver that never sends `run`."""
+        start = time.monotonic()
+        while not self._done.wait(timeout=0.5):
+            with self._lock:
+                running = bool(self._procs)
+            if not running and time.monotonic() - start > idle_timeout:
+                return 1
+        codes = [p.poll() for p in self._procs]
+        for c in codes:
+            if c:
+                return c
+        return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) < 2:
+        print("usage: task_service <host_id> <driver_host:port[,...]>",
+              file=sys.stderr)
+        return 2
+    host_id = argv[0]
+    driver_addrs = []
+    for part in argv[1].split(","):
+        h, p = part.rsplit(":", 1)
+        driver_addrs.append((h, int(p)))
+    svc = TaskService(host_id, driver_addrs, _secret.from_env())
+    svc.register()
+    rc = svc.serve_forever()
+    svc.service.close()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,203 @@
+"""Driver/task service layer: signed RPC wire, NIC enumeration and
+probing, registration, coordinator election, and the probed launch
+path end-to-end on localhost.
+
+Reference test analog: test/single/test_service.py (driver/task RPC)
+and test_run.py's driver-flow coverage in the reference suite.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.runner import network
+from horovod_tpu.runner import secret as _secret
+from horovod_tpu.runner.driver_service import DriverService
+from horovod_tpu.runner.service import (BasicClient, BasicService,
+                                        WireError, recv_frame,
+                                        send_frame)
+from horovod_tpu.runner.task_service import TaskService
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestWire:
+    def test_roundtrip(self):
+        a, b = socket.socketpair()
+        send_frame(a, "key", {"x": [1, 2, 3]})
+        assert recv_frame(b, "key") == {"x": [1, 2, 3]}
+        a.close(); b.close()
+
+    def test_bad_secret_rejected(self):
+        a, b = socket.socketpair()
+        send_frame(a, "key1", {"x": 1})
+        with pytest.raises(WireError):
+            recv_frame(b, "key2")
+        a.close(); b.close()
+
+
+class TestBasicService:
+    def test_dispatch_and_denial(self):
+        svc = BasicService("t", "sekrit")
+        svc.handle("echo", lambda req, peer: {"got": req["v"]})
+        try:
+            ok = BasicClient("127.0.0.1", svc.port, "sekrit")
+            assert ok.request({"type": "echo", "v": 7}) == {"got": 7}
+            bad = BasicClient("127.0.0.1", svc.port, "wrong")
+            with pytest.raises(WireError):
+                bad.request({"type": "echo", "v": 7})
+            assert ok.request({"type": "nope"})["error"].startswith(
+                "unknown")
+        finally:
+            svc.close()
+
+
+class TestNetwork:
+    def test_local_addresses_shape(self):
+        addrs = network.local_addresses()
+        assert isinstance(addrs, dict)
+        for iface, ips in addrs.items():
+            assert isinstance(iface, str) and isinstance(ips, list)
+            assert all(not ip.startswith("127.") for ip in ips)
+
+    def test_probe(self):
+        lst = socket.socket()
+        lst.bind(("127.0.0.1", 0))
+        lst.listen(1)
+        port = lst.getsockname()[1]
+        try:
+            assert network.probe("127.0.0.1", port, timeout=2.0)
+        finally:
+            lst.close()
+        assert not network.probe("127.0.0.1", port, timeout=0.5)
+
+
+class TestDriverTaskFlow:
+    """In-process driver + two task services over loopback — the
+    registration → probe → election → run → exit-collection flow."""
+
+    def _mk(self, n_hosts=2):
+        sec = _secret.make_secret()
+        driver = DriverService(sec, num_hosts=n_hosts)
+        tasks = []
+        for hid in ["hostA", "hostB"][:n_hosts]:
+            t = TaskService(hid, [("127.0.0.1", driver.port)], sec)
+            t.register(timeout=10.0)
+            tasks.append(t)
+        return sec, driver, tasks
+
+    def test_register_probe_elect(self):
+        sec, driver, tasks = self._mk()
+        try:
+            driver.wait_for_registration(timeout=10.0)
+            assert set(driver.tasks) == {"hostA", "hostB"}
+            driver.probe()
+            for rec in driver.tasks.values():
+                assert rec.routable, "loopback must be routable"
+            coord = driver.elect_coordinator("hostA")
+            assert coord in driver.tasks["hostA"].candidates()
+        finally:
+            for t in tasks:
+                t.service.close()
+            driver.close()
+
+    def test_registration_timeout_lists_missing(self):
+        sec = _secret.make_secret()
+        driver = DriverService(sec, num_hosts=2)
+        try:
+            with pytest.raises(TimeoutError, match="2 task"):
+                driver.wait_for_registration(timeout=0.2)
+        finally:
+            driver.close()
+
+    def test_unauthenticated_register_rejected(self):
+        sec, driver, tasks = self._mk(n_hosts=1)
+        try:
+            evil = BasicClient("127.0.0.1", driver.port, "not-the-key")
+            with pytest.raises(WireError):
+                evil.request({"type": "register", "host_id": "mallory",
+                              "port": 1, "addrs": {}})
+            assert "mallory" not in driver.tasks
+        finally:
+            for t in tasks:
+                t.service.close()
+            driver.close()
+
+    def test_run_and_exit_collection(self, tmp_path):
+        sec, driver, tasks = self._mk()
+        try:
+            driver.wait_for_registration(timeout=10.0)
+            driver.probe()
+            out = tmp_path / "out"
+            code = ("import os,sys;"
+                    "open(os.environ['OUTF']+os.environ['HOROVOD_RANK'],"
+                    "'w').write(os.environ['HOROVOD_RANK']);"
+                    "sys.exit(int(os.environ['HOROVOD_RANK']) * 0)")
+            by_host = {
+                "hostA": [(_FakeInfo(0), {"HOROVOD_RANK": "0",
+                                          "OUTF": str(out)})],
+                "hostB": [(_FakeInfo(1), {"HOROVOD_RANK": "1",
+                                          "OUTF": str(out)})],
+            }
+            driver.run_ranks([sys.executable, "-c", code], REPO, by_host)
+            assert driver.wait(num_ranks=2) == 0
+            assert (tmp_path / "out0").read_text() == "0"
+            assert (tmp_path / "out1").read_text() == "1"
+        finally:
+            for t in tasks:
+                t.service.close()
+            driver.close()
+
+    def test_failing_rank_propagates(self):
+        sec, driver, tasks = self._mk()
+        try:
+            driver.wait_for_registration(timeout=10.0)
+            driver.probe()
+            code = ("import os,sys;"
+                    "sys.exit(3 if os.environ['HOROVOD_RANK']=='1' "
+                    "else 0)")
+            by_host = {
+                "hostA": [(_FakeInfo(0), {"HOROVOD_RANK": "0"})],
+                "hostB": [(_FakeInfo(1), {"HOROVOD_RANK": "1"})],
+            }
+            driver.run_ranks([sys.executable, "-c", code], REPO, by_host)
+            assert driver.wait(num_ranks=2) == 3
+        finally:
+            for t in tasks:
+                t.service.close()
+            driver.close()
+
+
+class _FakeInfo:
+    def __init__(self, rank):
+        self.rank = rank
+
+
+@pytest.mark.integration
+class TestProbedLaunch:
+    def test_run_with_driver_localhost(self, capfd):
+        """End-to-end probed launch: task service spawned as a real
+        subprocess, registration over loopback, ranks launched through
+        it, output prefixed, exit codes collected."""
+        from horovod_tpu.runner import launch
+        env = {k: v for k, v in os.environ.items()}
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        code = ("import os; print('RANK', os.environ['HOROVOD_RANK'], "
+                "'IFACE', os.environ.get('HOROVOD_IFACE', '-'))")
+        old = dict(os.environ)
+        os.environ["PYTHONPATH"] = env["PYTHONPATH"]
+        try:
+            rc = launch.run_with_driver(
+                [sys.executable, "-c", code], np_=2,
+                start_timeout=60.0)
+        finally:
+            os.environ.clear()
+            os.environ.update(old)
+        assert rc == 0
+        out = capfd.readouterr().out
+        assert "RANK 0" in out and "RANK 1" in out

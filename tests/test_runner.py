@@ -60,10 +60,9 @@ class TestEnvAndSsh:
         assert env["PATH"] == "/bin"
 
     def test_ssh_command_string(self):
-        info = RankInfo(2, 4, 0, 2, 1, 2, "hostB")
         env = {"HOROVOD_RANK": "2", "SECRET_TOKEN": "x",
                "JAX_PLATFORMS": "cpu"}
-        cmd = _ssh_command(info, ["python", "train.py"], env, 2222)
+        cmd = _ssh_command("hostB", ["python", "train.py"], env, 2222)
         assert cmd[0] == "ssh"
         assert "-p" in cmd and "2222" in cmd
         assert cmd[-2] == "hostB"
@@ -73,11 +72,72 @@ class TestEnvAndSsh:
         assert "SECRET_TOKEN" not in remote  # not in forward list
         assert remote.endswith("python train.py")
 
+    def test_ssh_command_secret_never_in_argv(self):
+        """The HMAC job key must ride stdin, not the world-readable
+        remote argv (reference: secret.py's launcher-private key)."""
+        from horovod_tpu.runner import secret as S
+        env = {S.ENV_VAR: "deadbeef", "HOROVOD_RANK": "0"}
+        cmd = _ssh_command("hostB", ["python", "t.py"], env, None,
+                           secret_on_stdin=True)
+        remote = cmd[-1]
+        assert "deadbeef" not in " ".join(cmd)
+        assert f"read -r {S.ENV_VAR}" in remote
+        assert f"export {S.ENV_VAR}" in remote
+
     def test_parser(self):
         args = make_parser().parse_args(
             ["-np", "4", "-H", "h1:4", "python", "t.py"])
         assert args.num_proc == 4 and args.hosts == "h1:4"
         assert args.command == ["python", "t.py"]
+
+    def test_tuning_flags_forward_as_env(self):
+        """Reference: horovodrun's tuning flags mirror HOROVOD_* env
+        vars and are forwarded to every worker."""
+        from horovod_tpu.runner.launch import env_from_flags
+        args = make_parser().parse_args([
+            "-np", "2",
+            "--fusion-threshold-bytes", "1048576",
+            "--cycle-time-ms", "2.5",
+            "--cache-capacity", "0",
+            "--hierarchical-allreduce",
+            "--timeline-filename", "/tmp/tl.json",
+            "--timeline-mark-cycles",
+            "--autotune", "--autotune-log-file", "/tmp/at.csv",
+            "--no-stall-check",
+            "--stall-shutdown-time-seconds", "120",
+            "--log-level", "debug", "--log-hide-timestamp",
+            "--controller", "python",
+            "python", "t.py"])
+        env = env_from_flags(args, base={})
+        assert env == {
+            "HOROVOD_FUSION_THRESHOLD": "1048576",
+            "HOROVOD_CYCLE_TIME": "2.5",
+            "HOROVOD_CACHE_CAPACITY": "0",
+            "HOROVOD_HIERARCHICAL_ALLREDUCE": "1",
+            "HOROVOD_TIMELINE": "/tmp/tl.json",
+            "HOROVOD_TIMELINE_MARK_CYCLES": "1",
+            "HOROVOD_AUTOTUNE": "1",
+            "HOROVOD_AUTOTUNE_LOG": "/tmp/at.csv",
+            "HOROVOD_STALL_CHECK_DISABLE": "1",
+            "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS": "120.0",
+            "HOROVOD_LOG_LEVEL": "debug",
+            "HOROVOD_LOG_TIMESTAMP": "0",
+            "HOROVOD_CONTROLLER": "python",
+        }
+
+    def test_unset_tuning_flags_leave_env_alone(self):
+        from horovod_tpu.runner.launch import env_from_flags
+        args = make_parser().parse_args(["-np", "2", "python", "t.py"])
+        assert env_from_flags(args, base={"KEEP": "1"}) == {"KEEP": "1"}
+
+    def test_every_tuning_flag_maps_to_declared_knob(self):
+        """Each flag's target env var must exist in the config
+        registry — no flag may write a knob nothing reads."""
+        from horovod_tpu.common.config import KNOBS
+        from horovod_tpu.runner.launch import _FLAG_ENV_MAP
+        declared = {k.env for k in KNOBS}
+        for _, var, _ in _FLAG_ENV_MAP:
+            assert var in declared, var
 
 
 def run_launcher(np_, script, extra_env=None, timeout=240):
@@ -184,21 +244,21 @@ class TestSecretAuth:
         seen = []
         monkeypatch.setattr(notifications, "notify",
                             lambda info: seen.append(info))
+        from horovod_tpu.runner.service import recv_frame, send_frame
         lst = NotificationListener()
         try:
-            def poke(msg):
+            def poke(obj, key):
                 with socket_mod.create_connection(
                         ("127.0.0.1", lst.port), timeout=5) as s:
-                    s.sendall(json.dumps(msg).encode())
-                    return s.recv(16)
-            # unsigned poke: rejected, no notification fires
-            assert poke({"payload": json.dumps({"epoch": 9}),
-                         "sig": "bad"}) == b"denied"
+                    send_frame(s, key, obj)
+                    return recv_frame(s, k)  # replies signed with k
+            # missigned poke (wrong key): rejected, no notification
+            assert poke({"type": "hosts_updated", "epoch": 9},
+                        "wrong-key") == {"error": "denied"}
             assert seen == []
             # signed poke: accepted
-            payload = json.dumps({"epoch": 3})
-            assert poke({"payload": payload,
-                         "sig": S.sign(k, payload.encode())}) == b"ok"
+            assert poke({"type": "hosts_updated", "epoch": 3},
+                        k) == {"ok": True}
             assert seen == [{"epoch": 3}]
         finally:
             lst.stop()
