@@ -27,6 +27,26 @@ def pending() -> bool:
     return _pending
 
 
+def peek():
+    """(pending, info) without clearing the flag."""
+    with _lock:
+        return _pending, _last_update_info
+
+
+def consume_if(expected_info) -> bool:
+    """Clear the flag only if the pending info still equals
+    `expected_info` (compare-and-clear): a newer poke that landed
+    between a peek and this call must survive, or a real membership
+    change would be silently dropped."""
+    global _pending, _last_update_info
+    with _lock:
+        if _pending and _last_update_info == expected_info:
+            _pending = False
+            _last_update_info = None
+            return True
+        return False
+
+
 def consume():
     """Clear the flag, returning the update info."""
     global _pending, _last_update_info

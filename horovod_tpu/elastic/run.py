@@ -45,7 +45,11 @@ def run(func: Callable) -> Callable:
     def wrapper(state, *args, **kwargs):
         from .worker import register_with_rendezvous
         register_with_rendezvous()
-        notifications.consume()
+        # Deliberately NOT consuming pending notifications here: a poke
+        # (or the registration catch-up above) that raced our startup
+        # is a REAL membership change the first commit must act on;
+        # stale same-epoch pokes are filtered by the epoch check in
+        # State.check_host_updates.
         if state.maybe_load_snapshot():
             hlog.info("elastic: resumed from snapshot")
         reset_limit = int(os.environ.get("HOROVOD_ELASTIC_RESET_LIMIT", 0))
@@ -64,12 +68,14 @@ def run(func: Callable) -> Callable:
             except HorovodInternalError:
                 hlog.warning("elastic: collective failure — restoring "
                              "committed state and re-initializing")
+                state.before_reset()
                 state.restore()
                 _reinitialize()
                 state.on_reset()
             except HostsUpdatedInterrupt:
                 hlog.info("elastic: hosts updated — re-initializing")
                 notifications.consume()
+                state.before_reset()
                 _reinitialize()
                 state.on_reset()
             resets += 1

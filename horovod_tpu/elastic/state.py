@@ -55,16 +55,40 @@ class State:
         for cb in self._reset_callbacks:
             cb()
 
+    def before_reset(self) -> None:
+        """Called by elastic run() BEFORE the world is torn down for a
+        resize/restart — the last moment the old coordination service
+        is still alive. Subclasses flush/close resources bound to it
+        (JaxState: the async Orbax manager)."""
+
     def commit(self) -> None:
         self.save()
         self.check_host_updates()
 
     def check_host_updates(self) -> None:
         """Raise HostsUpdatedInterrupt if the driver pushed a membership
-        change notification (wired up by elastic/run.py)."""
+        change notification (wired up by elastic/run.py).
+
+        Epoch-aware: a poke naming the epoch this worker already runs
+        in is stale (e.g. a re-delivered notification after this rank
+        resized) and is swallowed instead of triggering a one-sided
+        re-init that the rest of the world would not join."""
+        import os
         from . import notifications
-        if notifications.pending():
-            raise HostsUpdatedInterrupt()
+        is_pending, info = notifications.peek()
+        if not is_pending:
+            return
+        target = info.get("epoch") if isinstance(info, dict) else None
+        cur = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", "0") or 0)
+        if target is not None and int(target) <= cur:
+            # This epoch (re-delivered) or an OLDER one (late poke
+            # arriving after this rank already resized past it) is
+            # stale either way; acting on it would one-sided-reinit.
+            # Compare-and-clear: a NEWER poke racing in between the
+            # peek above and this consume must survive.
+            notifications.consume_if(info)
+            return
+        raise HostsUpdatedInterrupt()
 
     def maybe_load_snapshot(self) -> bool:
         """Load a persisted snapshot if this state has one (JaxState
@@ -180,6 +204,34 @@ class JaxState(ObjectState):
             pickle.dump({"known": dict(self._saved),
                          "trees": dict(self._tree_saved)}, f)
         os.replace(tmp, self._snapshot_path)
+
+    def before_reset(self) -> None:
+        """Flush and drop the Orbax manager before the coordination
+        service it is bound to goes away: its async checkpointer holds
+        a signaling client pointing at the CURRENT jax.distributed
+        incarnation, and using (or even closing) it after re-init
+        raises UNAVAILABLE connection errors. A fresh manager is
+        lazily created against the new world on the next commit."""
+        mgr, self._ckpt_mgr = self._ckpt_mgr, None
+        if mgr is None:
+            return
+        for fn in (mgr.wait_until_finished, mgr.close):
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 — old world may be
+                #                     half-dead; never block the resize
+                from ..common import logging as hlog
+                hlog.debug("elastic: orbax flush on reset: %s", e)
+        # Orbax memoizes its coordination-service signaling client
+        # (functools.lru_cache on get_signaling_client); after re-init
+        # that cached client points at the DEAD coordinator and every
+        # async save fails with UNAVAILABLE. Drop the memo so the next
+        # manager binds the new world's client.
+        try:
+            from orbax.checkpoint._src.futures import signaling_client
+            signaling_client.get_signaling_client.cache_clear()
+        except Exception:  # noqa: BLE001 — private API; best effort
+            pass
 
     # -- orbax backend -----------------------------------------------------
 

@@ -11,7 +11,6 @@ from __future__ import annotations
 import json
 import os
 import socket
-import threading
 import urllib.request
 from typing import Optional
 
@@ -23,53 +22,31 @@ _listener: Optional["NotificationListener"] = None
 
 
 class NotificationListener:
-    """Tiny TCP listener the driver pokes on membership changes."""
+    """Listener the driver pokes on membership changes — a
+    BasicService (runner/service.py) with one handler, so the accept
+    loop, HMAC denial, per-connection threading (one silent peer
+    cannot wedge delivery), and shutdown wake-up all have a single
+    implementation."""
 
     def __init__(self, port: int = 0):
-        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._sock.bind(("0.0.0.0", port))
-        self._sock.listen(8)
-        self.port = self._sock.getsockname()[1]
-        self._stop = False
-        self._thread = threading.Thread(target=self._loop,
-                                        name="hvd-elastic-notify",
-                                        daemon=True)
-        self._thread.start()
+        from ..runner.service import BasicService
+        self._svc = BasicService("elastic-notify", _secret.from_env(),
+                                 port)
+        self._svc.handle("hosts_updated", self._on_poke)
 
-    def _loop(self) -> None:
-        while not self._stop:
-            try:
-                conn, _ = self._sock.accept()
-            except OSError:
-                return
-            try:
-                data = conn.recv(65536)
-                msg = json.loads(data.decode()) if data else {}
-                payload = msg.get("payload", "")
-                if not _secret.verify(_secret.from_env(),
-                                      payload.encode(),
-                                      msg.get("sig", "")):
-                    hlog.warning(
-                        "elastic: rejected unsigned/missigned "
-                        "notification poke")
-                    conn.sendall(b"denied")
-                    continue
-                info = json.loads(payload) if payload else None
-                hlog.info("elastic: hosts-updated notification: %s", info)
-                notifications.notify(info)
-                conn.sendall(b"ok")
-            except Exception as e:
-                hlog.debug("notification recv error: %s", e)
-            finally:
-                conn.close()
+    @property
+    def port(self) -> int:
+        return self._svc.port
+
+    @staticmethod
+    def _on_poke(req: dict, peer) -> dict:
+        info = {k: v for k, v in req.items() if k != "type"}
+        hlog.info("elastic: hosts-updated notification: %s", info)
+        notifications.notify(info)
+        return {"ok": True}
 
     def stop(self) -> None:
-        self._stop = True
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._svc.close()
 
 
 def start_listener() -> int:
@@ -98,9 +75,22 @@ def register_with_rendezvous() -> None:
             _secret.from_env(), path.encode() + body)})
     try:
         with urllib.request.urlopen(req, timeout=10) as resp:
-            resp.read()
+            reply = json.loads(resp.read().decode() or "{}")
         hlog.debug("elastic: registered notify port %d", port)
-    except OSError as e:
+        # Catch-up: if the world moved on while this worker was still
+        # starting (the driver's poke predates our listener), surface
+        # the missed membership change now so the next commit boundary
+        # resizes instead of training to completion in the old world.
+        cur = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", "0") or 0)
+        latest = int(reply.get("epoch", cur) or cur)
+        if latest != cur:
+            hlog.info("elastic: missed membership change "
+                      "(epoch %d -> %d); scheduling resize", cur, latest)
+            notifications.notify({"epoch": latest})
+    except (OSError, ValueError) as e:
+        # ValueError covers a malformed reply body (json/int parse):
+        # registration stays best-effort warn-and-continue, never a
+        # startup crash.
         hlog.warning("elastic: notify registration failed: %s", e)
 
 

@@ -26,7 +26,6 @@ paths instead:
 
 from __future__ import annotations
 
-import json
 import socket
 import subprocess
 import sys
@@ -37,7 +36,9 @@ from typing import Dict, List, Optional, Tuple
 from ...common import logging as hlog
 from .. import secret as _secret
 from ..hosts import HostSlots, RankInfo, assign_ranks
-from ..launch import _prefix_pump, _ssh_command, free_port
+from ..launch import (_prefix_pump, _ssh_command,
+                      _write_secret_stdin, free_port)
+from ..service import BasicClient
 from .discovery import HostDiscovery, hosts_key
 from .rendezvous import RendezvousServer
 
@@ -76,6 +77,7 @@ class ElasticDriver:
         self.rendezvous = RendezvousServer(secret=self.secret)
         self.epoch = 0
         self.resets = 0
+        self._clean_since = None  # first clean-exit-with-stragglers time
         self.slots: Dict[Tuple[str, int], _Slot] = {}
         self._io_lock = threading.Lock()
         self.blacklist: Dict[str, float] = {}  # host -> until timestamp
@@ -112,7 +114,12 @@ class ElasticDriver:
             env["HOROVOD_HOSTNAME"] = info.host
             env["HOROVOD_RENDEZVOUS_ADDR"] = \
                 f"{self._my_addr(info)}:{self.rendezvous.port}"
-            env[_secret.ENV_VAR] = self.secret
+            env["HOROVOD_ELASTIC_EPOCH"] = str(self.epoch)
+            # The HMAC key is deliberately NOT in this table: the
+            # rendezvous serves assignments over plain HTTP (signed,
+            # but not encrypted) and HMAC gives integrity, not
+            # confidentiality. Workers get the secret once, at spawn
+            # (local env / ssh stdin), and keep it across resizes.
             table[(info.host, info.local_rank)] = env
         return infos, table
 
@@ -126,18 +133,26 @@ class ElasticDriver:
         child_env.update(env_add)
         child_env["HOROVOD_ELASTIC"] = "1"
         child_env["HOROVOD_START_TIMEOUT"] = str(self.elastic_timeout)
+        child_env[_secret.ENV_VAR] = self.secret
         if info.is_local:
             cmd = self.command
             popen_env = child_env
         else:
-            cmd = _ssh_command(info, self.command, child_env, None)
+            # secret_on_stdin: the HMAC key must not appear in the
+            # remote argv (see _ssh_command).
+            cmd = _ssh_command(info.host, self.command, child_env, None,
+                               secret_on_stdin=True)
             popen_env = dict(os.environ)
         if self.verbose:
             print(f"[elastic] spawn rank {info.rank} on {info.host}",
                   file=sys.stderr)
         p = subprocess.Popen(cmd, env=popen_env,
+                             stdin=(None if info.is_local
+                                    else subprocess.PIPE),
                              stdout=subprocess.PIPE,
                              stderr=subprocess.PIPE)
+        if not info.is_local:
+            _write_secret_stdin(p, self.secret)
         slot = _Slot(info, p)
         tag = f"{info.rank}"
         t1 = threading.Thread(target=_prefix_pump,
@@ -152,27 +167,24 @@ class ElasticDriver:
 
     def _notify_workers(self) -> None:
         """Poke every registered notification listener (reference:
-        WorkerNotificationService HostsUpdatedRequest)."""
+        WorkerNotificationService HostsUpdatedRequest). try_request
+        swallows dead/half-closed listeners (worker mid-teardown) —
+        one bad reply must not take down the whole driver."""
         for (host, lr), port in self.rendezvous.notify_ports().items():
             if port <= 0:
                 continue
-            try:
-                with socket.create_connection((host, port),
-                                              timeout=5) as s:
-                    payload = json.dumps({"epoch": self.epoch})
-                    s.sendall(json.dumps({
-                        "payload": payload,
-                        "sig": _secret.sign(self.secret,
-                                            payload.encode()),
-                    }).encode())
-                    s.recv(16)
-            except OSError as e:
-                hlog.debug("elastic: notify %s:%d failed: %s", host,
-                           lr, e)
+            cli = BasicClient(host, port, self.secret, timeout=5.0)
+            if cli.try_request({"type": "hosts_updated",
+                                "epoch": self.epoch}) is None:
+                hlog.debug("elastic: notify %s:%d unreachable", host, lr)
 
     def _publish_epoch(self, hosts: List[HostSlots]
                        ) -> Tuple[List[RankInfo], Dict]:
         self.epoch += 1
+        # New world, new completion tracking: a grace timestamp from a
+        # previous epoch's rank-0 completion must not void the next
+        # epoch's grace window.
+        self._clean_since = None
         infos, table = self._assignments(hosts)
         self.rendezvous.publish(self.epoch, table)
         return infos, table
@@ -234,6 +246,42 @@ class ElasticDriver:
                 if all(c == 0 for c in codes.values()) and \
                         len(exited) == len(self.slots):
                     return 0  # clean completion
+                # Rank 0 finishing cleanly means the job is done
+                # (reference semantics: the elastic driver treats the
+                # coordinator rank's completion as job completion);
+                # give the other ranks a short grace to flush and
+                # exit, then terminate the rest. Peers erroring in
+                # this window is expected wind-down (rank 0's
+                # in-process coordination service died with it), NOT
+                # a failure to gang-restart a finished job over.
+                # Non-zero ranks finishing early while rank 0 still
+                # trains is legitimate skew (uneven hvd.join
+                # workloads) — keep waiting.
+                rank0_done_clean = any(
+                    s.info.rank == 0 and s.proc.returncode == 0
+                    for s in exited.values())
+                if rank0_done_clean:
+                    if all(s.proc.poll() is not None
+                           for s in self.slots.values()):
+                        return 0  # everyone down, job complete
+                    if self._clean_since is None:
+                        self._clean_since = time.time()
+                        hlog.info(
+                            "elastic: rank 0 finished cleanly; "
+                            "waiting up to 30s for %d peer(s)",
+                            len(self.slots) - len(exited))
+                    elif time.time() - self._clean_since > 30.0:
+                        stuck = [k for k, s in self.slots.items()
+                                 if s.proc.poll() is None]
+                        if stuck:
+                            hlog.warning(
+                                "elastic: terminating ranks %s still "
+                                "running after rank 0 completed",
+                                stuck)
+                            for k in stuck:
+                                self.slots[k].proc.kill()
+                        return 0
+                    continue
                 bad = {k: c for k, c in codes.items() if c != 0}
                 if bad:
                     self.resets += 1

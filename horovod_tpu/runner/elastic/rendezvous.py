@@ -95,7 +95,14 @@ class _Handler(BaseHTTPRequestHandler):
             key = (parts[1], int(parts[2]))
             with st.lock:
                 st.notify_ports[key] = int(body.get("port", 0))
-            self._json(200, {"ok": True})
+                epoch = st.epoch
+            # The current epoch rides the registration reply so a
+            # worker that registered AFTER a membership change (slow
+            # startup racing the driver's poke) can detect it missed
+            # the notification and catch up — otherwise it would train
+            # to completion in the stale world while newly-spawned
+            # ranks wait forever for a coordinator that never binds.
+            self._json(200, {"ok": True, "epoch": epoch})
         else:
             self._json(404, {"error": "not found"})
 
