@@ -83,17 +83,25 @@ def peak_tflops(device) -> float:
     return 0.0
 
 
-def compiled_flops(step_fn, *args) -> float:
-    """Per-execution FLOPs from XLA's cost analysis of the compiled
-    step (the same accounting the MFU literature uses: MAC = 2)."""
+def aot_compile(step_fn, *args):
+    """AOT-compile the step once and reuse the executable for both the
+    benchmark loop and XLA's cost analysis (compiling separately for
+    cost_analysis would double the multi-ten-second ResNet compile).
+    Returns (callable, flops_per_execution)."""
     try:
-        ca = step_fn.lower(*args).compile().cost_analysis()
+        compiled = step_fn.lower(*args).compile()
+    except Exception as e:  # pragma: no cover - backend-dependent
+        log(f"bench: AOT compile unavailable ({e}); using jit path")
+        return step_fn, 0.0
+    flops = 0.0
+    try:
+        ca = compiled.cost_analysis()
         if isinstance(ca, list):
             ca = ca[0]
-        return float(ca.get("flops", 0.0))
+        flops = float(ca.get("flops", 0.0))
     except Exception as e:  # pragma: no cover - backend-dependent
         log(f"bench: cost analysis unavailable ({e})")
-        return 0.0
+    return compiled, flops
 
 
 def main():
@@ -145,15 +153,15 @@ def main():
     rep_sh = NamedSharding(mesh, P())
     batch_stats = jax.device_put(batch_stats, rep_sh)
 
+    step_exec, flops_per_step = aot_compile(
+        step, params, opt_state,
+        {"images": images, "labels": labels, "batch_stats": batch_stats})
+
     def run_step(params, opt_state, batch_stats):
         batch = {"images": images, "labels": labels,
                  "batch_stats": batch_stats}
-        params, opt_state, metrics = step(params, opt_state, batch)
+        params, opt_state, metrics = step_exec(params, opt_state, batch)
         return params, opt_state, metrics["aux"], metrics["loss"]
-
-    flops_per_step = compiled_flops(
-        step, params, opt_state,
-        {"images": images, "labels": labels, "batch_stats": batch_stats})
 
     t_c0 = time.perf_counter()
     for _ in range(warmup):
@@ -161,7 +169,7 @@ def main():
             params, opt_state, batch_stats)
     # float() provably round-trips the value; block_until_ready is
     # unreliable on the experimental axon backend.
-    log(f"bench: warmup ({warmup} steps incl. compile) "
+    log(f"bench: warmup ({warmup} steps; compile done in AOT phase) "
         f"{time.perf_counter() - t_c0:.1f}s loss={float(loss):.3f}")
 
     profiler_cm = (jax.profiler.trace(profile_dir) if profile_dir

@@ -1,0 +1,172 @@
+// Multi-threaded controller stress for ThreadSanitizer CI.
+//
+// The reference ships no TSAN harness (SURVEY.md §5.2: safety by
+// construction, flushed by the parallel test matrix); this build adds
+// what it lacks: a standalone binary compiled wholly with
+// -fsanitize=thread that drives both sides of the negotiation
+// protocol — two Controllers (rank 0 coordinator + rank 1 worker) in
+// one process over loopback TCP — while hammering every cross-thread
+// surface: concurrent Submit from multiple frontend threads,
+// NextBatch consumers, live SetFusionThreshold/SetCycleTime retunes,
+// ok()/last_error() polling, Join, and Shutdown.
+//
+// It also asserts the protocol's core guarantee (the deterministic
+// response order the SPMD data plane depends on): both ranks must
+// receive the identical entry sequence even though their submit
+// threads interleave randomly. Prints "ORDER OK" and exits 0 on
+// success; TSAN reports land on stderr and flip the exit code.
+
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <netinet/in.h>
+#include <string>
+#include <sys/socket.h>
+#include <thread>
+#include <vector>
+
+#include "controller.h"
+
+using hvdtpu::Controller;
+using hvdtpu::ControllerOptions;
+using hvdtpu::Entry;
+
+namespace {
+
+int free_port() {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+  socklen_t len = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  int port = ntohs(addr.sin_port);
+  close(fd);
+  return port;
+}
+
+constexpr int kRounds = 25;
+constexpr int kTensors = 16;   // per round, split across 2 submitters
+constexpr int kExpected = kRounds * kTensors;
+
+void submitter(Controller* c, int lo, int hi, int round) {
+  for (int i = lo; i < hi; ++i) {
+    std::string name = "t" + std::to_string(i);
+    // Same sig every round: steady-state rounds ride the response
+    // cache's id announcements — the cache path under thread churn.
+    c->Submit(name, "f32|sum|#64", 256, "");
+  }
+  (void)round;
+}
+
+void consumer(Controller* c, std::vector<std::string>* order,
+              std::atomic<int>* count) {
+  // `order` is touched ONLY by this thread until it is joined; other
+  // threads observe progress through the atomic counter (an
+  // unsynchronized order->size() would be a harness-made race).
+  std::vector<Entry> entries;
+  while (count->load() < kExpected) {
+    entries.clear();
+    if (!c->NextBatch(0.2, &entries)) break;
+    for (const auto& e : entries) {
+      if (e.name == hvdtpu::kAllJoined) continue;
+      if (!e.error.empty()) {
+        fprintf(stderr, "entry error: %s: %s\n", e.name.c_str(),
+                e.error.c_str());
+        _exit(2);
+      }
+      order->push_back(e.name);
+      count->fetch_add(1);
+    }
+  }
+}
+
+}  // namespace
+
+int main() {
+  alarm(90);  // hard safety net: a hang must fail, not wedge CI
+  int port = free_port();
+
+  ControllerOptions o0;
+  o0.rank = 0;
+  o0.size = 2;
+  o0.coord_port = port;
+  o0.cycle_time_ms = 0.5;
+  o0.fusion_threshold = 1024;  // small: forces multi-batch rounds
+  ControllerOptions o1 = o0;
+  o1.rank = 1;
+
+  Controller c0(o0);
+  Controller c1(o1);
+
+  std::vector<std::string> order0, order1;
+  std::atomic<int> count0{0}, count1{0};
+  std::thread cons0(consumer, &c0, &order0, &count0);
+  std::thread cons1(consumer, &c1, &order1, &count1);
+
+  // Concurrent retuning + status polling while rounds run.
+  std::atomic<bool> stop_aux{false};
+  std::thread aux([&] {
+    int64_t th = 512;
+    while (!stop_aux.load()) {
+      c0.SetFusionThreshold(th);
+      c0.SetCycleTime(0.3 + (th % 7) * 0.1);
+      (void)c0.ok();
+      (void)c1.ok();
+      (void)c0.last_error();
+      (void)c1.last_error();
+      (void)c0.control_bytes_sent();
+      th = th == 512 ? 4096 : 512;
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  });
+
+  for (int r = 0; r < kRounds; ++r) {
+    // Two submit threads per rank, disjoint halves, opposite order
+    // across ranks — the coordinator must still deliver one agreed
+    // sequence to both.
+    std::thread a0(submitter, &c0, 0, kTensors / 2, r);
+    std::thread b0(submitter, &c0, kTensors / 2, kTensors, r);
+    std::thread a1(submitter, &c1, kTensors / 2, kTensors, r);
+    std::thread b1(submitter, &c1, 0, kTensors / 2, r);
+    a0.join(); b0.join(); a1.join(); b1.join();
+    // Wait for the round to drain before resubmitting the same names
+    // (one readiness announcement per name per round, like a training
+    // step).
+    int want = (r + 1) * kTensors;
+    while (count0.load() < want || count1.load() < want) {
+      if (!c0.ok() || !c1.ok()) {
+        fprintf(stderr, "controller error: %s / %s\n",
+                c0.last_error().c_str(), c1.last_error().c_str());
+        return 2;
+      }
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    }
+  }
+
+  c0.Join();
+  c1.Join();
+  while (c0.AllJoined() < 0 || c1.AllJoined() < 0)
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+
+  stop_aux.store(true);
+  aux.join();
+  c0.Shutdown();
+  c1.Shutdown();
+  cons0.join();
+  cons1.join();
+
+  if (order0 != order1 ||
+      static_cast<int>(order0.size()) != kExpected) {
+    fprintf(stderr, "ORDER MISMATCH: %zu vs %zu entries\n",
+            order0.size(), order1.size());
+    return 1;
+  }
+  printf("ORDER OK: %zu entries, identical sequence on both ranks\n",
+         order0.size());
+  return 0;
+}

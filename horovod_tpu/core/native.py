@@ -31,6 +31,36 @@ def _lib_path() -> str:
                         "libhvdtpu_core.so")
 
 
+def _source_hash() -> str:
+    """Hash of every .cc/.h + Makefile in the cc tree — a .so built
+    from different sources (e.g. a wire-protocol change pulled on top
+    of a previously-built install) must be rebuilt, not loaded: the
+    Python side and a stale core would disagree on the batch-entry
+    field layout and fail at the first collective."""
+    import hashlib
+    ccdir = os.path.join(os.path.dirname(__file__), "cc")
+    h = hashlib.sha256()
+    for name in sorted(os.listdir(ccdir)):
+        if name.endswith((".cc", ".h")) or name == "Makefile":
+            with open(os.path.join(ccdir, name), "rb") as f:
+                h.update(name.encode() + b"\0" + f.read() + b"\0")
+    return h.hexdigest()
+
+
+def _stamp_path() -> str:
+    return _lib_path() + ".srchash"
+
+
+def _built_fresh() -> bool:
+    if not os.path.exists(_lib_path()):
+        return False
+    try:
+        with open(_stamp_path()) as f:
+            return f.read().strip() == _source_hash()
+    except OSError:
+        return False  # no stamp: assume stale, rebuild
+
+
 def build(quiet: bool = True) -> bool:
     """Build the core in-tree (make) if a toolchain is present.
 
@@ -44,10 +74,13 @@ def build(quiet: bool = True) -> bool:
         with open(lock_path, "w") as lock:
             fcntl.flock(lock, fcntl.LOCK_EX)
             try:
-                if os.path.exists(_lib_path()):
+                if _built_fresh():
                     return True  # another rank built it while we waited
-                r = subprocess.run(["make", "-C", ccdir],
+                r = subprocess.run(["make", "-C", ccdir, "-B"],
                                    capture_output=quiet, timeout=300)
+                if r.returncode == 0:
+                    with open(_stamp_path(), "w") as f:
+                        f.write(_source_hash())
                 return r.returncode == 0
             finally:
                 fcntl.flock(lock, fcntl.LOCK_UN)
@@ -60,8 +93,17 @@ def load():
     if _lib is None and not _tried:
         _tried = True
         path = _lib_path()
-        if not os.path.exists(path):
-            build()
+        if not _built_fresh():
+            if not build() and os.path.exists(path):
+                # No toolchain to rebuild with but a .so exists
+                # (prebuilt wheel without its stamp): load it rather
+                # than lose the native core entirely — installs from
+                # this tree always carry a matching stamp, so this
+                # only fires for hand-copied artifacts.
+                from ..common import logging as hlog
+                hlog.warning(
+                    "native core: source hash mismatch/missing and "
+                    "rebuild unavailable; loading existing %s", path)
         if os.path.exists(path):
             lib = ctypes.CDLL(path)
             lib.hvd_core_create.restype = ctypes.c_void_p

@@ -21,6 +21,7 @@ reference's behavior when size == 1.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Optional, Sequence, Union
 
 import flax.linen as nn
@@ -47,13 +48,41 @@ class SyncBatchNorm(nn.BatchNorm):
 def to_sync_batch_norm(module: nn.Module,
                        axis_name: Union[str, Sequence[str], None]
                        ) -> Any:
-    """Best-effort converter mirroring the reference's
+    """Converter mirroring the reference's
     `SyncBatchNorm.convert_sync_batchnorm`: returns a copy of a linen
-    module tree with every nn.BatchNorm's axis_name set. Only works on
-    modules built with dataclass fields (standard linen); returns the
-    module unchanged if nothing to convert."""
-    if isinstance(module, nn.BatchNorm):
-        return module.clone(
-            axis_name=tuple(axis_name) if isinstance(axis_name, (list,))
-            else axis_name)
-    return module
+    module tree with every nn.BatchNorm's axis_name set, recursing
+    through dataclass fields and list/tuple/dict containers of
+    submodules. Submodules constructed inline inside `__call__` cannot
+    be reached this way — declare them as fields (standard linen
+    style) or pass the axis name explicitly there."""
+    ax = tuple(axis_name) if isinstance(axis_name, list) else axis_name
+
+    def convert(obj: Any) -> Any:
+        if isinstance(obj, nn.BatchNorm):
+            return obj.clone(axis_name=ax)
+        if isinstance(obj, nn.Module):
+            updates = {}
+            for f in dataclasses.fields(obj):
+                if f.name in ("parent", "name"):
+                    continue
+                try:
+                    val = getattr(obj, f.name)
+                except AttributeError:
+                    continue
+                new = convert(val)
+                if new is not val:
+                    updates[f.name] = new
+            return obj.clone(**updates) if updates else obj
+        if isinstance(obj, (list, tuple)):
+            new = [convert(v) for v in obj]
+            if any(a is not b for a, b in zip(new, obj)):
+                return type(obj)(new)
+            return obj
+        if isinstance(obj, dict):
+            new = {k: convert(v) for k, v in obj.items()}
+            if any(new[k] is not obj[k] for k in obj):
+                return new
+            return obj
+        return obj
+
+    return convert(module)

@@ -8,7 +8,6 @@ at runtime (horovod_tpu/core/native.py still falls back to a lazy
 in-tree build when the .so is absent).
 """
 
-import subprocess
 import sys
 from pathlib import Path
 
@@ -18,16 +17,25 @@ from setuptools.command.build_py import build_py
 
 class BuildWithNativeCore(build_py):
     def run(self):
-        ccdir = Path(__file__).parent / "horovod_tpu" / "core" / "cc"
         try:
-            r = subprocess.run(["make", "-C", str(ccdir)],
-                               capture_output=True, timeout=600)
-            if r.returncode != 0:
+            # native.py's build() also stamps the .so with a source
+            # hash so a later source update forces a rebuild instead
+            # of loading a wire-incompatible stale core. Loaded as a
+            # standalone module (NOT via the horovod_tpu package):
+            # PEP 517 isolated build envs have only setuptools — the
+            # package __init__ would pull in jax and fail.
+            import importlib.util
+            native_path = (Path(__file__).parent / "horovod_tpu" /
+                           "core" / "native.py")
+            spec = importlib.util.spec_from_file_location(
+                "_hvdtpu_native_build", native_path)
+            native = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(native)
+            if not native.build(quiet=False):
                 print("warning: native core prebuild failed "
-                      "(runtime lazy build will retry):\n"
-                      + r.stderr.decode(errors="replace"),
+                      "(runtime lazy build will retry)",
                       file=sys.stderr)
-        except (OSError, subprocess.TimeoutExpired) as e:
+        except Exception as e:  # noqa: BLE001 — install must not die
             print(f"warning: native core prebuild skipped: {e}",
                   file=sys.stderr)
         super().run()
