@@ -25,7 +25,7 @@ from .ops.collective_ops import (  # noqa: F401
     allreduce, allreduce_async, grouped_allreduce, grouped_allreduce_async,
     allgather, allgather_async, broadcast, broadcast_async,
     alltoall, alltoall_async, reducescatter, reducescatter_async,
-    barrier, join, synchronize, poll,
+    barrier, join, synchronize, poll, check_execution_order,
     Average, Sum, Adasum, Min, Max, Product,
 )
 from .ops.compression import Compression  # noqa: F401
@@ -39,11 +39,11 @@ from .optim.distributed_optimizer import (  # noqa: F401
 )
 from .optim.functions import (  # noqa: F401
     broadcast_parameters, broadcast_optimizer_state, broadcast_object,
-    allreduce_parameters,
+    allgather_object, allreduce_parameters,
 )
 from . import elastic  # noqa: F401
 from . import callbacks  # noqa: F401
-from .sync_batch_norm import SyncBatchNorm  # noqa: F401
+from .sync_batch_norm import SyncBatchNorm, to_sync_batch_norm  # noqa: F401
 
 __version__ = "0.1.0"
 

@@ -2,13 +2,21 @@
 
 TPU-native analog of the reference's ParameterManager
 (reference: horovod/common/parameter_manager.cc — ParameterManager /
-BayesianParameter; utils/bayesian_optimization.cc). The reference tunes
-fusion-threshold / cycle-time with a Gaussian-process Bayesian search;
-here a coordinate hill-climb over the same discrete grids is used —
-the search space is tiny (two knobs, ~10 levels each) and the score
-function (bytes reduced per second) is the same. A GP is easy to add
-later behind the same record()/suggest() interface if the hill-climb
-plateaus badly on real pods.
+BayesianParameter; utils/bayesian_optimization.cc +
+utils/gaussian_process.cc). Two search modes over the same
+(fusion_threshold, cycle_time) space and the same score (bytes
+reduced per second):
+
+  * "hillclimb" (default): coordinate descent over the discrete
+    grids — robust, no hyperparameters, fine for the tiny space.
+  * "gp": Gaussian-process Bayesian optimization with expected-
+    improvement acquisition, the reference's BayesianParameter
+    redesigned in ~80 lines of numpy (the reference vendors Eigen +
+    an L-BFGS port to maximize acquisition continuously; here the
+    candidate set IS the discrete grid product, so acquisition is
+    evaluated exactly — no inner optimizer needed).
+
+Select with HOROVOD_AUTOTUNE_MODE.
 """
 
 from __future__ import annotations
@@ -17,6 +25,8 @@ import os
 import time
 from typing import List, Optional, Tuple
 
+import numpy as np
+
 _MB = 1024 * 1024
 
 FUSION_GRID = [0, 1 * _MB, 2 * _MB, 4 * _MB, 8 * _MB, 16 * _MB,
@@ -24,9 +34,88 @@ FUSION_GRID = [0, 1 * _MB, 2 * _MB, 4 * _MB, 8 * _MB, 16 * _MB,
 CYCLE_GRID = [0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 50.0]
 
 
+class GaussianProcessSearch:
+    """GP regression + expected improvement over a fixed candidate
+    set (reference: utils/gaussian_process.cc GaussianProcessRegressor
+    + bayesian_optimization.cc ExpectedImprovement)."""
+
+    def __init__(self, candidates: np.ndarray, lengthscale: float = 0.3,
+                 noise: float = 1e-3, xi: float = 0.01):
+        self.cand = np.asarray(candidates, float)   # (M, D) in [0,1]^D
+        self.ls = lengthscale
+        self.noise = noise
+        self.xi = xi
+
+    def _kernel(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+        return np.exp(-0.5 * d2 / (self.ls ** 2))
+
+    def suggest(self, X: np.ndarray, y: np.ndarray) -> int:
+        """Index into `candidates` maximizing expected improvement
+        given observations (X, y). With <2 observations, explores the
+        candidate furthest from what's been tried."""
+        X = np.asarray(X, float).reshape(-1, self.cand.shape[1])
+        y = np.asarray(y, float)
+        if len(y) < 2:
+            if len(y) == 0:
+                return 0
+            d2 = ((self.cand - X[0]) ** 2).sum(-1)
+            return int(np.argmax(d2))
+        mu_y, sd_y = float(y.mean()), float(y.std() or 1.0)
+        yn = (y - mu_y) / sd_y
+        K = self._kernel(X, X) + self.noise * np.eye(len(X))
+        L = np.linalg.cholesky(K)
+        alpha = np.linalg.solve(L.T, np.linalg.solve(L, yn))
+        Ks = self._kernel(X, self.cand)              # (N, M)
+        mu = Ks.T @ alpha
+        v = np.linalg.solve(L, Ks)
+        var = np.clip(1.0 - (v ** 2).sum(0), 1e-12, None)
+        sd = np.sqrt(var)
+        best = yn.max()
+        z = (mu - best - self.xi) / sd
+        # standard-normal pdf/cdf without scipy
+        pdf = np.exp(-0.5 * z ** 2) / np.sqrt(2 * np.pi)
+        cdf = 0.5 * (1.0 + _erf(z / np.sqrt(2.0)))
+        ei = (mu - best - self.xi) * cdf + sd * pdf
+        return int(np.argmax(ei))
+
+
+def _erf(x: np.ndarray) -> np.ndarray:
+    # Abramowitz & Stegun 7.1.26 — max abs error 1.5e-7, plenty for
+    # an acquisition argmax.
+    sign = np.sign(x)
+    x = np.abs(x)
+    t = 1.0 / (1.0 + 0.3275911 * x)
+    poly = t * (0.254829592 + t * (-0.284496736 + t * (
+        1.421413741 + t * (-1.453152027 + t * 1.061405429))))
+    return sign * (1.0 - poly * np.exp(-x * x))
+
+
+def _normalize_point(fusion: int, cycle: float) -> Tuple[float, float]:
+    """Map a (fusion_threshold, cycle_time) pair into [0,1]^2 — log
+    scales, matching how the knobs actually behave."""
+    fmax = np.log2(FUSION_GRID[-1] + 1.0)
+    f = np.log2(fusion + 1.0) / fmax
+    cmin, cmax = np.log(CYCLE_GRID[0]), np.log(CYCLE_GRID[-1])
+    c = (np.log(cycle) - cmin) / (cmax - cmin)
+    return float(f), float(c)
+
+
+def _gp_candidates() -> Tuple[np.ndarray, List[Tuple[int, float]]]:
+    pairs = [(f, c) for f in FUSION_GRID for c in CYCLE_GRID]
+    pts = np.array([_normalize_point(f, c) for f, c in pairs])
+    return pts, pairs
+
+
 class Autotuner:
-    def __init__(self, cfg):
+    def __init__(self, cfg, mode: Optional[str] = None):
         self.enabled = True
+        self.mode = (mode or getattr(cfg, "autotune_mode", "hillclimb")
+                     or "hillclimb").lower()
+        if self.mode not in ("hillclimb", "gp"):
+            raise ValueError(
+                f"HOROVOD_AUTOTUNE_MODE={self.mode!r}: expected "
+                "'hillclimb' or 'gp'")
         self.warmup_remaining = cfg.autotune_warmup_samples
         self.steps_per_sample = cfg.autotune_steps_per_sample
         self.log_path = cfg.autotune_log
@@ -39,7 +128,12 @@ class Autotuner:
         self._best = (self.fusion_threshold, self.cycle_time_ms)
         self._knob = 0              # 0: fusion, 1: cycle
         self._direction = 1
+        self._frozen = False
+        self._num_samples = 0
         self._samples: List[Tuple[int, float, float]] = []
+        if self.mode == "gp":
+            self._gp_pts, self._gp_pairs = _gp_candidates()
+            self._gp = GaussianProcessSearch(self._gp_pts)
         if self.log_path:
             with open(self.log_path, "w") as f:
                 f.write("fusion_threshold,cycle_time_ms,score_bytes_per_sec\n")
@@ -60,11 +154,16 @@ class Autotuner:
         self._bytes = 0
         self._seconds = 0.0
         self._events = 0
+        if self._frozen:
+            return
         if self.warmup_remaining > 0:
             self.warmup_remaining -= 1
             return
+        self._num_samples += 1
         self._samples.append(
             (self.fusion_threshold, self.cycle_time_ms, score))
+        if len(self._samples) > 512:   # bound hot-path memory
+            self._samples = self._samples[-256:]
         if self.log_path:
             with open(self.log_path, "a") as f:
                 f.write(f"{self.fusion_threshold},{self.cycle_time_ms},"
@@ -72,12 +171,15 @@ class Autotuner:
         if score > self._best_score:
             self._best_score = score
             self._best = (self.fusion_threshold, self.cycle_time_ms)
-        else:
+        elif self.mode == "hillclimb":
             # revert and turn around
             self.fusion_threshold, self.cycle_time_ms = self._best
             self._direction = -self._direction
             self._knob = 1 - self._knob
-        self._step_knob()
+        if self.mode == "gp":
+            self._step_gp()
+        else:
+            self._step_knob()
 
     def _step_knob(self) -> None:
         if self._knob == 0:
@@ -93,6 +195,26 @@ class Autotuner:
             self.fusion_threshold = grid[j]
         else:
             self.cycle_time_ms = grid[j]
+
+    # GP fit window and total exploration budget: the fit is O(N^3)
+    # (Cholesky) and runs on the training hot path, so it must not
+    # grow with run length; after the budget the tuner freezes at the
+    # best point (reference: ParameterManager stops tuning once
+    # converged rather than searching forever).
+    GP_FIT_WINDOW = 64
+    GP_SAMPLE_BUDGET = 128
+
+    def _step_gp(self) -> None:
+        if self._num_samples >= self.GP_SAMPLE_BUDGET:
+            if not self._frozen:
+                self._frozen = True
+                self.fusion_threshold, self.cycle_time_ms = self._best
+            return
+        recent = self._samples[-self.GP_FIT_WINDOW:]
+        X = np.array([_normalize_point(f, c) for f, c, _ in recent])
+        y = np.array([s for _, _, s in recent])
+        idx = self._gp.suggest(X, y)
+        self.fusion_threshold, self.cycle_time_ms = self._gp_pairs[idx]
 
     def best(self) -> Tuple[int, float]:
         return self._best

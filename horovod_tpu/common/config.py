@@ -50,6 +50,10 @@ KNOBS: List[Knob] = [
     # TPU analog — XLA fuses bucket gather/scatter copies and owns the
     # launch lanes. Deliberately NOT declared: a knob that silently
     # does nothing is worse than an unknown-variable warning.)
+    Knob("HOROVOD_ADASUM_PALLAS", str, "auto",
+         "Adasum pair-combine implementation: 'auto' = fused Pallas "
+         "kernel on TPU / plain jnp elsewhere; 1 forces the Pallas "
+         "path (interpreter off-TPU), 0 forces jnp."),
     # -- controller / backends ----------------------------------------------
     Knob("HOROVOD_CONTROLLER", str, "auto",
          "Control-plane implementation: 'native' (C++ core), 'python' "
@@ -71,10 +75,21 @@ KNOBS: List[Knob] = [
          "Enable online autotuning of fusion threshold and cycle time."),
     Knob("HOROVOD_AUTOTUNE_LOG", str, "",
          "If set, append autotune samples (params, score) to this CSV."),
+    Knob("HOROVOD_AUTOTUNE_MODE", str, "hillclimb",
+         "Search strategy: 'hillclimb' (coordinate descent) or 'gp' "
+         "(Gaussian-process Bayesian optimization with expected "
+         "improvement, the reference parameter_manager's "
+         "BayesianParameter)."),
     Knob("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", int, 3,
          "Autotune warmup samples discarded before scoring."),
     Knob("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", int, 10,
          "Training steps contributing to one autotune sample."),
+    # -- order check ---------------------------------------------------------
+    Knob("HOROVOD_ORDER_CHECK", _parse_bool, False,
+         "Record every executed collective's name into a per-rank "
+         "digest; hvd.check_execution_order() then asserts all ranks "
+         "executed the identical sequence (the coordinator's core "
+         "ordering guarantee, made checkable at runtime)."),
     # -- stall inspector -----------------------------------------------------
     Knob("HOROVOD_STALL_CHECK_DISABLE", _parse_bool, False,
          "Disable the stall inspector."),
@@ -166,8 +181,11 @@ class Config:
         "timeline_mark_cycles": "HOROVOD_TIMELINE_MARK_CYCLES",
         "autotune": "HOROVOD_AUTOTUNE",
         "autotune_log": "HOROVOD_AUTOTUNE_LOG",
+        "autotune_mode": "HOROVOD_AUTOTUNE_MODE",
         "autotune_warmup_samples": "HOROVOD_AUTOTUNE_WARMUP_SAMPLES",
         "autotune_steps_per_sample": "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE",
+        "adasum_pallas": "HOROVOD_ADASUM_PALLAS",
+        "order_check": "HOROVOD_ORDER_CHECK",
         "stall_check_disable": "HOROVOD_STALL_CHECK_DISABLE",
         "stall_check_time": "HOROVOD_STALL_CHECK_TIME_SECONDS",
         "stall_shutdown_time": "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS",

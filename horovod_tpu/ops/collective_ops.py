@@ -406,3 +406,48 @@ def synchronize(handle: int):
 def poll(handle: int) -> bool:
     st = _require_init()
     return st.engine.get_handle(handle).done()
+
+
+def check_execution_order() -> int:
+    """Assert every rank executed the identical collective sequence.
+
+    Requires HOROVOD_ORDER_CHECK=1 (see common/config.py): each rank
+    digests executed op names in order; this call (itself a
+    collective — every rank must reach it at the same point)
+    allgathers the digests and raises RuntimeError on divergence.
+    Returns the number of ops folded into the digest so far. The
+    ordering guarantee being asserted is the coordinator's core
+    contract (reference: controller.cc's identical ResponseList on
+    every rank; the runtime assertion itself is an addition the
+    reference lacks, SURVEY.md §5.2).
+    """
+    st = _require_init()
+    oc = st.engine.order_check
+    if oc is None:
+        raise RuntimeError(
+            "check_execution_order() needs HOROVOD_ORDER_CHECK=1 "
+            "(set before hvd.init())")
+    # The gather's name uses the number of CHECK CALLS (same on every
+    # rank by this API's calling contract), NOT the per-rank op count
+    # — a count divergence is exactly what we are detecting, and
+    # baking it into the tensor name would deadlock the negotiation
+    # instead of raising. The count rides the payload.
+    call_idx = oc.checks
+    oc.checks += 1
+    count = oc.count
+    payload = (oc.digest()
+               + int(count).to_bytes(8, "big", signed=False))
+    dig = jnp.asarray(np.frombuffer(payload, np.uint8))
+    gathered = np.asarray(
+        allgather(dig, name=f"__order_check__.{call_idx}"))
+    rows = gathered.reshape(-1, dig.shape[0])
+    if not all(np.array_equal(rows[0], r) for r in rows[1:]):
+        bad = [r for r in range(rows.shape[0])
+               if not np.array_equal(rows[0], rows[r])]
+        counts = [int.from_bytes(bytes(rows[r][-8:].tolist()), "big")
+                  for r in range(rows.shape[0])]
+        raise RuntimeError(
+            f"execution order diverged: rank(s) {bad} executed a "
+            f"different collective sequence than rank 0 "
+            f"(per-rank op counts: {counts})")
+    return count

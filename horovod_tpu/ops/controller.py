@@ -382,6 +382,12 @@ class NegotiatedController:
             live.append(e)
         if not live:
             return
+        if self.engine.order_check is not None:
+            # The agreed order IS the executed order: fold each live
+            # entry in, identically on every rank (including zero-fill
+            # participation on joined ranks).
+            for e in live:
+                self.engine.order_check.record(e.name)
         if tl is not None:
             marked = [e for e in live if e.name in local]
             for e in marked:

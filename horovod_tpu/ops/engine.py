@@ -94,6 +94,10 @@ class Engine:
         self.timeline = None
         self.autotuner = None
         self.controller = None      # negotiated-cycle controller (optional)
+        self.order_check = None
+        if getattr(cfg, "order_check", False):
+            from .order_check import OrderCheck
+            self.order_check = OrderCheck()
         self._shutdown = False
         # Bytes/latency accounting for autotune scoring.
         self._bytes_processed = 0
@@ -149,6 +153,8 @@ class Engine:
             return h
         if self.timeline is not None:
             self.timeline.dispatched(name)
+        if self.order_check is not None:
+            self.order_check.record(name)
         self._bytes_processed += nbytes
         if self.autotuner is not None:
             # Throughput scoring needs the wall time to completion, not

@@ -386,6 +386,9 @@ def make_parser() -> argparse.ArgumentParser:
     tune.add_argument("--autotune-log-file", default=None,
                       help="CSV of autotune samples "
                            "(HOROVOD_AUTOTUNE_LOG)")
+    tune.add_argument("--autotune-mode", default=None,
+                      choices=["hillclimb", "gp"],
+                      help="search strategy (HOROVOD_AUTOTUNE_MODE)")
     tune.add_argument("--autotune-warmup-samples", type=int,
                       default=None,
                       help="HOROVOD_AUTOTUNE_WARMUP_SAMPLES")
@@ -435,6 +438,7 @@ _FLAG_ENV_MAP = [
      lambda v: "1"),
     ("autotune", "HOROVOD_AUTOTUNE", lambda v: "1"),
     ("autotune_log_file", "HOROVOD_AUTOTUNE_LOG", str),
+    ("autotune_mode", "HOROVOD_AUTOTUNE_MODE", str),
     ("autotune_warmup_samples", "HOROVOD_AUTOTUNE_WARMUP_SAMPLES", str),
     ("autotune_steps_per_sample", "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE",
      str),

@@ -1,0 +1,43 @@
+"""2-proc worker for the execution-order assertion: ranks submit the
+same ops in OPPOSITE program order; the negotiated controller must
+still deliver one agreed sequence, so check_execution_order passes.
+Launched by test_order_check.py via the real launcher."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax.numpy as jnp  # noqa: E402
+
+import horovod_tpu as hvd  # noqa: E402
+
+
+def main():
+    os.environ["HOROVOD_ORDER_CHECK"] = "1"
+    hvd.init()
+    r = hvd.rank()
+    names = [f"t{i}" for i in range(8)]
+    order = names if r == 0 else list(reversed(names))
+    handles = [hvd.allreduce_async(jnp.full(4, float(r)), name=n)
+               for n in order]
+    for h in handles:
+        hvd.synchronize(h)
+    n = hvd.check_execution_order()
+    assert n >= len(names), n
+    # a second round reusing the same names (response-cache path);
+    # async like round 1 — SYNCHRONOUS submission in opposite orders
+    # would deadlock by design (each rank blocks on a tensor the
+    # other hasn't announced; the stall inspector's territory).
+    handles = [hvd.allreduce_async(jnp.ones(4), name=nm)
+               for nm in order]
+    for h in handles:
+        hvd.synchronize(h)
+    hvd.check_execution_order()
+    print(f"rank {r}: ORDER CHECK OK ({n} ops at first check)")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

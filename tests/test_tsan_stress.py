@@ -1,0 +1,30 @@
+"""Race detection for the native control plane (SURVEY.md §5.2: the
+reference ships no TSAN harness — this build adds one). Builds the
+ThreadSanitizer-instrumented controller stress binary and runs it:
+zero TSAN reports AND identical agreed order on both ranks required.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+CCDIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "horovod_tpu", "core", "cc")
+
+
+@pytest.mark.integration
+def test_controller_stress_under_tsan():
+    if shutil.which("g++") is None or shutil.which("make") is None:
+        pytest.skip("no C++ toolchain")
+    build = subprocess.run(["make", "-C", CCDIR, "stress_tsan"],
+                           capture_output=True, text=True, timeout=300)
+    if build.returncode != 0:
+        # e.g. libtsan not installed on this host
+        pytest.skip(f"tsan build unavailable: {build.stderr[-500:]}")
+    r = subprocess.run([os.path.join(CCDIR, "stress_tsan")],
+                       capture_output=True, text=True, timeout=180)
+    assert "ThreadSanitizer" not in r.stderr, r.stderr[-3000:]
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr[-2000:])
+    assert "ORDER OK" in r.stdout, r.stdout
