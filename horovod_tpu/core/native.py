@@ -95,12 +95,25 @@ def load():
         path = _lib_path()
         if not _built_fresh():
             if not build() and os.path.exists(path):
+                import shutil
+                from ..common import logging as hlog
+                if shutil.which("make") and shutil.which("g++"):
+                    # Toolchain present but the rebuild FAILED: the
+                    # sources changed and we could not compile them.
+                    # Loading the stale .so would mean a possibly
+                    # wire-incompatible core silently corrupting
+                    # negotiation — refuse, and let init fall back to
+                    # the pure-Python controller.
+                    hlog.error(
+                        "native core: sources changed but rebuild "
+                        "failed; NOT loading stale %s (run `make -C "
+                        "horovod_tpu/core/cc` to see the error)", path)
+                    return None
                 # No toolchain to rebuild with but a .so exists
                 # (prebuilt wheel without its stamp): load it rather
                 # than lose the native core entirely — installs from
                 # this tree always carry a matching stamp, so this
                 # only fires for hand-copied artifacts.
-                from ..common import logging as hlog
                 hlog.warning(
                     "native core: source hash mismatch/missing and "
                     "rebuild unavailable; loading existing %s", path)

@@ -37,9 +37,42 @@ from .process_set import ProcessSet
 from . import dispatch
 
 
+def _use_pallas() -> bool:
+    """HOROVOD_ADASUM_PALLAS: 'auto' (default) = Pallas kernel on TPU,
+    plain jnp elsewhere; 1/0 force it on (interpreter off-TPU) / off.
+    Read at trace time — the choice is baked into the compiled
+    kernel. Prefers the initialized Config (so
+    hvd.init(config_overrides=...) works like every other knob),
+    falling back to the raw env before init."""
+    import os
+    v = None
+    try:
+        from ..common import basics
+        st = basics._state
+        if st is not None and st.engine is not None:
+            v = str(st.engine.cfg.adasum_pallas)
+    except Exception:  # pragma: no cover - pre-init edge
+        pass
+    if v is None:
+        v = os.environ.get("HOROVOD_ADASUM_PALLAS", "auto")
+    v = v.lower()
+    if v in ("1", "true", "yes"):
+        return True
+    if v in ("0", "false", "no"):
+        return False
+    return jax.default_backend() == "tpu"
+
+
 def _pair_combine(a, b):
     """The Adasum combine for one pair, with zero-norm guards
-    (reference: adasum.h ComputeDotAndNormSqrds + ScaledAdd)."""
+    (reference: adasum.h ComputeDotAndNormSqrds + ScaledAdd). The
+    Pallas path (ops/pallas_kernels.py) fuses the three reductions
+    and the scaled add into two HBM passes; complex dtypes stay on
+    the jnp path (the kernel accumulates in real f32 and would drop
+    the imaginary parts and the conjugated dot)."""
+    if _use_pallas() and not jnp.iscomplexobj(a):
+        from .pallas_kernels import pair_combine
+        return pair_combine(a, b)
     dot = jnp.vdot(a, b).real.astype(jnp.float32)
     asq = jnp.vdot(a, a).real.astype(jnp.float32)
     bsq = jnp.vdot(b, b).real.astype(jnp.float32)

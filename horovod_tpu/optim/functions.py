@@ -93,3 +93,30 @@ def broadcast_object(obj: Any, root_rank: int = 0,
     out = dispatch.broadcast(jnp.asarray(data), set_root, pset)
     raw = bytes(np.asarray(out).tobytes())
     return pickle.loads(raw)
+
+
+def allgather_object(obj: Any,
+                     name: Optional[str] = None,
+                     process_set: Optional[ProcessSet] = None) -> list:
+    """Gather one arbitrary picklable object per rank; every rank
+    returns the rank-ordered list (reference:
+    horovod/torch/mpi_ops.py allgather_object — pickle to a byte
+    tensor, uneven allgather, unpickle per rank)."""
+    st = _require_init()
+    pset = process_set or st.process_set_table.global_set
+    if pset.size == 1:
+        return [obj]
+    payload = pickle.dumps(obj)
+    data = jnp.asarray(np.frombuffer(payload, dtype=np.uint8))
+    name = name or st.engine.auto_name("allgather_object")
+    # Uneven first-dim allgather: per-rank sizes ride the negotiation
+    # metadata (ops/collective_ops.allgather_async), so this is one
+    # collective, not size+payload rounds.
+    sizes = C.allgather(jnp.asarray([data.shape[0]], jnp.int32),
+                        name=name + ".sizes", process_set=pset)
+    blob = np.asarray(C.allgather(data, name=name, process_set=pset))
+    out, off = [], 0
+    for n in np.asarray(sizes).reshape(-1):
+        out.append(pickle.loads(blob[off:off + int(n)].tobytes()))
+        off += int(n)
+    return out

@@ -75,6 +75,12 @@ def main():
     obj = hvd.broadcast_object({"epoch": r * 10}, root_rank=0)
     assert obj == {"epoch": 0}
 
+    # allgather_object: rank-varying payload SIZES (uneven gather)
+    got = hvd.allgather_object({"rank": r, "pad": "x" * (10 * (r + 1))})
+    assert [g["rank"] for g in got] == list(range(hvd.size())), got
+    assert all(len(g["pad"]) == 10 * (i + 1)
+               for i, g in enumerate(got)), got
+
     print(f"worker rank={r}: ALL OK")
     hvd.shutdown()
 

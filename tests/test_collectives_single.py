@@ -122,6 +122,11 @@ def test_compression_bf16_roundtrip(hvd_single):
     np.testing.assert_allclose(np.asarray(out), np.asarray(x), atol=5e-2)
 
 
+def test_allgather_object_single(hvd_single):
+    import horovod_tpu as hvd
+    assert hvd.allgather_object({"a": [1, 2]}) == [{"a": [1, 2]}]
+
+
 def test_broadcast_object_single(hvd_single):
     from horovod_tpu.optim.functions import broadcast_object
     obj = {"epoch": 3, "name": "x"}

@@ -99,7 +99,7 @@ class TestElastic:
         try:
             time.sleep(8)  # let the 2-proc world make progress
             hosts_file.write_text("localhost:3\n")
-            out, _ = p.communicate(timeout=240)
+            out, _ = p.communicate(timeout=420)
         finally:
             if p.poll() is None:
                 p.kill()
@@ -126,7 +126,7 @@ class TestElastic:
         env = make_env(tmp_path, steps=12, sleep=0.2)
         env["ELASTIC_TEST_DIE_AT"] = "4"  # rank 1 exits at step 4
         p = launch(script, env, extra=("--reset-limit", "3"))
-        out, _ = p.communicate(timeout=240)
+        out, _ = p.communicate(timeout=420)
         assert p.returncode == 0, out
         lines = read_logs(tmp_path)
         assert sum("done" in ln for ln in lines) >= 2, (lines, out)

@@ -82,6 +82,8 @@ class TestExamples:
              "--image-size", "32", "--batch-size", "2",
              "--snapshot", str(tmp_path / "snap.bin")],
             cwd=REPO, env=env, capture_output=True, text=True,
-            timeout=300)
+            # ~230s alone (two CPU ResNet compiles); leave headroom
+            # for a loaded machine running the full suite.
+            timeout=600)
         assert r.returncode == 0, r.stdout + r.stderr
         assert "elastic training complete" in r.stdout
