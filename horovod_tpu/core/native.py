@@ -97,7 +97,9 @@ def load():
             if not build() and os.path.exists(path):
                 import shutil
                 from ..common import logging as hlog
-                if shutil.which("make") and shutil.which("g++"):
+                # Same compiler resolution as the Makefile (CXX ?= g++)
+                cxx = os.environ.get("CXX", "g++").split()[0]
+                if shutil.which("make") and shutil.which(cxx):
                     # Toolchain present but the rebuild FAILED: the
                     # sources changed and we could not compile them.
                     # Loading the stale .so would mean a possibly

@@ -63,14 +63,21 @@ def _use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def _pair_combine(a, b):
+def _pallas_ok_dtype(dtype) -> bool:
+    """Dtypes the Pallas kernel handles without semantic loss: its f32
+    accumulation would drop imaginary parts (complex) or truncate
+    precision (float64), so those stay on the jnp path."""
+    return jnp.dtype(dtype) in (jnp.dtype(jnp.float32),
+                                jnp.dtype(jnp.bfloat16),
+                                jnp.dtype(jnp.float16))
+
+
+def _pair_combine(a, b, use_pallas: bool = False):
     """The Adasum combine for one pair, with zero-norm guards
     (reference: adasum.h ComputeDotAndNormSqrds + ScaledAdd). The
     Pallas path (ops/pallas_kernels.py) fuses the three reductions
-    and the scaled add into two HBM passes; complex dtypes stay on
-    the jnp path (the kernel accumulates in real f32 and would drop
-    the imaginary parts and the conjugated dot)."""
-    if _use_pallas() and not jnp.iscomplexobj(a):
+    and the scaled add into two HBM passes."""
+    if use_pallas:
         from .pallas_kernels import pair_combine
         return pair_combine(a, b)
     dot = jnp.vdot(a, b).real.astype(jnp.float32)
@@ -81,7 +88,7 @@ def _pair_combine(a, b):
     return ca.astype(a.dtype) * a + cb.astype(b.dtype) * b
 
 
-def _tree_fold(rows):
+def _tree_fold(rows, use_pallas: bool = False):
     """Deterministic binary-tree fold of (n, d) stacked contributions.
     Odd member passes through to the next round, matching the
     reference's handling of non-power-of-two groups."""
@@ -89,7 +96,8 @@ def _tree_fold(rows):
     while len(items) > 1:
         nxt = []
         for i in range(0, len(items) - 1, 2):
-            nxt.append(_pair_combine(items[i], items[i + 1]))
+            nxt.append(_pair_combine(items[i], items[i + 1],
+                                     use_pallas))
         if len(items) % 2:
             nxt.append(items[-1])
         items = nxt
@@ -97,7 +105,10 @@ def _tree_fold(rows):
 
 
 @functools.lru_cache(maxsize=None)
-def _adasum_kernel(mesh, n: int, sig: Tuple):
+def _adasum_kernel(mesh, n: int, sig: Tuple, use_pallas: bool = False):
+    # use_pallas is part of the cache key on purpose: a re-init with a
+    # different HOROVOD_ADASUM_PALLAS must not reuse a kernel traced
+    # with the old choice.
     shapes = [s for s, _ in sig]
     sizes = [int(np.prod(s)) if s else 1 for s in shapes]
 
@@ -105,7 +116,7 @@ def _adasum_kernel(mesh, n: int, sig: Tuple):
         flats = [b.reshape(-1) for b in blocks]
         concat = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
         g = lax.all_gather(concat, "proc")          # (n, total)
-        red = _tree_fold([g[i] for i in range(n)])
+        red = _tree_fold([g[i] for i in range(n)], use_pallas)
         outs = []
         off = 0
         for s, sz in zip(shapes, sizes):
@@ -137,7 +148,9 @@ def adasum_allreduce(tensors: List[jax.Array], pset: ProcessSet,
         return scale(scale(tensors, prescale), postscale)
     tensors = scale(tensors, prescale)
     sig = dispatch._sig(tensors)
-    kern = _adasum_kernel(pset.mesh, pset.size, sig)
+    use_pallas = _use_pallas() and all(
+        _pallas_ok_dtype(t.dtype) for t in tensors)
+    kern = _adasum_kernel(pset.mesh, pset.size, sig, use_pallas)
     gins = [dispatch.to_global(t, pset) for t in tensors]
     gouts = kern(*gins)
     return scale([dispatch.local_shard(g) for g in gouts], postscale)

@@ -284,6 +284,30 @@ def _allgather_kernel(mesh, n: int, sizes: Tuple[int, ...], sig: Tuple):
 
 
 @functools.lru_cache(maxsize=None)
+def _allgather_kernel_hier(mesh, n: int, sizes: Tuple[int, ...],
+                           sig: Tuple):
+    """Hierarchical allgather over a ('cross', 'local') mesh:
+    all-gather within the slice (ICI) first, then exchange the
+    concatenated slice blocks across slices (DCN) — the reference's
+    HOROVOD_HIERARCHICAL_ALLGATHER staging (NCCL-local + MPI-cross,
+    nccl_operations.cc) re-landed on the hybrid mesh. Slice-aligned
+    rank r = cross*L + local, so gathering local-then-cross already
+    yields global rank order."""
+    L = mesh.shape["local"]
+
+    def body(block):
+        g_local = lax.all_gather(block[0], "local")     # (L, maxr,*)
+        g = lax.all_gather(g_local, "cross")            # (n/L, L, ...)
+        pieces = [g[i // L, i % L, : sizes[i]] for i in range(n)]
+        return jnp.concatenate(pieces, axis=0)[None]
+
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=P(("cross", "local")),
+                       out_specs=P(("cross", "local")))
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
 def _broadcast_kernel(mesh, n: int, root: int, sig: Tuple):
     def body(block):
         idx = lax.axis_index("proc")
@@ -446,9 +470,18 @@ def allgather(tensor: jax.Array, pset: ProcessSet,
     if x.shape[0] < maxr:
         pad = [(0, maxr - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
         x = jnp.pad(x, pad)
-    kern = _allgather_kernel(pset.mesh, n, tuple(int(r) for r in all_rows),
-                             _sig([x]))
-    out = local_shard(kern(to_global(x, pset)))
+    rows = tuple(int(r) for r in all_rows)
+    mesh2 = _hier_mesh(pset)
+    if mesh2 is not None:
+        # HOROVOD_HIERARCHICAL_ALLREDUCE also stages allgathers
+        # (reference: HOROVOD_HIERARCHICAL_ALLGATHER): ICI gather
+        # within the slice, DCN exchange of slice blocks across.
+        kern = _allgather_kernel_hier(mesh2, n, rows, _sig([x]))
+        gin = to_global(x, pset, mesh=mesh2, spec=P(("cross", "local")))
+    else:
+        kern = _allgather_kernel(pset.mesh, n, rows, _sig([x]))
+        gin = to_global(x, pset)
+    out = local_shard(kern(gin))
     return out.astype(jnp.bool_) if was_bool else out
 
 

@@ -107,16 +107,19 @@ def allgather_object(obj: Any,
     if pset.size == 1:
         return [obj]
     payload = pickle.dumps(obj)
-    data = jnp.asarray(np.frombuffer(payload, dtype=np.uint8))
+    # Length-prefix each rank's pickle so ONE uneven allgather carries
+    # everything (per-rank first-dim sizes ride the negotiation
+    # metadata; the prefix lets the receiver walk the concatenated
+    # blob without a separate sizes collective).
+    framed = len(payload).to_bytes(8, "big") + payload
+    data = jnp.asarray(np.frombuffer(framed, dtype=np.uint8))
     name = name or st.engine.auto_name("allgather_object")
-    # Uneven first-dim allgather: per-rank sizes ride the negotiation
-    # metadata (ops/collective_ops.allgather_async), so this is one
-    # collective, not size+payload rounds.
-    sizes = C.allgather(jnp.asarray([data.shape[0]], jnp.int32),
-                        name=name + ".sizes", process_set=pset)
-    blob = np.asarray(C.allgather(data, name=name, process_set=pset))
+    blob = bytes(np.asarray(
+        C.allgather(data, name=name, process_set=pset)).tobytes())
     out, off = [], 0
-    for n in np.asarray(sizes).reshape(-1):
-        out.append(pickle.loads(blob[off:off + int(n)].tobytes()))
-        off += int(n)
+    for _ in range(pset.size):
+        n = int.from_bytes(blob[off:off + 8], "big")
+        off += 8
+        out.append(pickle.loads(blob[off:off + n]))
+        off += n
     return out

@@ -74,15 +74,32 @@ def test_bf16_inputs_accumulate_in_f32():
                                rtol=0.05, atol=0.05)
 
 
-def test_forced_pallas_path_in_adasum_allreduce(monkeypatch, hvd_single):
-    """HOROVOD_ADASUM_PALLAS=1 routes the public Adasum op through the
-    kernel (interpreter here); result matches the numpy model."""
+def test_forced_pallas_path_in_adasum_allreduce():
+    """HOROVOD_ADASUM_PALLAS=1 (via config_overrides, the public way)
+    routes the Adasum op through the kernel — interpreter here — and
+    the kernel choice is part of the trace-cache key, so this init's
+    setting cannot reuse a kernel traced with the other choice."""
     import horovod_tpu as hvd
     from horovod_tpu.ops import adasum as adasum_mod
-    monkeypatch.setenv("HOROVOD_ADASUM_PALLAS", "1")
-    adasum_mod._adasum_kernel.cache_clear()  # force a re-trace
-    x = jnp.asarray(np.arange(1000, dtype=np.float32))
-    out = hvd.allreduce(x, op=hvd.Adasum, name="pallas_adasum")
-    # single process: Adasum of one contribution is identity
-    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
-    adasum_mod._adasum_kernel.cache_clear()
+    hvd.init(config_overrides={"HOROVOD_ADASUM_PALLAS": "1"})
+    try:
+        assert adasum_mod._use_pallas() is True
+        x = jnp.asarray(np.arange(1000, dtype=np.float32))
+        out = hvd.allreduce(x, op=hvd.Adasum, name="pallas_adasum")
+        # single process: Adasum of one contribution is identity
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+    finally:
+        hvd.shutdown()
+
+
+def test_adasum_kernel_cache_keyed_on_pallas_choice(hvd_single):
+    """Same mesh/sig with a different use_pallas flag must be a
+    distinct compiled kernel, not a cache hit."""
+    from horovod_tpu.common.basics import _require_init
+    from horovod_tpu.ops import adasum as adasum_mod
+    from horovod_tpu.ops import dispatch
+    pset = _require_init().process_set_table.global_set
+    sig = dispatch._sig([jnp.ones(8)])
+    k_off = adasum_mod._adasum_kernel(pset.mesh, 2, sig, False)
+    k_on = adasum_mod._adasum_kernel(pset.mesh, 2, sig, True)
+    assert k_off is not k_on
