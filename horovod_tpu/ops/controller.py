@@ -85,7 +85,13 @@ class _PendingGeneric:
 class PythonCore:
     """In-process stand-in for the native core: same submit/next_batch
     protocol, single-process only (reference analog: running with one
-    rank, where negotiation degenerates to local FIFO + fusion)."""
+    rank, where negotiation degenerates to local FIFO + fusion).
+
+    Intentional semantic divergences from the C++ core, acceptable
+    because there are no peers: no cross-rank signature-mismatch
+    checking (nothing to mismatch against) and therefore no error
+    entries in batches; fusion packing is the same greedy same-key
+    rule but runs on the caller's thread, not a cycle thread."""
 
     def __init__(self, fusion_threshold: int):
         self.fusion_threshold = fusion_threshold
@@ -466,12 +472,18 @@ class NegotiatedController:
                        else pset.size)
             eff_op, eff_post = SUM, post / max(divisor, 1)
         try:
-            if rop == ADASUM:
-                from .adasum import adasum_allreduce
-                outs = adasum_allreduce(tensors, pset, pre, post)
-            else:
-                outs = dispatch.allreduce_group(tensors, pset, eff_op,
-                                                pre, eff_post)
+            # One profiler span per fused launch: shows up in
+            # jax.profiler/XPlane next to the device collective.
+            label = (f"hvd::fused_allreduce[{len(entries)}]"
+                     if len(entries) > 1 else
+                     f"hvd::{entries[0].name}")
+            with jax.profiler.TraceAnnotation(label):
+                if rop == ADASUM:
+                    from .adasum import adasum_allreduce
+                    outs = adasum_allreduce(tensors, pset, pre, post)
+                else:
+                    outs = dispatch.allreduce_group(
+                        tensors, pset, eff_op, pre, eff_post)
         except BaseException as ex:
             for e, p, cnt in slots:
                 if p is not None:

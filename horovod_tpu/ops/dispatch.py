@@ -131,7 +131,10 @@ def _allreduce_kernel(mesh, n: int, op: int, prescale: float,
             return lax.pmax(flat, "proc")
         if op == PRODUCT:
             g = lax.all_gather(flat, "proc")
-            return jnp.prod(g, axis=0)
+            # dtype= pins the accumulator: jnp.prod would silently
+            # upcast sub-32-bit ints (uint8 -> uint32), breaking the
+            # reference's dtype-preserving allreduce contract.
+            return jnp.prod(g, axis=0, dtype=flat.dtype)
         raise ValueError(f"unknown reduce op {op}")
 
     def body(*blocks):

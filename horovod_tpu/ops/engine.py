@@ -144,7 +144,12 @@ class Engine:
         if self.timeline is not None:
             self.timeline.enqueue(name)
         try:
-            result = fn()
+            # TraceAnnotation names the host-side dispatch span in
+            # jax.profiler/XPlane traces so device timelines line up
+            # with the per-tensor semantic lanes (SURVEY.md §5.1's
+            # "rebuild the semantic layer" guidance).
+            with jax.profiler.TraceAnnotation(f"hvd::{name}"):
+                result = fn()
             h.set_result(result)
         except BaseException as e:
             h.set_error(e)

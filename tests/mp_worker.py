@@ -6,6 +6,9 @@ import os
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# 64-bit rows of the dtype matrix need real x64 (this process is NOT
+# under conftest.py's jax_enable_x64).
+os.environ.setdefault("JAX_ENABLE_X64", "1")
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -64,6 +67,36 @@ def main():
     out = hvd.reducescatter(x, op=hvd.Sum, name="t6")
     np.testing.assert_allclose(
         np.asarray(out), np.full((2, 3), sum(range(1, n + 1))))
+
+    # dtype x op matrix on the negotiated path (reference analog:
+    # test_torch.py's exhaustive dtype/op coverage under -np 2).
+    # Rank r contributes full((r+2)); closed forms below.
+    matrix_dtypes = [jnp.float32, jnp.float64, jnp.bfloat16,
+                     jnp.float16, jnp.int32, jnp.int64, jnp.uint8]
+    vals = [i + 2 for i in range(n)]
+    for dt in matrix_dtypes:
+        is_float = jnp.issubdtype(dt, jnp.floating)
+        ops = [(hvd.Sum, float(sum(vals))),
+               (hvd.Min, float(min(vals))),
+               (hvd.Max, float(max(vals))),
+               (hvd.Product, float(np.prod(vals)))]
+        if is_float:
+            ops.append((hvd.Average, sum(vals) / n))
+        for op_, want in ops:
+            x = jnp.full((4, 3), r + 2, dt)
+            out = hvd.allreduce(x, op=op_,
+                                name=f"mx.{np.dtype(dt).name}.{op_}")
+            assert out.dtype == x.dtype, (out.dtype, dt)
+            tol = 5e-2 if dt in (jnp.bfloat16, jnp.float16) else 1e-6
+            np.testing.assert_allclose(
+                np.asarray(out, np.float64), np.full((4, 3), want),
+                rtol=tol)
+    # bool allgather/broadcast (the reference covers bool paths too)
+    out = hvd.allgather(jnp.asarray([r % 2 == 0] * 2), name="mx.bool")
+    assert out.dtype == jnp.bool_ and out.shape[0] == 2 * n
+    out = hvd.broadcast(jnp.asarray([True, False]), root_rank=0,
+                        name="mx.bool.bc")
+    assert bool(out[0]) and not bool(out[1])
 
     # barrier + broadcast_parameters + optimizer functions
     hvd.barrier()

@@ -87,3 +87,28 @@ class TestExamples:
             timeout=600)
         assert r.returncode == 0, r.stdout + r.stderr
         assert "elastic training complete" in r.stdout
+
+
+@pytest.mark.integration
+class TestParallelismExamples:
+    """SP/EP showcase examples on the 8-device virtual CPU mesh."""
+
+    def test_ring_attention_long_context(self):
+        r = run_example(
+            "ring_attention_long_context.py",
+            ["--seq-len", "256", "--heads", "2", "--head-dim", "16",
+             "--verify"],
+            env_extra={"XLA_FLAGS":
+                       "--xla_force_host_platform_device_count=8"})
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "verified against full attention" in r.stdout
+
+    def test_moe_expert_parallel(self):
+        r = run_example(
+            "moe_expert_parallel.py",
+            ["--experts", "8", "--tokens", "64", "--d-model", "32",
+             "--d-ff", "64"],
+            env_extra={"XLA_FLAGS":
+                       "--xla_force_host_platform_device_count=8"})
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "expert-parallel MoE OK" in r.stdout
