@@ -14,7 +14,10 @@ Prints ONE JSON line:
 
 vs_baseline: BASELINE.json carries no absolute reference img/sec
 (`published` is empty — see BASELINE.md provenance note), so the ratio
-is reported against BENCH_BASELINE_IMG_SEC if set, else 1.0.
+is reported against BENCH_BASELINE_IMG_SEC if set; otherwise against
+the FIRST recorded round's number (the lowest-numbered BENCH_r*.json
+beside this script — cross-round progress on the same hardware); 1.0
+when neither exists.
 
 MFU is reported to stderr from the XLA-compiled FLOP count and the
 chip's peak (device_kind table below, override with
@@ -199,6 +202,27 @@ def main():
             f"compiled)")
 
     baseline = float(os.environ.get("BENCH_BASELINE_IMG_SEC", "0")) or None
+    if baseline is None:
+        # BASELINE.json's `published` is empty (see BASELINE.md
+        # provenance note), so the most meaningful ratio is against
+        # the FIRST recorded round on this same hardware — cross-round
+        # progress rather than a vacuous 1.0.
+        here = os.path.dirname(os.path.abspath(__file__))
+        for fname in sorted(os.listdir(here)):
+            if fname.startswith("BENCH_r") and fname.endswith(".json"):
+                try:
+                    with open(os.path.join(here, fname)) as f:
+                        doc = json.load(f)
+                    rec = doc.get("parsed") or {}
+                    if rec.get("metric") == \
+                            "resnet50_synthetic_train_img_sec_per_chip":
+                        baseline = float(rec["value"])
+                        log(f"bench: vs_baseline uses {fname} "
+                            f"({baseline:.1f} img/sec/chip)")
+                        break
+                except (OSError, ValueError, KeyError, TypeError,
+                        AttributeError):
+                    continue
     vs = img_sec_chip / baseline if baseline else 1.0
     print(json.dumps({
         "metric": "resnet50_synthetic_train_img_sec_per_chip",

@@ -76,6 +76,27 @@ def rocm_built() -> bool:
     return False
 
 
+def nccl_enabled() -> bool:
+    return False
+
+
+def mpi_enabled() -> bool:
+    return False
+
+
+def gloo_enabled() -> bool:
+    return False
+
+
+def mpi_threads_supported() -> bool:
+    """Reference API shim (horovod/torch/mpi_ops.py
+    mpi_threads_supported). There is no MPI: the coordination service
+    and XLA runtime are thread-safe by construction, but the honest
+    answer to 'is MPI multithreading supported' is that MPI is not
+    present at all."""
+    return False
+
+
 def check_build_summary() -> str:
     import jax
     lines = ["horovod_tpu capability matrix:"]

@@ -105,3 +105,21 @@ def test_process_set_out_of_range(hvd_single):
     import horovod_tpu as hvd
     with pytest.raises(ValueError, match="out of range"):
         hvd.add_process_set([0, 5])
+
+
+def test_capability_shims_match_reference_contract():
+    """The reference's capability probes must exist and answer
+    honestly: no NCCL/MPI/Gloo anywhere (the data plane is XLA over
+    PJRT), XLA always built (reference: horovod/metadata and
+    mpi_ops.py mpi_threads_supported)."""
+    import horovod_tpu as hvd
+    assert hvd.nccl_built() is False
+    assert hvd.mpi_built() is False
+    assert hvd.gloo_built() is False
+    assert hvd.cuda_built() is False
+    assert hvd.rocm_built() is False
+    assert hvd.nccl_enabled() is False
+    assert hvd.mpi_enabled() is False
+    assert hvd.gloo_enabled() is False
+    assert hvd.mpi_threads_supported() is False
+    assert hvd.xla_built() is True
