@@ -16,7 +16,6 @@ machinery collapses to this one election.
 
 from __future__ import annotations
 
-import shlex
 import subprocess
 import sys
 import threading
@@ -250,17 +249,21 @@ def spawn_task_service(host: str, host_id: str, driver_addrs: str,
     """Start a task service on `host` (subprocess locally, ssh
     remotely) — reference: the driver ssh'ing task servers onto every
     host before launch. The remote path reuses launch._ssh_command so
-    secret handling (stdin, never argv) has a single implementation."""
-    from .launch import _ssh_command, _write_secret_stdin
+    env/secret handling (ssh stdin, never argv) has a single
+    implementation; forwarding the launcher's full environment here is
+    also what carries user variables to --driver workers (they inherit
+    the task service's env)."""
+    import os
+    from .launch import _ssh_command, _write_env_stdin
     inner = [sys.executable, "-m", "horovod_tpu.runner.task_service",
              host_id, driver_addrs]
     if is_local:
-        import os
         env = dict(os.environ)
         env[_secret.ENV_VAR] = job_secret
         return subprocess.Popen(inner, env=env, cwd=cwd)
-    cmd = _ssh_command(host, inner, {"PYTHONPATH": cwd}, ssh_port,
-                       secret_on_stdin=True)
+    cmd = _ssh_command(host, inner, ssh_port)
     p = subprocess.Popen(cmd, stdin=subprocess.PIPE)
-    _write_secret_stdin(p, job_secret)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = cwd + os.pathsep + env.get("PYTHONPATH", "")
+    _write_env_stdin(p, env, job_secret)
     return p

@@ -37,7 +37,7 @@ from ...common import logging as hlog
 from .. import secret as _secret
 from ..hosts import HostSlots, RankInfo, assign_ranks
 from ..launch import (_prefix_pump, _ssh_command,
-                      _write_secret_stdin, free_port)
+                      _write_env_stdin, free_port)
 from ..service import BasicClient
 from .discovery import HostDiscovery, hosts_key
 from .rendezvous import RendezvousServer
@@ -138,10 +138,9 @@ class ElasticDriver:
             cmd = self.command
             popen_env = child_env
         else:
-            # secret_on_stdin: the HMAC key must not appear in the
-            # remote argv (see _ssh_command).
-            cmd = _ssh_command(info.host, self.command, child_env, None,
-                               secret_on_stdin=True)
+            # The whole worker env (incl. the HMAC key) rides the ssh
+            # stdin pipe, never the argv (see _ssh_command).
+            cmd = _ssh_command(info.host, self.command)
             popen_env = dict(os.environ)
         if self.verbose:
             print(f"[elastic] spawn rank {info.rank} on {info.host}",
@@ -152,7 +151,7 @@ class ElasticDriver:
                              stdout=subprocess.PIPE,
                              stderr=subprocess.PIPE)
         if not info.is_local:
-            _write_secret_stdin(p, self.secret)
+            _write_env_stdin(p, child_env)
         slot = _Slot(info, p)
         tag = f"{info.rank}"
         t1 = threading.Thread(target=_prefix_pump,

@@ -34,6 +34,20 @@ from .hosts import RankInfo, assign_ranks, parse_hosts
 FORWARD_PREFIXES = ("HOROVOD_", "JAX_", "XLA_", "TPU_", "LIBTPU_",
                     "PYTHON", "PATH", "LD_LIBRARY_PATH", "HOME")
 
+# Never forwarded to remote ranks: host-specific shell state and ssh
+# agent plumbing. Prefix entries end with "_"; the rest match exactly
+# (so e.g. a user's TERMINATION_GRACE is not eaten by TERM).
+SSH_ENV_BLOCK_PREFIXES = ("SSH_", "XDG_", "DBUS_", "BASH_FUNC_")
+SSH_ENV_BLOCK_EXACT = frozenset(
+    {"HOSTNAME", "PWD", "OLDPWD", "SHLVL", "TERM", "DISPLAY",
+     "LS_COLORS", "_"})
+
+
+def _forwardable(k: str) -> bool:
+    return (k.isidentifier()
+            and not k.startswith(SSH_ENV_BLOCK_PREFIXES)
+            and k not in SSH_ENV_BLOCK_EXACT)
+
 
 def free_port() -> int:
     with socket.socket() as s:
@@ -61,24 +75,22 @@ def build_env(info: RankInfo, coordinator: str,
 
 
 def _ssh_command(host: str, command: List[str],
-                 env: Dict[str, str], ssh_port: Optional[int],
-                 secret_on_stdin: bool = False) -> List[str]:
-    """Build the remote exec command. The job secret NEVER rides the
-    argv (argv is world-readable via /proc on the remote host, which
-    would hand the HMAC key to any local user): with secret_on_stdin
-    the remote shell reads it from the ssh stdin pipe instead, and the
-    caller feeds it with _write_secret_stdin after spawn. This is THE
-    ssh assembly point — every remote spawn (static launch, elastic
-    driver, task services) goes through it so secret handling has one
-    implementation."""
-    exports = " ".join(
-        f"{k}={shlex.quote(v)}" for k, v in sorted(env.items())
-        if k.startswith(FORWARD_PREFIXES) and k != _secret.ENV_VAR)
-    prefix = ""
-    if secret_on_stdin:
-        prefix = (f"IFS= read -r {_secret.ENV_VAR}; "
-                  f"export {_secret.ENV_VAR}; ")
-    remote = f"{prefix}cd {shlex.quote(os.getcwd())} && env {exports} " + \
+                 ssh_port: Optional[int] = None) -> List[str]:
+    """Build the remote exec command. NOTHING from the environment
+    rides the argv — argv is world-readable via /proc on both hosts,
+    so inlined exports would expose every launcher credential (cloud
+    keys, API tokens) plus the job's HMAC secret to any local user.
+    Instead the remote shell reads ONE base64 line of `export` script
+    from the ssh stdin pipe (fed by _write_env_stdin) and evals it:
+    the full environment (reference parity with gloo_run's full-env
+    forwarding, minus host-specific shell state) arrives over the
+    encrypted channel, with no 128 KiB argv ceiling. This is THE ssh
+    assembly point — static launch, elastic driver, and task-service
+    spawns all go through it."""
+    prefix = ('IFS= read -r __HVD_ENV; '
+              'eval "$(printf %s "$__HVD_ENV" | base64 -d)"; '
+              'unset __HVD_ENV; ')
+    remote = f"{prefix}cd {shlex.quote(os.getcwd())} && exec " + \
         " ".join(shlex.quote(c) for c in command)
     cmd = ["ssh", "-o", "StrictHostKeyChecking=no"]
     if ssh_port:
@@ -87,12 +99,21 @@ def _ssh_command(host: str, command: List[str],
     return cmd
 
 
-def _write_secret_stdin(p: subprocess.Popen, secret: str) -> None:
-    """Feed the job secret to a remote child started with
-    secret_on_stdin. A child that died instantly is tolerated — its
-    exit surfaces through the caller's normal failure path."""
+def _write_env_stdin(p: subprocess.Popen, env: Dict[str, str],
+                     secret: Optional[str] = None) -> None:
+    """Feed the forwarded environment (plus the job secret) to a
+    remote child as one base64 line of `export` script. A child that
+    died instantly is tolerated — its exit surfaces through the
+    caller's normal failure path."""
+    import base64
+    items = {k: v for k, v in env.items() if _forwardable(k)}
+    if secret is not None:
+        items[_secret.ENV_VAR] = secret
+    script = "\n".join(
+        f"export {k}={shlex.quote(v)}" for k, v in sorted(items.items()))
+    line = base64.b64encode(script.encode()) + b"\n"
     try:
-        p.stdin.write((secret + "\n").encode())
+        p.stdin.write(line)
         p.stdin.close()
     except OSError:
         pass
@@ -144,8 +165,7 @@ def run(command: List[str], np_: int = 1, hosts: Optional[str] = None,
                 cmd = command
                 popen_env = child_env
             else:
-                cmd = _ssh_command(info.host, command, child_env,
-                                   ssh_port, secret_on_stdin=True)
+                cmd = _ssh_command(info.host, command, ssh_port)
                 popen_env = dict(os.environ)
             if verbose:
                 print(f"[launcher] rank {info.rank} on {info.host}: "
@@ -156,7 +176,7 @@ def run(command: List[str], np_: int = 1, hosts: Optional[str] = None,
                                  stdout=subprocess.PIPE,
                                  stderr=subprocess.PIPE)
             if not info.is_local:
-                _write_secret_stdin(p, job_secret)
+                _write_env_stdin(p, child_env)
             procs.append(p)
             if output_filename:
                 fo = open(f"{output_filename}.{info.rank}.out", "wb")

@@ -173,3 +173,29 @@ def test_jax_state_orbax_snapshot_roundtrip(tmp_path, hvd_single):
     st2.restore()
     np.testing.assert_allclose(np.asarray(st2.params["w"]),
                                np.full(4, 9.0))
+
+
+@pytest.mark.integration
+def test_elastic_remote_spawn_via_ssh_shim(tmp_path):
+    """Elastic driver's remote-spawn branch through the fake-ssh shim
+    (see test_runner._write_fake_ssh): workers on 'fakehost' are
+    spawned with the secret on stdin and the full (blocklist-filtered)
+    env inlined; the job completes and the secret never rides argv."""
+    import socket
+    from tests.test_runner import _write_fake_ssh
+    _, log = _write_fake_ssh(tmp_path)
+    # The real hostname: not in LOCALHOSTS (so the ssh branch fires)
+    # but resolvable, which elastic needs — rank 0 lives on the
+    # "remote" host and every worker must reach its coordinator.
+    host = socket.gethostname()
+    script = write_discovery(tmp_path, f"echo {host}:2")
+    env = make_env(tmp_path, steps=4, sleep=0.05)
+    env["PATH"] = str(tmp_path) + os.pathsep + env["PATH"]
+    p = launch(script, env)
+    out, _ = p.communicate(timeout=420)
+    assert p.returncode == 0, out
+    lines = read_logs(tmp_path)
+    assert sum("done" in ln for ln in lines) == 2, (lines, out)
+    argv = log.read_text()
+    assert "HOROVOD_SECRET=" not in argv
+    assert "read -r __HVD_ENV" in argv
