@@ -32,6 +32,7 @@ from .ops.compression import Compression  # noqa: F401
 from .ops.process_set import ProcessSet  # noqa: F401
 from .metadata import (  # noqa: F401
     nccl_built, mpi_built, gloo_built, cuda_built, rocm_built,
+    ddl_built, ccl_built,
     nccl_enabled, mpi_enabled, gloo_enabled, mpi_threads_supported,
     xla_built, tpu_available, check_build_summary,
 )

@@ -76,6 +76,14 @@ def rocm_built() -> bool:
     return False
 
 
+def ddl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
 def nccl_enabled() -> bool:
     return False
 

@@ -264,6 +264,9 @@ def spawn_task_service(host: str, host_id: str, driver_addrs: str,
     cmd = _ssh_command(host, inner, ssh_port)
     p = subprocess.Popen(cmd, stdin=subprocess.PIPE)
     env = dict(os.environ)
-    env["PYTHONPATH"] = cwd + os.pathsep + env.get("PYTHONPATH", "")
+    pp = env.get("PYTHONPATH")
+    # no trailing separator when PYTHONPATH was unset: an empty
+    # element would add the remote's cwd to sys.path implicitly
+    env["PYTHONPATH"] = cwd + (os.pathsep + pp if pp else "")
     _write_env_stdin(p, env, job_secret)
     return p

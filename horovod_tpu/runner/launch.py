@@ -6,7 +6,10 @@ rank needs (HOROVOD_RANK/SIZE/..., HOROVOD_COORDINATOR_ADDR pointing
 at the rank-0 JAX coordination service = rendezvous + KV store +
 heartbeat, replacing the reference's HTTP rendezvous + gloo store).
 Local ranks are subprocesses; remote hosts are reached over ssh with
-env inlined (reference: horovod/runner/util/remote.py).
+the full (blocklist-filtered) environment delivered over the ssh
+stdin pipe as a base64 export script — never inlined into argv, which
+is world-readable via /proc (reference: horovod/runner/util/remote.py
+for the exec; the env transport is hardened relative to it).
 
 Usage:
     python -m horovod_tpu.runner -np 4 python train.py
@@ -150,10 +153,11 @@ def run(command: List[str], np_: int = 1, hosts: Optional[str] = None,
     lock = threading.Lock()
     sinks = []
 
-    # Per-job HMAC key, forwarded to every rank (HOROVOD_ prefix is in
-    # the ssh export list); any launcher-side service a worker talks to
-    # authenticates with it (reference: secret.py in the reference
-    # launcher, used by its driver/task/rendezvous RPCs).
+    # Per-job HMAC key, set into each rank's child_env (local: process
+    # env; remote: the stdin env payload — never argv); any
+    # launcher-side service a worker talks to authenticates with it
+    # (reference: secret.py in the reference launcher, used by its
+    # driver/task/rendezvous RPCs).
     job_secret = _secret.make_secret()
     try:
         for info in infos:

@@ -62,6 +62,24 @@ def main():
     out, recv = hvd.alltoall(x, splits=[2] * n, name="t5")
     assert out.shape[0] == 2 * n
 
+    # UNEVEN alltoall: rank r sends (d+1)*(r+1) rows to dest d — both
+    # the send and the receive split vectors differ per rank, all
+    # carried through the negotiation metadata (reference:
+    # MPI_Alltoallv semantics via HorovodAlltoallOp splits)
+    sends = [(d + 1) * (r + 1) for d in range(n)]
+    rows = sum(sends)
+    x = jnp.full((rows, 2), float(r))
+    out, recv = hvd.alltoall(x, splits=sends, name="t5u")
+    want_recv = [(r + 1) * (src + 1) for src in range(n)]
+    np.testing.assert_array_equal(np.asarray(recv), want_recv)
+    assert out.shape == (sum(want_recv), 2)
+    # block from src has value src
+    off = 0
+    for src in range(n):
+        np.testing.assert_allclose(
+            np.asarray(out[off:off + want_recv[src]]), float(src))
+        off += want_recv[src]
+
     # reducescatter
     x = jnp.ones((2 * n, 3)) * (r + 1)
     out = hvd.reducescatter(x, op=hvd.Sum, name="t6")

@@ -118,6 +118,8 @@ def test_capability_shims_match_reference_contract():
     assert hvd.gloo_built() is False
     assert hvd.cuda_built() is False
     assert hvd.rocm_built() is False
+    assert hvd.ddl_built() is False
+    assert hvd.ccl_built() is False
     assert hvd.nccl_enabled() is False
     assert hvd.mpi_enabled() is False
     assert hvd.gloo_enabled() is False

@@ -97,7 +97,17 @@ class TestElastic:
         env = make_env(tmp_path, steps=40, sleep=0.25)
         p = launch(script, env)
         try:
-            time.sleep(8)  # let the 2-proc world make progress
+            # Wait for OBSERVED 2-proc progress before growing the
+            # world (a fixed sleep races worker startup on a loaded
+            # machine: the resize then lands before step 1 and the
+            # world-2 assertions below have nothing to see).
+            deadline = time.time() + 240
+            while time.time() < deadline:
+                if any("world 2" in ln for ln in read_logs(tmp_path)):
+                    break
+                if p.poll() is not None:
+                    break
+                time.sleep(0.5)
             hosts_file.write_text("localhost:3\n")
             out, _ = p.communicate(timeout=420)
         finally:
@@ -199,3 +209,71 @@ def test_elastic_remote_spawn_via_ssh_shim(tmp_path):
     argv = log.read_text()
     assert "HOROVOD_SECRET=" not in argv
     assert "read -r __HVD_ENV" in argv
+
+
+class TestElasticSampler:
+    """Resharding-aware sampler (reference:
+    horovod/torch/elastic/sampler.py ElasticSampler) — pure-logic
+    tests with the world faked via attributes, the reference suite's
+    own technique for sampler coverage."""
+
+    def _mk(self, n=20, rank=0, world=2, shuffle=False):
+        # hvd is not initialized in these unit tests, so _reset keeps
+        # the injected rank/world (the reference suite fakes the world
+        # the same way for sampler coverage).
+        from horovod_tpu.elastic.sampler import ElasticSampler
+        s = ElasticSampler(n, shuffle=shuffle)
+        s.rank, s.world_size = rank, world
+        s._reset()
+        return s
+
+    def test_even_sharding_no_overlap(self):
+        a = self._mk(rank=0)
+        b = self._mk(rank=1)
+        ia, ib = list(a), list(b)
+        assert len(ia) == len(ib) == 10
+        assert not set(ia) & set(ib)
+        assert sorted(ia + ib) == list(range(20))
+
+    def test_resharding_preserves_unprocessed(self):
+        """After processing 2 batches and growing 2 -> 4 ranks, the
+        remaining pool is exactly the unprocessed indices, split with
+        no repeats across the new world."""
+        ranks = [self._mk(rank=r, world=2) for r in range(2)]
+        done = []
+        for s in ranks:
+            s.record_batch(0, 3)
+            s.record_batch(1, 3)
+            done += s.processed_indices
+        assert len(set(done)) == 12
+        new = []
+        for r in range(4):
+            s = ranks[r % 2]
+            import copy
+            s4 = copy.copy(s)
+            s4.processed_indices = list(done)
+            s4.rank, s4.world_size = r, 4
+            s4.reset_from_state()
+            new.append(list(s4))
+        flat = [i for idx in new for i in idx]
+        assert not set(flat) & set(done)      # nothing repeated
+        assert len(set(flat)) == len(flat)    # no cross-rank overlap
+        assert set(flat) == set(range(20)) - set(done)  # none dropped
+
+    def test_set_epoch_reshuffles_and_restores_full_pool(self):
+        s = self._mk(shuffle=True)
+        s.record_batch(0, 5)
+        assert len(s.processed_indices) == 5
+        order1 = list(s.remaining_indices)
+        s.set_epoch(1)
+        assert len(s.remaining_indices) == 20
+        s2 = self._mk(shuffle=True)
+        s2.set_epoch(1)
+        assert s.remaining_indices == s2.remaining_indices
+        assert s.remaining_indices != order1
+
+    def test_ragged_tail_dropped_evenly(self):
+        a = self._mk(n=21, rank=0, world=2)
+        b = self._mk(n=21, rank=1, world=2)
+        assert len(list(a)) == len(list(b)) == 10
+        assert len(a) == 10
