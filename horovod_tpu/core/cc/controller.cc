@@ -53,6 +53,7 @@ Controller::Controller(const ControllerOptions& opts) : opts_(opts) {
       }
       Buf hello;
       hello.PutU32(static_cast<uint32_t>(opts_.rank));
+      hello.PutStr(opts_.auth_token);
       SendMsg(coord_fd_, MsgType::kHello, hello.data());
       threads_.emplace_back(&Controller::WorkerReaderLoop, this);
     }
@@ -451,6 +452,20 @@ void Controller::DeliverEntries(const std::vector<Entry>& entries) {
 // socket threads
 // --------------------------------------------------------------------------
 
+namespace {
+// Constant-time string equality for the auth token (early-exit
+// comparison would leak matching-prefix length via response timing —
+// the same reason runner/secret.py uses hmac.compare_digest).
+bool ConstTimeEq(const std::string& a, const std::string& b) {
+  if (a.size() != b.size()) return false;
+  volatile unsigned char acc = 0;
+  for (size_t i = 0; i < a.size(); ++i)
+    acc |= static_cast<unsigned char>(a[i]) ^
+           static_cast<unsigned char>(b[i]);
+  return acc == 0;
+}
+}  // namespace
+
 void Controller::ServerAcceptLoop() {
   int connected = 0;
   while (!shutdown_.load() && connected < opts_.size - 1) {
@@ -458,21 +473,56 @@ void Controller::ServerAcceptLoop() {
     if (fd < 0) break;
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    // Bound the hello read: the accept loop is serial, so a peer that
+    // connects and withholds its hello would otherwise stall every
+    // legitimate rank behind it (slow-loris on the rank rendezvous).
+    struct timeval hello_to;
+    hello_to.tv_sec = 10;
+    hello_to.tv_usec = 0;
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &hello_to,
+               sizeof(hello_to));
     MsgType t;
     std::string payload;
     if (!RecvMsg(fd, &t, &payload) || t != MsgType::kHello) {
       ::close(fd);
       continue;
     }
+    // Back to blocking reads for the steady-state reader loop.
+    hello_to.tv_sec = 0;
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &hello_to,
+               sizeof(hello_to));
     Reader rd(payload);
     uint32_t rank = 0;
+    std::string token;
     rd.GetU32(&rank);
+    rd.GetStr(&token);
     if (rank == 0 || rank >= static_cast<uint32_t>(opts_.size)) {
       ::close(fd);
       continue;
     }
+    // Auth: the token is derived from the per-job HMAC secret on the
+    // Python side (identical on every legitimate rank); an arbitrary
+    // network peer cannot claim a rank slot without it. Empty
+    // configured token = open (single-user tests, no secret set) —
+    // matching secret.py's verify() semantics.
+    if (!opts_.auth_token.empty() &&
+        !ConstTimeEq(token, opts_.auth_token)) {
+      HVD_LOG(kWarning,
+              "rejected control-plane hello for rank %u: bad auth "
+              "token", rank);
+      ::close(fd);
+      continue;
+    }
     {
+      // Claim-once check and assignment under ONE lock: a second
+      // accept path (e.g. future elastic re-accept) must not be able
+      // to interleave between check and store.
       std::lock_guard<std::mutex> lk(coord_mu_);
+      if (worker_fds_[rank] != -1) {
+        HVD_LOG(kWarning, "duplicate hello for rank %u rejected", rank);
+        ::close(fd);
+        continue;
+      }
       worker_fds_[rank] = fd;
     }
     {

@@ -60,6 +60,11 @@ struct ControllerOptions {
   // Response cache capacity (reference: HOROVOD_CACHE_CAPACITY,
   // response_cache.cc). 0 disables caching entirely.
   int cache_capacity = 1024;
+  // Control-plane auth token, derived from the per-job HMAC secret on
+  // the Python side (ops/controller.py); empty = unauthenticated
+  // (single-user tests). Workers present it in the hello; the
+  // coordinator rejects rank claims without it.
+  std::string auth_token;
 };
 
 // Sentinel entry name broadcast when every rank has joined

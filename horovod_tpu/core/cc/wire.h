@@ -28,7 +28,7 @@ namespace hvdtpu {
 
 // Message types.
 enum class MsgType : uint8_t {
-  kHello = 1,      // worker -> coord: {rank}
+  kHello = 1,      // worker -> coord: {rank, auth_token}
   kReady = 2,      // worker -> coord: RequestList (ready tensors)
   kResponses = 3,  // coord -> worker: ResponseList (agreed batches)
   kShutdown = 4,   // either direction

@@ -35,6 +35,21 @@ from . import dispatch
 from .dispatch import ADASUM, AVERAGE, SUM
 
 
+def control_plane_token() -> str:
+    """Auth token for the native control plane's TCP hello, derived
+    from the per-job HMAC secret (reference threat model:
+    secret.py-authenticated launcher RPCs): every legitimate rank
+    holds HOROVOD_SECRET and derives the same token; an arbitrary
+    network peer cannot claim a rank slot on the coordinator. Empty
+    (= unauthenticated) when no secret is configured, e.g. direct
+    single-user runs without the launcher."""
+    from ..runner import secret as _secret
+    key = _secret.from_env()
+    if not key:
+        return ""
+    return _secret.sign(key, b"hvd-control-plane")
+
+
 class JoinError(RuntimeError):
     pass
 
@@ -217,7 +232,8 @@ class NegotiatedController:
                               else cfg.stall_check_time),
                 stall_kill_s=cfg.stall_shutdown_time,
                 connect_timeout_s=cfg.start_timeout,
-                cache_capacity=cfg.cache_capacity)
+                cache_capacity=cfg.cache_capacity,
+                auth_token=control_plane_token())
         elif topology.size == 1:
             self.core = PythonCore(cfg.fusion_threshold)
         else:

@@ -1,0 +1,120 @@
+"""Native control-plane authentication: the coordinator's TCP
+listener only hands rank slots to peers presenting the job-derived
+auth token (reference threat model: secret.py-authenticated launcher
+RPCs, extended to the C++ negotiation plane — the reference's gloo
+control plane is unauthenticated; this build closes that)."""
+
+import socket
+import struct
+
+import pytest
+
+from horovod_tpu.core import native
+from horovod_tpu.ops.controller import control_plane_token
+from horovod_tpu.runner.launch import free_port
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native core not built")
+
+
+def _hello_frame(rank: int, token: str) -> bytes:
+    payload = struct.pack(">I", rank) + \
+        struct.pack(">I", len(token)) + token.encode()
+    return bytes([1]) + struct.pack(">I", len(payload)) + payload
+
+
+def _mk_core(rank, size, port, token):
+    return native.NativeCore(
+        rank=rank, size=size, coord_host="127.0.0.1", coord_port=port,
+        fusion_threshold=1024, cycle_time_ms=0.5, stall_warn_s=60.0,
+        stall_kill_s=0.0, connect_timeout_s=10.0, cache_capacity=16,
+        auth_token=token)
+
+
+def test_forged_hello_rejected_and_slot_stays_free():
+    port = free_port()
+    c0 = _mk_core(0, 2, port, "sekrit-token")
+    try:
+        # Impostor: claims rank 1 with the wrong token. The
+        # coordinator must close the connection AND leave the rank-1
+        # slot unclaimed.
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=5) as s:
+            s.sendall(_hello_frame(1, "wrong-token"))
+            s.settimeout(5)
+            assert s.recv(1) == b""  # peer closed = rejected
+        # The real rank 1 still gets the slot and negotiation works.
+        c1 = _mk_core(1, 2, port, "sekrit-token")
+        try:
+            c0.submit("t", "f32|0|0|1.0|1.0#4", 16)
+            c1.submit("t", "f32|0|0|1.0|1.0#4", 16)
+            got0 = _drain(c0)
+            got1 = _drain(c1)
+            assert [e.name for e in got0] == ["t"]
+            assert [e.name for e in got1] == ["t"]
+        finally:
+            c1.shutdown()
+    finally:
+        c0.shutdown()
+
+
+def test_unauthenticated_mode_still_open():
+    """No token configured (no job secret): hellos are accepted —
+    single-user compatibility, matching secret.verify()'s semantics."""
+    port = free_port()
+    c0 = _mk_core(0, 2, port, "")
+    try:
+        c1 = _mk_core(1, 2, port, "anything")
+        try:
+            c0.submit("x", "f32|0|0|1.0|1.0#2", 8)
+            c1.submit("x", "f32|0|0|1.0|1.0#2", 8)
+            assert [e.name for e in _drain(c0)] == ["x"]
+            assert [e.name for e in _drain(c1)] == ["x"]
+        finally:
+            c1.shutdown()
+    finally:
+        c0.shutdown()
+
+
+def test_duplicate_rank_claim_cannot_disrupt():
+    """A late hello for an already-claimed rank (full world: it stays
+    unaccepted in the backlog; partial world: the claim-once check
+    drops it) must not disturb negotiation between the real ranks."""
+    port = free_port()
+    c0 = _mk_core(0, 2, port, "tok")
+    try:
+        c1 = _mk_core(1, 2, port, "tok")
+        try:
+            with socket.create_connection(("127.0.0.1", port),
+                                          timeout=5) as s:
+                s.sendall(_hello_frame(1, "tok"))
+                c0.submit("y", "f32|0|0|1.0|1.0#2", 8)
+                c1.submit("y", "f32|0|0|1.0|1.0#2", 8)
+                assert [e.name for e in _drain(c0)] == ["y"]
+                assert [e.name for e in _drain(c1)] == ["y"]
+        finally:
+            c1.shutdown()
+    finally:
+        c0.shutdown()
+
+
+def test_token_derivation(monkeypatch):
+    from horovod_tpu.runner import secret as S
+    monkeypatch.delenv(S.ENV_VAR, raising=False)
+    assert control_plane_token() == ""
+    monkeypatch.setenv(S.ENV_VAR, "k1")
+    t1 = control_plane_token()
+    monkeypatch.setenv(S.ENV_VAR, "k2")
+    t2 = control_plane_token()
+    assert t1 and t2 and t1 != t2 and len(t1) == 64
+
+
+def _drain(core, max_wait=10.0):
+    import time
+    entries = []
+    deadline = time.monotonic() + max_wait
+    while not entries and time.monotonic() < deadline:
+        batch = core.next_batch(0.5)
+        if batch:
+            entries.extend(batch)
+    return entries
