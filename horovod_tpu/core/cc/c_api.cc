@@ -39,7 +39,7 @@ void* hvd_core_create(int rank, int size, const char* coord_host,
                       int coord_port, long long fusion_threshold,
                       double cycle_time_ms, double stall_warn_s,
                       double stall_kill_s, double connect_timeout_s,
-                      int cache_capacity, const char* auth_token) {
+                      int cache_capacity, const char* auth_secret) {
   ControllerOptions o;
   o.rank = rank;
   o.size = size;
@@ -51,7 +51,7 @@ void* hvd_core_create(int rank, int size, const char* coord_host,
   o.stall_kill_s = stall_kill_s;
   o.connect_timeout_s = connect_timeout_s;
   o.cache_capacity = cache_capacity;
-  o.auth_token = auth_token ? auth_token : "";
+  o.auth_secret = auth_secret ? auth_secret : "";
   return new CoreHandle(o);
 }
 

@@ -1,7 +1,10 @@
 #include "controller.h"
 
 #include <algorithm>
+#include <random>
 #include <sstream>
+
+#include "sha256.h"
 
 namespace hvdtpu {
 
@@ -14,6 +17,46 @@ namespace {
 std::string FuseKey(const std::string& sig) {
   auto pos = sig.find('#');
   return pos == std::string::npos ? sig : sig.substr(0, pos);
+}
+
+// Constant-time equality for handshake MACs (early-exit comparison
+// would leak matching-prefix length via response timing — the same
+// reason runner/secret.py uses hmac.compare_digest).
+bool ConstTimeEq(const std::string& a, const std::string& b) {
+  if (a.size() != b.size()) return false;
+  volatile unsigned char acc = 0;
+  for (size_t i = 0; i < a.size(); ++i)
+    acc |= static_cast<unsigned char>(a[i]) ^
+           static_cast<unsigned char>(b[i]);
+  return acc == 0;
+}
+
+// 32-byte per-connection nonce: random_device entropy mixed with a
+// counter and the clock, whitened through SHA-256.
+std::string MakeNonce() {
+  static std::atomic<uint64_t> ctr{0};
+  std::random_device rd;
+  uint64_t parts[4];
+  parts[0] = (static_cast<uint64_t>(rd()) << 32) ^ rd();
+  parts[1] = (static_cast<uint64_t>(rd()) << 32) ^ rd();
+  parts[2] = static_cast<uint64_t>(
+      std::chrono::steady_clock::now().time_since_epoch().count());
+  parts[3] = ctr.fetch_add(1);
+  return Sha256Bin(std::string(reinterpret_cast<char*>(parts),
+                               sizeof(parts)));
+}
+
+std::string WorkerMac(const std::string& secret,
+                      const std::string& coord_nonce, uint32_t rank) {
+  // The claimed rank is bound into the MAC so a MITM cannot splice a
+  // valid handshake onto a different rank claim.
+  return HmacSha256(secret,
+                    coord_nonce + "|worker|" + std::to_string(rank));
+}
+
+std::string CoordMac(const std::string& secret,
+                     const std::string& worker_nonce) {
+  return HmacSha256(secret, worker_nonce + "|coord");
 }
 }  // namespace
 
@@ -51,10 +94,48 @@ Controller::Controller(const ControllerOptions& opts) : opts_(opts) {
                  std::to_string(opts_.coord_port));
         return;
       }
+      // Mutual challenge-response (see ControllerOptions.auth_secret):
+      // challenge -> hello{rank, worker_nonce, mac} -> welcome{mac}.
+      double hs_deadline = NowSeconds() + opts_.connect_timeout_s;
+      MsgType t;
+      std::string payload;
+      if (!RecvMsgDeadline(coord_fd_, &t, &payload, hs_deadline,
+                           4096) ||
+          t != MsgType::kChallenge) {
+        SetError("control-plane handshake failed: no challenge from "
+                 "coordinator");
+        return;
+      }
+      Reader crd(payload);
+      std::string coord_nonce;
+      crd.GetStr(&coord_nonce);
+      std::string worker_nonce = MakeNonce();
       Buf hello;
       hello.PutU32(static_cast<uint32_t>(opts_.rank));
-      hello.PutStr(opts_.auth_token);
+      hello.PutStr(worker_nonce);
+      hello.PutStr(opts_.auth_secret.empty()
+                       ? std::string()
+                       : WorkerMac(opts_.auth_secret, coord_nonce,
+                                   static_cast<uint32_t>(opts_.rank)));
       SendMsg(coord_fd_, MsgType::kHello, hello.data());
+      if (!RecvMsgDeadline(coord_fd_, &t, &payload, hs_deadline,
+                           4096) ||
+          t != MsgType::kWelcome) {
+        SetError("control-plane handshake failed: no welcome "
+                 "(auth rejected, or not a horovod_tpu coordinator)");
+        return;
+      }
+      if (!opts_.auth_secret.empty()) {
+        Reader wrd(payload);
+        std::string mac;
+        wrd.GetStr(&mac);
+        if (!ConstTimeEq(mac,
+                         CoordMac(opts_.auth_secret, worker_nonce))) {
+          SetError("coordinator failed authentication (wrong or "
+                   "missing job secret)");
+          return;
+        }
+      }
       threads_.emplace_back(&Controller::WorkerReaderLoop, this);
     }
   }
@@ -452,20 +533,6 @@ void Controller::DeliverEntries(const std::vector<Entry>& entries) {
 // socket threads
 // --------------------------------------------------------------------------
 
-namespace {
-// Constant-time string equality for the auth token (early-exit
-// comparison would leak matching-prefix length via response timing —
-// the same reason runner/secret.py uses hmac.compare_digest).
-bool ConstTimeEq(const std::string& a, const std::string& b) {
-  if (a.size() != b.size()) return false;
-  volatile unsigned char acc = 0;
-  for (size_t i = 0; i < a.size(); ++i)
-    acc |= static_cast<unsigned char>(a[i]) ^
-           static_cast<unsigned char>(b[i]);
-  return acc == 0;
-}
-}  // namespace
-
 void Controller::ServerAcceptLoop() {
   int connected = 0;
   while (!shutdown_.load() && connected < opts_.size - 1) {
@@ -473,43 +540,40 @@ void Controller::ServerAcceptLoop() {
     if (fd < 0) break;
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    // Bound the hello read: the accept loop is serial, so a peer that
-    // connects and withholds its hello would otherwise stall every
-    // legitimate rank behind it (slow-loris on the rank rendezvous).
-    struct timeval hello_to;
-    hello_to.tv_sec = 10;
-    hello_to.tv_usec = 0;
-    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &hello_to,
-               sizeof(hello_to));
+    // Mutual challenge-response rank rendezvous (see
+    // ControllerOptions.auth_secret). The whole handshake runs
+    // against an ABSOLUTE deadline (per-read timeouts would reset on
+    // every dripped byte) with a tight pre-auth frame cap, so a
+    // hostile peer can hold the serial accept loop for at most 10s
+    // and cannot force large allocations.
+    double deadline = NowSeconds() + 10.0;
+    std::string coord_nonce = MakeNonce();
+    Buf ch;
+    ch.PutStr(coord_nonce);
+    SendMsg(fd, MsgType::kChallenge, ch.data());
     MsgType t;
     std::string payload;
-    if (!RecvMsg(fd, &t, &payload) || t != MsgType::kHello) {
+    if (!RecvMsgDeadline(fd, &t, &payload, deadline, 4096) ||
+        t != MsgType::kHello) {
       ::close(fd);
       continue;
     }
-    // Back to blocking reads for the steady-state reader loop.
-    hello_to.tv_sec = 0;
-    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &hello_to,
-               sizeof(hello_to));
     Reader rd(payload);
     uint32_t rank = 0;
-    std::string token;
+    std::string worker_nonce, mac;
     rd.GetU32(&rank);
-    rd.GetStr(&token);
+    rd.GetStr(&worker_nonce);
+    rd.GetStr(&mac);
     if (rank == 0 || rank >= static_cast<uint32_t>(opts_.size)) {
       ::close(fd);
       continue;
     }
-    // Auth: the token is derived from the per-job HMAC secret on the
-    // Python side (identical on every legitimate rank); an arbitrary
-    // network peer cannot claim a rank slot without it. Empty
-    // configured token = open (single-user tests, no secret set) —
-    // matching secret.py's verify() semantics.
-    if (!opts_.auth_token.empty() &&
-        !ConstTimeEq(token, opts_.auth_token)) {
+    if (!opts_.auth_secret.empty() &&
+        !ConstTimeEq(mac, WorkerMac(opts_.auth_secret, coord_nonce,
+                                    rank))) {
       HVD_LOG(kWarning,
               "rejected control-plane hello for rank %u: bad auth "
-              "token", rank);
+              "MAC", rank);
       ::close(fd);
       continue;
     }
@@ -525,6 +589,13 @@ void Controller::ServerAcceptLoop() {
       }
       worker_fds_[rank] = fd;
     }
+    // Prove we hold the secret too (the worker will not trust agreed
+    // batches from an unauthenticated coordinator).
+    Buf wl;
+    wl.PutStr(opts_.auth_secret.empty()
+                  ? std::string()
+                  : CoordMac(opts_.auth_secret, worker_nonce));
+    SendMsg(fd, MsgType::kWelcome, wl.data());
     {
       std::lock_guard<std::mutex> lk(reader_threads_mu_);
       reader_threads_.emplace_back(&Controller::ReaderLoop, this,

@@ -60,11 +60,15 @@ struct ControllerOptions {
   // Response cache capacity (reference: HOROVOD_CACHE_CAPACITY,
   // response_cache.cc). 0 disables caching entirely.
   int cache_capacity = 1024;
-  // Control-plane auth token, derived from the per-job HMAC secret on
-  // the Python side (ops/controller.py); empty = unauthenticated
-  // (single-user tests). Workers present it in the hello; the
-  // coordinator rejects rank claims without it.
-  std::string auth_token;
+  // Per-job secret (HOROVOD_SECRET) for the rank-rendezvous mutual
+  // challenge-response (HMAC-SHA256, sha256.h): the coordinator
+  // challenges each connection with a fresh nonce and only hands out
+  // a rank slot for a valid MAC (replay of a captured handshake is
+  // useless — the nonce differs); the worker likewise verifies the
+  // coordinator's MAC over its own nonce before trusting agreed
+  // batches. Empty = unauthenticated (single-user runs without a
+  // launcher secret), matching runner/secret.py verify() semantics.
+  std::string auth_secret;
 };
 
 // Sentinel entry name broadcast when every rank has joined

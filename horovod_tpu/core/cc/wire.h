@@ -14,6 +14,7 @@
 #include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <poll.h>
 #include <sys/socket.h>
 #include <unistd.h>
 
@@ -28,10 +29,12 @@ namespace hvdtpu {
 
 // Message types.
 enum class MsgType : uint8_t {
-  kHello = 1,      // worker -> coord: {rank, auth_token}
+  kHello = 1,      // worker -> coord: {rank, worker_nonce, mac_w}
   kReady = 2,      // worker -> coord: RequestList (ready tensors)
   kResponses = 3,  // coord -> worker: ResponseList (agreed batches)
   kShutdown = 4,   // either direction
+  kChallenge = 5,  // coord -> new connection: {nonce}
+  kWelcome = 6,    // coord -> worker: {mac over the worker's nonce}
 };
 
 // One pending-tensor announcement (reference: Request).
@@ -173,6 +176,44 @@ inline bool RecvMsg(int fd, MsgType* t, std::string* payload) {
   if (len > (1u << 30)) return false;  // sanity cap
   payload->resize(len);
   return len == 0 || ReadAll(fd, payload->data(), len);
+}
+
+// Deadline-bounded read for PRE-AUTH frames (the rank-rendezvous
+// handshake): an absolute wall-clock deadline defeats byte-dripping
+// (a per-read timeout would reset on every byte), and the tight
+// payload cap stops an unauthenticated peer from forcing a large
+// allocation (RecvMsg's 1 GiB sanity cap is for trusted peers).
+inline bool ReadAllDeadline(int fd, char* p, size_t n,
+                            double deadline_s) {
+  while (n > 0) {
+    double remain = deadline_s - NowSeconds();
+    if (remain <= 0) return false;
+    struct pollfd pf;
+    pf.fd = fd;
+    pf.events = POLLIN;
+    pf.revents = 0;
+    int pr = ::poll(&pf, 1, static_cast<int>(remain * 1000) + 1);
+    if (pr <= 0) return false;
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+inline bool RecvMsgDeadline(int fd, MsgType* t, std::string* payload,
+                            double deadline_s, uint32_t max_len) {
+  char hdr[5];
+  if (!ReadAllDeadline(fd, hdr, 5, deadline_s)) return false;
+  *t = static_cast<MsgType>(hdr[0]);
+  uint32_t len;
+  memcpy(&len, hdr + 1, 4);
+  len = ntohl(len);
+  if (len > max_len) return false;
+  payload->resize(len);
+  return len == 0 ||
+         ReadAllDeadline(fd, payload->data(), len, deadline_s);
 }
 
 // --- serialization ------------------------------------------------------

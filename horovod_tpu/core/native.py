@@ -193,7 +193,7 @@ class NativeCore:
                  coord_port: int, fusion_threshold: int,
                  cycle_time_ms: float, stall_warn_s: float,
                  stall_kill_s: float, connect_timeout_s: float = 30.0,
-                 cache_capacity: int = 1024, auth_token: str = ""):
+                 cache_capacity: int = 1024, auth_secret: str = ""):
         lib = load()
         if lib is None:
             raise RuntimeError("native core not built")
@@ -202,7 +202,7 @@ class NativeCore:
             rank, size, coord_host.encode(), coord_port,
             fusion_threshold, cycle_time_ms, stall_warn_s,
             stall_kill_s, connect_timeout_s, cache_capacity,
-            auth_token.encode())
+            auth_secret.encode())
         self._buf = ctypes.create_string_buffer(self.BUF_SIZE)
         if not lib.hvd_core_ok(self._h):
             err = self.last_error()

@@ -35,19 +35,17 @@ from . import dispatch
 from .dispatch import ADASUM, AVERAGE, SUM
 
 
-def control_plane_token() -> str:
-    """Auth token for the native control plane's TCP hello, derived
-    from the per-job HMAC secret (reference threat model:
-    secret.py-authenticated launcher RPCs): every legitimate rank
-    holds HOROVOD_SECRET and derives the same token; an arbitrary
-    network peer cannot claim a rank slot on the coordinator. Empty
-    (= unauthenticated) when no secret is configured, e.g. direct
-    single-user runs without the launcher."""
+def control_plane_secret() -> str:
+    """Per-job secret for the native control plane's mutual
+    challenge-response rank rendezvous (reference threat model:
+    secret.py-authenticated launcher RPCs, extended to the C++
+    negotiation plane): the coordinator challenges each connection
+    with a fresh nonce and both sides prove possession via
+    HMAC-SHA256 (core/cc/sha256.h), so captured handshakes cannot be
+    replayed. Empty (= unauthenticated) when no secret is configured,
+    e.g. direct single-user runs without the launcher."""
     from ..runner import secret as _secret
-    key = _secret.from_env()
-    if not key:
-        return ""
-    return _secret.sign(key, b"hvd-control-plane")
+    return _secret.from_env()
 
 
 class JoinError(RuntimeError):
@@ -233,7 +231,7 @@ class NegotiatedController:
                 stall_kill_s=cfg.stall_shutdown_time,
                 connect_timeout_s=cfg.start_timeout,
                 cache_capacity=cfg.cache_capacity,
-                auth_token=control_plane_token())
+                auth_secret=control_plane_secret())
         elif topology.size == 1:
             self.core = PythonCore(cfg.fusion_threshold)
         else:

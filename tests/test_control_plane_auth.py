@@ -1,112 +1,86 @@
-"""Native control-plane authentication: the coordinator's TCP
-listener only hands rank slots to peers presenting the job-derived
-auth token (reference threat model: secret.py-authenticated launcher
-RPCs, extended to the C++ negotiation plane — the reference's gloo
-control plane is unauthenticated; this build closes that)."""
+"""Native control-plane authentication: mutual challenge-response
+rank rendezvous. The coordinator challenges every connection with a
+fresh nonce and hands out a rank slot only for a valid
+HMAC-SHA256(secret, nonce|worker|rank); it then proves its own
+possession of the secret over the worker's nonce. Replaying a
+captured handshake is useless (fresh nonce per connection).
+Reference contrast: the gloo control plane is unauthenticated — this
+build extends the secret.py threat model down into the C++ core
+(core/cc/sha256.h)."""
 
+import hashlib
+import hmac as hmac_mod
 import socket
 import struct
 
 import pytest
 
 from horovod_tpu.core import native
-from horovod_tpu.ops.controller import control_plane_token
+from horovod_tpu.ops.controller import control_plane_secret
 from horovod_tpu.runner.launch import free_port
 
 pytestmark = pytest.mark.skipif(not native.available(),
                                 reason="native core not built")
 
 
-def _hello_frame(rank: int, token: str) -> bytes:
-    payload = struct.pack(">I", rank) + \
-        struct.pack(">I", len(token)) + token.encode()
-    return bytes([1]) + struct.pack(">I", len(payload)) + payload
+def _recv_frame(s):
+    hdr = b""
+    while len(hdr) < 5:
+        b = s.recv(5 - len(hdr))
+        assert b, "peer closed mid-frame"
+        hdr += b
+    t = hdr[0]
+    (n,) = struct.unpack(">I", hdr[1:5])
+    payload = b""
+    while len(payload) < n:
+        b = s.recv(n - len(payload))
+        assert b, "peer closed mid-frame"
+        payload += b
+    return t, payload
 
 
-def _mk_core(rank, size, port, token):
+def _send_frame(s, t, payload):
+    s.sendall(bytes([t]) + struct.pack(">I", len(payload)) + payload)
+
+
+def _get_str(buf, off):
+    (n,) = struct.unpack(">I", buf[off:off + 4])
+    return buf[off + 4:off + 4 + n], off + 4 + n
+
+
+def _put_str(b: bytes) -> bytes:
+    return struct.pack(">I", len(b)) + b
+
+
+def _worker_mac(secret: str, coord_nonce: bytes, rank: int) -> bytes:
+    msg = coord_nonce + b"|worker|" + str(rank).encode()
+    return hmac_mod.new(secret.encode(), msg, hashlib.sha256).digest()
+
+
+def _handshake(s, secret: str, rank: int, mac_override: bytes = None):
+    """Drive the worker side of the handshake by hand; returns the
+    coordinator's welcome MAC payload (or None if it closed on us)."""
+    t, payload = _recv_frame(s)
+    assert t == 5, t  # kChallenge
+    coord_nonce, _ = _get_str(payload, 0)
+    mac = mac_override if mac_override is not None else \
+        _worker_mac(secret, coord_nonce, rank)
+    hello = struct.pack(">I", rank) + _put_str(b"wnonce-fixed") + \
+        _put_str(mac)
+    _send_frame(s, 1, hello)  # kHello
+    try:
+        s.settimeout(5)
+        return _recv_frame(s)
+    except AssertionError:
+        return None
+
+
+def _mk_core(rank, size, port, secret, connect_timeout=10.0):
     return native.NativeCore(
         rank=rank, size=size, coord_host="127.0.0.1", coord_port=port,
         fusion_threshold=1024, cycle_time_ms=0.5, stall_warn_s=60.0,
-        stall_kill_s=0.0, connect_timeout_s=10.0, cache_capacity=16,
-        auth_token=token)
-
-
-def test_forged_hello_rejected_and_slot_stays_free():
-    port = free_port()
-    c0 = _mk_core(0, 2, port, "sekrit-token")
-    try:
-        # Impostor: claims rank 1 with the wrong token. The
-        # coordinator must close the connection AND leave the rank-1
-        # slot unclaimed.
-        with socket.create_connection(("127.0.0.1", port),
-                                      timeout=5) as s:
-            s.sendall(_hello_frame(1, "wrong-token"))
-            s.settimeout(5)
-            assert s.recv(1) == b""  # peer closed = rejected
-        # The real rank 1 still gets the slot and negotiation works.
-        c1 = _mk_core(1, 2, port, "sekrit-token")
-        try:
-            c0.submit("t", "f32|0|0|1.0|1.0#4", 16)
-            c1.submit("t", "f32|0|0|1.0|1.0#4", 16)
-            got0 = _drain(c0)
-            got1 = _drain(c1)
-            assert [e.name for e in got0] == ["t"]
-            assert [e.name for e in got1] == ["t"]
-        finally:
-            c1.shutdown()
-    finally:
-        c0.shutdown()
-
-
-def test_unauthenticated_mode_still_open():
-    """No token configured (no job secret): hellos are accepted —
-    single-user compatibility, matching secret.verify()'s semantics."""
-    port = free_port()
-    c0 = _mk_core(0, 2, port, "")
-    try:
-        c1 = _mk_core(1, 2, port, "anything")
-        try:
-            c0.submit("x", "f32|0|0|1.0|1.0#2", 8)
-            c1.submit("x", "f32|0|0|1.0|1.0#2", 8)
-            assert [e.name for e in _drain(c0)] == ["x"]
-            assert [e.name for e in _drain(c1)] == ["x"]
-        finally:
-            c1.shutdown()
-    finally:
-        c0.shutdown()
-
-
-def test_duplicate_rank_claim_cannot_disrupt():
-    """A late hello for an already-claimed rank (full world: it stays
-    unaccepted in the backlog; partial world: the claim-once check
-    drops it) must not disturb negotiation between the real ranks."""
-    port = free_port()
-    c0 = _mk_core(0, 2, port, "tok")
-    try:
-        c1 = _mk_core(1, 2, port, "tok")
-        try:
-            with socket.create_connection(("127.0.0.1", port),
-                                          timeout=5) as s:
-                s.sendall(_hello_frame(1, "tok"))
-                c0.submit("y", "f32|0|0|1.0|1.0#2", 8)
-                c1.submit("y", "f32|0|0|1.0|1.0#2", 8)
-                assert [e.name for e in _drain(c0)] == ["y"]
-                assert [e.name for e in _drain(c1)] == ["y"]
-        finally:
-            c1.shutdown()
-    finally:
-        c0.shutdown()
-
-
-def test_token_derivation(monkeypatch):
-    from horovod_tpu.runner import secret as S
-    monkeypatch.delenv(S.ENV_VAR, raising=False)
-    assert control_plane_token() == ""
-    monkeypatch.setenv(S.ENV_VAR, "k1")
-    t1 = control_plane_token()
-    monkeypatch.setenv(S.ENV_VAR, "k2")
-    t2 = control_plane_token()
-    assert t1 and t2 and t1 != t2 and len(t1) == 64
+        stall_kill_s=0.0, connect_timeout_s=connect_timeout,
+        cache_capacity=16, auth_secret=secret)
 
 
 def _drain(core, max_wait=10.0):
@@ -118,3 +92,137 @@ def _drain(core, max_wait=10.0):
         if batch:
             entries.extend(batch)
     return entries
+
+
+def test_wrong_mac_rejected_and_slot_stays_free():
+    port = free_port()
+    c0 = _mk_core(0, 2, port, "sekrit")
+    try:
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=5) as s:
+            got = _handshake(s, "WRONG-secret", rank=1)
+        assert got is None, "impostor with wrong secret got a welcome"
+        # The real rank 1 still gets the slot and negotiation works.
+        c1 = _mk_core(1, 2, port, "sekrit")
+        try:
+            c0.submit("t", "f32|0|0|1.0|1.0#4", 16)
+            c1.submit("t", "f32|0|0|1.0|1.0#4", 16)
+            assert [e.name for e in _drain(c0)] == ["t"]
+            assert [e.name for e in _drain(c1)] == ["t"]
+        finally:
+            c1.shutdown()
+    finally:
+        c0.shutdown()
+
+
+def test_replayed_mac_rejected():
+    """A MAC captured from one handshake is useless on the next
+    connection: the coordinator's nonce is fresh each time."""
+    port = free_port()
+    c0 = _mk_core(0, 3, port, "sekrit")
+    try:
+        # First connection: capture a VALID mac for rank 1 (we know
+        # the secret here; a real attacker would have sniffed it).
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=5) as s:
+            t, payload = _recv_frame(s)
+            nonce1, _ = _get_str(payload, 0)
+            captured_mac = _worker_mac("sekrit", nonce1, 1)
+            # abandon this handshake without completing it
+        # Replay the captured mac on a NEW connection.
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=5) as s:
+            got = _handshake(s, "ignored", rank=1,
+                             mac_override=captured_mac)
+        assert got is None, "replayed MAC was accepted"
+    finally:
+        c0.shutdown()
+
+
+def test_worker_rejects_unauthenticated_coordinator():
+    """Mutual auth: a worker configured with a secret refuses a
+    coordinator that cannot prove possession (here: a coordinator
+    configured with NO secret sends an empty welcome MAC)."""
+    port = free_port()
+    c0 = _mk_core(0, 2, port, "")          # rogue/secretless coord
+    try:
+        with pytest.raises(RuntimeError,
+                           match="coordinator failed authentication"):
+            _mk_core(1, 2, port, "sekrit")
+    finally:
+        c0.shutdown()
+
+
+def test_unauthenticated_mode_still_open():
+    """No secret configured anywhere: handshake flows with empty MACs
+    — single-user compatibility (secret.verify() semantics)."""
+    port = free_port()
+    c0 = _mk_core(0, 2, port, "")
+    try:
+        c1 = _mk_core(1, 2, port, "")
+        try:
+            c0.submit("x", "f32|0|0|1.0|1.0#2", 8)
+            c1.submit("x", "f32|0|0|1.0|1.0#2", 8)
+            assert [e.name for e in _drain(c0)] == ["x"]
+            assert [e.name for e in _drain(c1)] == ["x"]
+        finally:
+            c1.shutdown()
+    finally:
+        c0.shutdown()
+
+
+def test_secret_comes_from_env(monkeypatch):
+    from horovod_tpu.runner import secret as S
+    monkeypatch.delenv(S.ENV_VAR, raising=False)
+    assert control_plane_secret() == ""
+    monkeypatch.setenv(S.ENV_VAR, "k1")
+    assert control_plane_secret() == "k1"
+
+
+def test_silent_peer_cannot_block_rendezvous():
+    """Slow-loris guard: a peer that connects and withholds its hello
+    holds the serial accept loop only until the 10s ABSOLUTE handshake
+    deadline (byte-dripping cannot reset it) — the real rank behind it
+    still gets its slot and negotiation completes."""
+    import threading
+    port = free_port()
+    c0 = _mk_core(0, 2, port, "tok")
+    silent = socket.create_connection(("127.0.0.1", port), timeout=5)
+    try:
+        results = {}
+
+        def join_late():
+            # generous handshake deadline: the silent peer legally
+            # holds the serial accept loop for up to its full 10s
+            c1 = _mk_core(1, 2, port, "tok", connect_timeout=30.0)
+            try:
+                c0.submit("z", "f32|0|0|1.0|1.0#2", 8)
+                c1.submit("z", "f32|0|0|1.0|1.0#2", 8)
+                results["names"] = [e.name for e in _drain(c1, 30.0)]
+            finally:
+                c1.shutdown()
+
+        t = threading.Thread(target=join_late, daemon=True)
+        t.start()
+        t.join(timeout=40.0)
+        assert not t.is_alive(), "rendezvous blocked behind silent peer"
+        assert results.get("names") == ["z"]
+    finally:
+        silent.close()
+        c0.shutdown()
+
+
+def test_oversized_preauth_frame_rejected():
+    """An unauthenticated peer declaring a huge hello payload is cut
+    off by the 4 KiB pre-auth cap — no large allocation, no slot."""
+    port = free_port()
+    c0 = _mk_core(0, 2, port, "tok")
+    try:
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=5) as s:
+            _recv_frame(s)  # challenge
+            s.sendall(bytes([1]) + struct.pack(">I", 1 << 30))
+            s.settimeout(10)
+            assert s.recv(1) == b""  # coordinator dropped us
+    finally:
+        c0.shutdown()
