@@ -31,31 +31,47 @@ from .service import BasicClient, BasicService
 
 class TaskRecord:
     def __init__(self, host_id: str, peer_addr: str, port: int,
-                 addrs: Dict[str, List[str]]):
+                 addrs: Dict[str, List[str]],
+                 ifaces: Optional[List[str]] = None):
         self.host_id = host_id
         self.peer_addr = peer_addr      # source addr of the register call
         self.port = port                # task service port
         self.addrs = addrs              # iface -> [ip, ...]
+        self.ifaces = ifaces            # user-restricted NIC names
         self.routable: List[str] = []   # driver-reachable ips
 
     def candidates(self) -> List[str]:
         """Addresses to try for this host, most-specific first: the
-        address it registered from, then every advertised NIC."""
+        address it registered from, then every advertised NIC. With a
+        user NIC restriction (hvdrun --network-interfaces; reference:
+        horovodrun --network-interface pinning the gloo iface), only
+        addresses on the named interfaces are considered — the
+        registration source address is kept only if it belongs to one
+        of them."""
+        allowed = None
+        if self.ifaces:
+            allowed = {ip for name in self.ifaces
+                       for ip in self.addrs.get(name, [])}
         seen, out = set(), []
         for a in [self.peer_addr] + \
                 [ip for lst in self.addrs.values() for ip in lst]:
-            if a not in seen:
-                seen.add(a)
-                out.append(a)
+            if a in seen:
+                continue
+            if allowed is not None and a not in allowed:
+                continue
+            seen.add(a)
+            out.append(a)
         return out
 
 
 class DriverService:
     """The launcher's registration/exit-collection RPC endpoint."""
 
-    def __init__(self, secret: str, num_hosts: int):
+    def __init__(self, secret: str, num_hosts: int,
+                 ifaces: Optional[List[str]] = None):
         self._secret = secret
         self._num_hosts = num_hosts
+        self._ifaces = list(ifaces) if ifaces else None
         self.tasks: Dict[str, TaskRecord] = {}
         self._exit_codes: Dict[int, int] = {}      # rank -> code
         self._cv = threading.Condition()
@@ -69,7 +85,8 @@ class DriverService:
 
     def _on_register(self, req: dict, peer) -> dict:
         rec = TaskRecord(str(req["host_id"]), peer[0],
-                         int(req["port"]), req.get("addrs", {}))
+                         int(req["port"]), req.get("addrs", {}),
+                         ifaces=self._ifaces)
         with self._cv:
             self.tasks[rec.host_id] = rec
             self._cv.notify_all()
@@ -117,6 +134,14 @@ class DriverService:
             t.join()
         for rec in self.tasks.values():
             if not rec.routable:
+                if rec.ifaces and not rec.candidates():
+                    raise RuntimeError(
+                        f"driver: host {rec.host_id} advertises no "
+                        f"address on the requested interface(s) "
+                        f"{rec.ifaces} — it has "
+                        f"{sorted(rec.addrs) or ['<none>']}; check "
+                        "--network-interfaces for typos/per-host "
+                        "naming differences")
                 raise RuntimeError(
                     f"driver: host {rec.host_id} registered but none of "
                     f"its addresses {rec.candidates()} accept "

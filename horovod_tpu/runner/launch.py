@@ -242,6 +242,7 @@ def run_with_driver(command: List[str], np_: int = 1,
                     output_filename: Optional[str] = None,
                     ssh_port: Optional[int] = None,
                     start_timeout: float = 30.0,
+                    network_interfaces: Optional[List[str]] = None,
                     verbose: bool = False) -> int:
     """Probed launch path (reference: horovodrun's default flow through
     driver_service.py): start a task service on every host, wait for
@@ -262,15 +263,25 @@ def run_with_driver(command: List[str], np_: int = 1,
         if info.host not in host_ids:
             host_ids.append(info.host)
 
-    driver = ds.DriverService(job_secret, num_hosts=len(host_ids))
+    driver = ds.DriverService(job_secret, num_hosts=len(host_ids),
+                              ifaces=network_interfaces)
     task_procs: List[subprocess.Popen] = []
     try:
         # Candidate driver addresses a task may reach us on: loopback
         # (local tasks) + every local NIC, all on the driver port.
         from . import network
-        cand = ",".join(f"{a}:{driver.port}"
-                        for a in network.flat_addresses(
-                            include_loopback=True))
+        local = network.local_addresses()
+        if network_interfaces:
+            # The restriction applies to BOTH directions (reference:
+            # horovodrun --network-interface pins the iface for the
+            # whole job): tasks should not burn connect timeouts on
+            # excluded driver NICs either. Loopback stays for local
+            # task services.
+            local = {k: v for k, v in local.items()
+                     if k in network_interfaces}
+        addrs = [a for lst in local.values() for a in lst]
+        addrs.append("127.0.0.1")
+        cand = ",".join(f"{a}:{driver.port}" for a in addrs)
         from .hosts import LOCALHOSTS
         for hid in host_ids:
             is_local = hid in LOCALHOSTS
@@ -354,6 +365,12 @@ def make_parser() -> argparse.ArgumentParser:
                         "stdout/stderr")
     p.add_argument("--ssh-port", type=int, default=None)
     p.add_argument("--start-timeout", type=float, default=30.0)
+    p.add_argument("--network-interfaces", default=None,
+                   help="comma-separated NIC names the probed "
+                        "(--driver) launch may use, both for task "
+                        "candidate addresses and the driver's own "
+                        "(reference: horovodrun --network-interface); "
+                        "no effect without --driver")
     p.add_argument("--driver", action="store_true",
                    help="launch through per-host task services with "
                         "NIC routability probing (reference: the "
@@ -526,12 +543,21 @@ def main(argv: Optional[List[str]] = None) -> int:
             env=env,
             verbose=args.verbose)
         return driver.run()
+    nics = None
+    if args.network_interfaces:
+        nics = [n.strip() for n in args.network_interfaces.split(",")
+                if n.strip()]
+        if not args.driver:
+            print("warning: --network-interfaces only affects the "
+                  "probed launch path; add --driver (ignored on the "
+                  "plain ssh path)", file=sys.stderr)
     if args.driver:
         return run_with_driver(
             command, np_=args.num_proc, hosts=args.hosts,
             env=env, output_filename=args.output_filename,
             ssh_port=args.ssh_port,
-            start_timeout=args.start_timeout, verbose=args.verbose)
+            start_timeout=args.start_timeout,
+            network_interfaces=nics, verbose=args.verbose)
     return run(command, np_=args.num_proc, hosts=args.hosts,
                env=env,
                output_filename=args.output_filename,

@@ -201,3 +201,42 @@ class TestProbedLaunch:
         assert rc == 0
         out = capfd.readouterr().out
         assert "RANK 0" in out and "RANK 1" in out
+
+
+class TestNicRestriction:
+    def test_candidates_filtered_by_interface(self):
+        from horovod_tpu.runner.driver_service import TaskRecord
+        addrs = {"eth0": ["10.0.0.5"], "docker0": ["172.17.0.1"]}
+        # unrestricted: registration source first, then all NICs
+        rec = TaskRecord("h", "10.0.0.5", 1234, addrs)
+        assert rec.candidates() == ["10.0.0.5", "172.17.0.1"]
+        # restricted to eth0: docker0 dropped; source kept (it IS
+        # eth0's address)
+        rec = TaskRecord("h", "10.0.0.5", 1234, addrs, ifaces=["eth0"])
+        assert rec.candidates() == ["10.0.0.5"]
+        # source NOT on an allowed NIC: dropped too
+        rec = TaskRecord("h", "172.17.0.1", 1234, addrs,
+                         ifaces=["eth0"])
+        assert rec.candidates() == ["10.0.0.5"]
+
+    def test_parser_accepts_network_interfaces(self):
+        from horovod_tpu.runner.launch import make_parser
+        args = make_parser().parse_args(
+            ["-np", "2", "--driver", "--network-interfaces",
+             "eth0,ens5", "python", "t.py"])
+        assert args.network_interfaces == "eth0,ens5"
+
+    def test_bad_interface_name_gives_actionable_error(self):
+        from horovod_tpu.runner.driver_service import (DriverService,
+                                                       TaskRecord)
+        sec = _secret.make_secret()
+        driver = DriverService(sec, num_hosts=1, ifaces=["eht0"])
+        try:
+            driver.tasks["h"] = TaskRecord(
+                "h", "10.0.0.5", 1, {"eth0": ["10.0.0.5"]},
+                ifaces=["eht0"])
+            with pytest.raises(RuntimeError,
+                               match="network-interfaces"):
+                driver.probe(timeout=0.1)
+        finally:
+            driver.close()
