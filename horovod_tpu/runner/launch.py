@@ -280,6 +280,13 @@ def run_with_driver(command: List[str], np_: int = 1,
             local = {k: v for k, v in local.items()
                      if k in network_interfaces}
         addrs = [a for lst in local.values() for a in lst]
+        if network_interfaces and not addrs and len(host_ids) > 1:
+            raise RuntimeError(
+                f"--network-interfaces {network_interfaces} matches "
+                f"none of the launcher's interfaces "
+                f"{sorted(network.local_addresses())} — remote task "
+                "services would have nothing but loopback to register "
+                "against")
         addrs.append("127.0.0.1")
         cand = ",".join(f"{a}:{driver.port}" for a in addrs)
         from .hosts import LOCALHOSTS
@@ -529,6 +536,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("error: no command given", file=sys.stderr)
         return 2
     env = env_from_flags(args)
+    nics = None
+    if args.network_interfaces:
+        nics = [n.strip() for n in args.network_interfaces.split(",")
+                if n.strip()]
+        if not args.driver:
+            print("warning: --network-interfaces only affects the "
+                  "probed launch path; add --driver (ignored on the "
+                  "plain ssh and elastic paths)", file=sys.stderr)
     if args.host_discovery_script:
         from .elastic import ElasticDriver, HostDiscoveryScript
         min_np = args.min_num_proc if args.min_num_proc is not None \
@@ -543,14 +558,6 @@ def main(argv: Optional[List[str]] = None) -> int:
             env=env,
             verbose=args.verbose)
         return driver.run()
-    nics = None
-    if args.network_interfaces:
-        nics = [n.strip() for n in args.network_interfaces.split(",")
-                if n.strip()]
-        if not args.driver:
-            print("warning: --network-interfaces only affects the "
-                  "probed launch path; add --driver (ignored on the "
-                  "plain ssh path)", file=sys.stderr)
     if args.driver:
         return run_with_driver(
             command, np_=args.num_proc, hosts=args.hosts,

@@ -52,13 +52,6 @@ def local_addresses() -> Dict[str, List[str]]:
     return out
 
 
-def flat_addresses(include_loopback: bool = False) -> List[str]:
-    addrs = [a for lst in local_addresses().values() for a in lst]
-    if include_loopback:
-        addrs.append("127.0.0.1")
-    return addrs
-
-
 def probe(addr: str, port: int, timeout: float = 2.0) -> bool:
     """TCP-connect reachability check (reference: the driver's probe of
     each task address)."""

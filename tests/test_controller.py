@@ -243,3 +243,25 @@ class TestNegotiationMultiProcess:
             timeout=240)
         assert r.returncode == 0, r.stdout + "\n" + r.stderr
         assert r.stdout.count("NEGOTIATION ALL OK") == np_
+
+
+@pytest.mark.integration
+def test_eager_cache_microbench_traffic_ratio():
+    """The benchmarks/ microbench's headline claim, asserted: the
+    response cache shrinks steady-state control traffic severalfold
+    (reference: response_cache.cc's bit-vector motivation; here
+    5-byte id announcements)."""
+    import importlib.util
+    import os as _os
+    spec = importlib.util.spec_from_file_location(
+        "eager_cache_latency",
+        _os.path.join(_os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__))), "benchmarks",
+            "eager_cache_latency.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    on = mod.run_job(100, cache_capacity=1024)
+    off = mod.run_job(100, cache_capacity=0)
+    per_on = on["control_bytes"] / (on["iters"] + mod.WARMUP)
+    per_off = off["control_bytes"] / (off["iters"] + mod.WARMUP)
+    assert per_off > 2 * per_on, (per_on, per_off)
