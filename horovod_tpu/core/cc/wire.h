@@ -243,7 +243,15 @@ inline bool ParseRequests(const std::string& d, std::vector<Request>* out) {
   uint32_t n;
   if (!rd.GetU32(&n)) return false;
   out->clear();
-  out->reserve(n);
+  // n is wire-controlled. Two bounds: an impossible count (every
+  // entry costs >= 5 payload bytes, so n can never exceed the
+  // payload size) is rejected outright — otherwise a well-formed
+  // frame of minimal entries could legally materialize tens of GB of
+  // structs; and the speculative reserve is clamped so a lying
+  // header cannot force a huge allocation before per-entry parses
+  // fail.
+  if (n > d.size()) return false;
+  out->reserve(n < 4096 ? n : 4096);
   for (uint32_t i = 0; i < n; ++i) {
     Request r;
     uint8_t cached;
@@ -286,7 +294,8 @@ inline bool ParseEntries(const std::string& d, std::vector<Entry>* out) {
   uint32_t n;
   if (!rd.GetU32(&n)) return false;
   out->clear();
-  out->reserve(n);
+  if (n > d.size()) return false;     // see ParseRequests
+  out->reserve(n < 4096 ? n : 4096);
   for (uint32_t i = 0; i < n; ++i) {
     Entry e;
     uint32_t bid, act;

@@ -540,10 +540,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.network_interfaces:
         nics = [n.strip() for n in args.network_interfaces.split(",")
                 if n.strip()]
-        if not args.driver:
+        if args.host_discovery_script:
+            print("warning: --network-interfaces is not supported on "
+                  "the elastic path and will be ignored",
+                  file=sys.stderr)
+        elif not args.driver:
             print("warning: --network-interfaces only affects the "
                   "probed launch path; add --driver (ignored on the "
-                  "plain ssh and elastic paths)", file=sys.stderr)
+                  "plain ssh path)", file=sys.stderr)
     if args.host_discovery_script:
         from .elastic import ElasticDriver, HostDiscoveryScript
         min_np = args.min_num_proc if args.min_num_proc is not None \

@@ -1,0 +1,46 @@
+"""Wire-parser fuzzing under ASan+UBSan (SURVEY.md §5.2 race/sanitizer
+stance: the reference relies on FlatBuffers verification; this build's
+hand-rolled format gets a hand-rolled fuzzer). Gated on the C++
+toolchain like the TSAN stress."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+CCDIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "horovod_tpu", "core", "cc")
+
+
+def _asan_available() -> bool:
+    """Probe-compile a trivial -fsanitize=address program: only a
+    missing libasan may skip the fuzz test — a compile-broken harness
+    must FAIL, not silently vanish from CI."""
+    import tempfile
+    with tempfile.TemporaryDirectory() as d:
+        src = os.path.join(d, "p.cc")
+        with open(src, "w") as f:
+            f.write("int main(){return 0;}\n")
+        r = subprocess.run(
+            ["g++", "-fsanitize=address", src, "-o",
+             os.path.join(d, "p")],
+            capture_output=True, timeout=120)
+        return r.returncode == 0
+
+
+@pytest.mark.integration
+def test_wire_parsers_survive_fuzzing():
+    if shutil.which("g++") is None or shutil.which("make") is None:
+        pytest.skip("no C++ toolchain")
+    if not _asan_available():
+        pytest.skip("libasan unavailable")
+    build = subprocess.run(["make", "-C", CCDIR, "fuzz_wire"],
+                           capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, build.stderr[-2000:]
+    r = subprocess.run([os.path.join(CCDIR, "fuzz_wire"), "30000"],
+                       capture_output=True, text=True, timeout=300)
+    assert "AddressSanitizer" not in r.stderr, r.stderr[-3000:]
+    assert "runtime error" not in r.stderr, r.stderr[-3000:]
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr[-2000:])
+    assert "FUZZ OK" in r.stdout, r.stdout
