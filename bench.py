@@ -36,8 +36,10 @@ in the same band); the headline img/sec cannot move much without
 changing the model's BN structure, which the benchmark contract
 forbids.
 
-Env knobs: BENCH_BATCH (default 128), BENCH_STEPS (30), BENCH_WARMUP
-(5), BENCH_IMAGE (224), BENCH_PROFILE (trace dir), BENCH_PEAK_TFLOPS.
+Env knobs: BENCH_BATCH (default 128), BENCH_STEPS (200 — a ~10s
+window at bs 128 on v5e, so round-over-round deltas above ~0.5% are
+above tunnel noise), BENCH_WARMUP (5), BENCH_IMAGE (224),
+BENCH_PROFILE (trace dir), BENCH_PEAK_TFLOPS.
 """
 
 import json
@@ -109,7 +111,7 @@ def aot_compile(step_fn, *args):
 
 def main():
     batch_per_chip = int(os.environ.get("BENCH_BATCH", "128"))
-    steps = int(os.environ.get("BENCH_STEPS", "30"))
+    steps = int(os.environ.get("BENCH_STEPS", "200"))
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
     image = int(os.environ.get("BENCH_IMAGE", "224"))
     profile_dir = os.environ.get("BENCH_PROFILE", "")

@@ -76,6 +76,11 @@ def init(config_overrides: Optional[Dict[str, Any]] = None,
         cfg = Config(config_overrides)
         _state.config = cfg
         hlog.configure(cfg.log_level, cfg.log_timestamp)
+        # Fail fast on bad knob values BEFORE any threads/sockets/
+        # backends exist — a raise later would leak a live engine
+        # because shutdown() early-returns while !initialized.
+        from ..ops import dispatch as _dispatch
+        _dispatch.set_alltoall_mode(cfg.alltoall_mode)
         _state._owns_distributed = _ensure_distributed(cfg)
         _state.topology = detect(cfg)
         hlog.set_rank(_state.topology.rank)
@@ -106,7 +111,7 @@ def init(config_overrides: Optional[Dict[str, Any]] = None,
             from ..ops.controller import (NegotiatedController,
                                           PythonCore)
             forced_python = mode == "python"
-            core = (PythonCore(cfg.fusion_threshold)
+            core = (PythonCore(cfg.fusion_threshold, cfg.cycle_time_ms)
                     if forced_python and _state.topology.size == 1
                     else None)
             _state.engine.controller = NegotiatedController(
@@ -127,7 +132,6 @@ def init(config_overrides: Optional[Dict[str, Any]] = None,
         # ALLREDUCE / NCCLHierarchicalAllreduce): factor the process
         # axis as (slice over DCN) x (chip-within-slice over ICI)
         # using the launcher-detected local_size.
-        from ..ops import dispatch as _dispatch
         _dispatch.set_hierarchical(
             _state.topology.local_size
             if cfg.hierarchical_allreduce else 0)
@@ -172,6 +176,7 @@ def shutdown() -> None:
         _state.topology = None
         from ..ops import dispatch as _dispatch
         _dispatch.set_hierarchical(0)
+        _dispatch.set_alltoall_mode("auto")
 
 
 atexit.register(shutdown)

@@ -50,6 +50,12 @@ KNOBS: List[Knob] = [
     # TPU analog — XLA fuses bucket gather/scatter copies and owns the
     # launch lanes. Deliberately NOT declared: a knob that silently
     # does nothing is worse than an unknown-variable warning.)
+    Knob("HOROVOD_ALLTOALL_MODE", str, "auto",
+         "alltoallv exchange layout: 'padded' = one all_to_all padded "
+         "to the global max split (n*max wire bytes); 'ragged' = "
+         "shift-round ppermutes with per-round bucketed maxima (wire "
+         "bytes track the real split matrix — the MPI_Alltoallv exact-"
+         "counts analog); 'auto' picks ragged for skewed routing."),
     Knob("HOROVOD_ADASUM_PALLAS", str, "auto",
          "Adasum pair-combine implementation: 'auto' = fused Pallas "
          "kernel on TPU / plain jnp elsewhere; 1 forces the Pallas "
@@ -185,6 +191,7 @@ class Config:
         "autotune_warmup_samples": "HOROVOD_AUTOTUNE_WARMUP_SAMPLES",
         "autotune_steps_per_sample": "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE",
         "adasum_pallas": "HOROVOD_ADASUM_PALLAS",
+        "alltoall_mode": "HOROVOD_ALLTOALL_MODE",
         "order_check": "HOROVOD_ORDER_CHECK",
         "stall_check_disable": "HOROVOD_STALL_CHECK_DISABLE",
         "stall_check_time": "HOROVOD_STALL_CHECK_TIME_SECONDS",

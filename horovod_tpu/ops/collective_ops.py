@@ -308,7 +308,8 @@ def alltoall_async(tensor, splits: Optional[Sequence[int]] = None,
             recv = [mat[src][me] for src in range(n)]
             maxsplit = max(max(max(row) for row in mat), 1)
             out = dispatch.alltoall(t, splits, recv, pset,
-                                    maxsplit=maxsplit)
+                                    maxsplit=maxsplit,
+                                    split_matrix=mat)
             return out, jnp.asarray(recv, jnp.int32)
 
         return st.engine.controller.submit_generic(
@@ -322,7 +323,8 @@ def alltoall_async(tensor, splits: Optional[Sequence[int]] = None,
         # Global max over the whole split matrix so every rank compiles
         # the same padded SPMD program.
         maxsplit = max(int(mat.max()), 1)
-        out = dispatch.alltoall(t, splits, recv, pset, maxsplit=maxsplit)
+        out = dispatch.alltoall(t, splits, recv, pset, maxsplit=maxsplit,
+                                split_matrix=mat)
         return out, jnp.asarray(recv, jnp.int32)
 
     return st.engine.run(name, _nbytes([t]), fn).id

@@ -21,6 +21,7 @@ the reference controller's FuseResponses rule).
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -106,11 +107,12 @@ class PythonCore:
     entries in batches; fusion packing is the same greedy same-key
     rule but runs on the caller's thread, not a cycle thread."""
 
-    def __init__(self, fusion_threshold: int):
+    def __init__(self, fusion_threshold: int, cycle_time_ms: float = 0.0):
         self.fusion_threshold = fusion_threshold
+        self.cycle_time_ms = float(cycle_time_ms)
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
-        self._pending: List[native.BatchEntry] = []
+        self._pending: collections.deque = collections.deque()
         self._joined = False
         self._shutdown = False
         self._cycles = 0
@@ -144,9 +146,21 @@ class PythonCore:
                 return None
             if not self._pending:
                 return []
+            if self.cycle_time_ms > 0:
+                # Cycle pacing: linger so concurrent submitters can land
+                # in the same fused batch (reference: the background
+                # loop's HOROVOD_CYCLE_TIME sleep). This is what the
+                # autotuner's set_cycle_time actually tunes here.
+                deadline = time.monotonic() + self.cycle_time_ms / 1e3
+                while not self._shutdown:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._cv.wait(left)
             self._cycles += 1
             # greedy same-key fusion from the front (mirrors the C++
-            # coordinator's FuseResponses loop)
+            # coordinator's FuseResponses loop); deque keeps drain O(1)
+            # per entry under backlog
             first, _ = self._pending[0]
             key = first.sig.split("#", 1)[0]
             batch, total = [], 0
@@ -158,7 +172,7 @@ class PythonCore:
                     break
                 batch.append(e)
                 total += nb
-                self._pending.pop(0)
+                self._pending.popleft()
             return batch
 
     def set_fusion_threshold(self, nbytes: int) -> None:
@@ -166,9 +180,10 @@ class PythonCore:
             self.fusion_threshold = int(nbytes)
 
     def set_cycle_time(self, ms: float) -> None:
-        # Single-process core has no cycle sleep; accepted for API
-        # parity with NativeCore so the autotuner can push blindly.
-        self.cycle_time_ms = float(ms)
+        # Paces next_batch's accumulation window (see above) — the
+        # same knob the NativeCore's coordinator cycle honors.
+        with self._cv:
+            self.cycle_time_ms = float(ms)
 
     def control_bytes(self) -> int:
         return 0  # nothing crosses a wire in-process
@@ -233,7 +248,8 @@ class NegotiatedController:
                 cache_capacity=cfg.cache_capacity,
                 auth_secret=control_plane_secret())
         elif topology.size == 1:
-            self.core = PythonCore(cfg.fusion_threshold)
+            self.core = PythonCore(cfg.fusion_threshold,
+                                   cfg.cycle_time_ms)
         else:
             raise RuntimeError(
                 "multi-process negotiation requires the native core "

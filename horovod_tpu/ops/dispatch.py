@@ -342,6 +342,102 @@ def _alltoall_kernel(mesh, n: int, maxsplit: int, sig: Tuple):
 
 
 @functools.lru_cache(maxsize=None)
+def _ppermute_shift_kernel(mesh, n: int, shift: int, sig: Tuple):
+    """One ragged-alltoallv round: every rank sends its chunk (padded
+    to this round's bucket) to set-rank (rank+shift) % n and receives
+    from (rank-shift) % n. The ragged exchange runs n-1 of these with
+    per-round bucket sizes instead of one all_to_all padded to the
+    global max (reference: horovod/common/ops/mpi_operations.cc
+    MPIAlltoall uses MPI_Alltoallv with exact per-pair counts; SPMD
+    needs rank-identical shapes, so per-ROUND maxima are the exact
+    analog)."""
+    pairs = tuple((i, (i + shift) % n) for i in range(n))
+
+    def body(block):
+        return lax.ppermute(block, "proc", perm=pairs)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc"),
+                       out_specs=P("proc"))
+    return jax.jit(fn)
+
+
+# alltoall split-exchange mode (HOROVOD_ALLTOALL_MODE): "padded" = one
+# all_to_all padded to the global max split; "ragged" = n-1 ppermute
+# rounds with per-round bucketed maxima (wire bytes track the real
+# split matrix, not n * global-max); "auto" picks ragged when the
+# split matrix is skewed enough that it moves < 3/4 of the padded
+# bytes despite the extra launches.
+_alltoall_mode = "auto"
+
+# Introspection for tests/benchmarks: rows moved by the last alltoall
+# on this rank vs what the padded kernel would have moved.
+_last_alltoall_stats: dict = {}
+
+
+def set_alltoall_mode(mode: str) -> None:
+    global _alltoall_mode
+    mode = (mode or "auto").lower()
+    if mode not in ("auto", "ragged", "padded"):
+        raise ValueError(
+            f"HOROVOD_ALLTOALL_MODE must be auto/ragged/padded, "
+            f"got {mode!r}")
+    _alltoall_mode = mode
+
+
+def last_alltoall_stats() -> dict:
+    return dict(_last_alltoall_stats)
+
+
+def _pow2_bucket(k: int) -> int:
+    """Smallest power of two >= k (0 -> 0). Bucketing the per-round
+    pad bounds recompiles to O(log max) distinct shapes per shift even
+    when routing (hence the split matrix) changes every step, at the
+    cost of at most 2x the per-round-max bytes."""
+    return 1 << (int(k) - 1).bit_length() if k > 0 else 0
+
+
+def _ragged_round_buckets(matrix: np.ndarray) -> List[int]:
+    """Bucketed send size for each shift round r=1..n-1: the max over
+    ranks i of matrix[i][(i+r) % n], rounded up to a power of two."""
+    n = matrix.shape[0]
+    idx = np.arange(n)
+    return [_pow2_bucket(int(matrix[idx, (idx + r) % n].max()))
+            for r in range(1, n)]
+
+
+def _alltoall_ragged(x: jax.Array, splits: Sequence[int],
+                     recv_splits: Sequence[int], pset: ProcessSet,
+                     matrix: np.ndarray,
+                     buckets: Sequence[int]) -> jax.Array:
+    """Ragged alltoallv: shift rounds of exact (bucket-padded) chunks.
+    Rounds are independent XLA programs, so they dispatch
+    asynchronously and overlap on the ICI."""
+    n = pset.size
+    me = pset.rank()
+    rest = x.shape[1:]
+    offs = np.concatenate([[0], np.cumsum(splits)]).astype(int)
+    out_chunks: List[Any] = [None] * n
+    out_chunks[me] = x[offs[me]:offs[me] + splits[me]]
+    for r in range(1, n):
+        dst = (me + r) % n
+        src = (me - r) % n
+        rows_from_src = int(matrix[src][me])
+        bucket = buckets[r - 1]
+        if bucket == 0:
+            out_chunks[src] = jnp.zeros((0,) + rest, x.dtype)
+            continue
+        c = x[offs[dst]:offs[dst] + splits[dst]]
+        if c.shape[0] < bucket:
+            pad = [(0, bucket - c.shape[0])] + [(0, 0)] * (x.ndim - 1)
+            c = jnp.pad(c, pad)
+        kern = _ppermute_shift_kernel(pset.mesh, n, r, _sig([c]))
+        got = local_shard(kern(to_global(c, pset)))
+        out_chunks[src] = got[:rows_from_src]
+    return (jnp.concatenate(out_chunks, axis=0) if n
+            else jnp.zeros((0,) + rest, x.dtype))
+
+
+@functools.lru_cache(maxsize=None)
 def _reducescatter_kernel(mesh, n: int, op: int, prescale: float,
                           postscale: float, rows: Tuple[int, ...],
                           sig: Tuple):
@@ -503,14 +599,19 @@ def broadcast(tensor: jax.Array, root: int, pset: ProcessSet) -> jax.Array:
 
 def alltoall(tensor: jax.Array, splits: Sequence[int],
              recv_splits: Sequence[int], pset: ProcessSet,
-             maxsplit: Optional[int] = None) -> jax.Array:
+             maxsplit: Optional[int] = None,
+             split_matrix: Optional[Sequence[Sequence[int]]] = None
+             ) -> jax.Array:
     """Distribute `tensor` rows: splits[i] rows go to set-rank i;
     recv_splits[i] rows arrive from set-rank i (exchanged by caller).
 
     `maxsplit` MUST be the global maximum over the full split matrix
     (all ranks' sends), or ranks would compile different-shaped SPMD
     programs for the same collective; the caller computes it from the
-    exchanged matrix."""
+    exchanged matrix. When the full `split_matrix` (matrix[i][j] =
+    rows rank i sends rank j) is provided, skewed routing takes the
+    ragged ppermute-rounds path whose wire bytes track sum(splits)
+    instead of n * maxsplit (see HOROVOD_ALLTOALL_MODE)."""
     x = _as_local(tensor)
     n = pset.size
     if n == 1:
@@ -523,6 +624,29 @@ def alltoall(tensor: jax.Array, splits: Sequence[int],
     if maxsplit is None:
         maxsplit = max(max(splits), max(recv_splits), 1)
     rest = x.shape[1:]
+
+    if split_matrix is not None and _alltoall_mode != "padded" and n > 1:
+        matrix = np.asarray(split_matrix, dtype=np.int64)
+        buckets = _ragged_round_buckets(matrix)
+        # Every rank moves the same padded volume per round (SPMD), so
+        # the rank-level comparison is global: ragged moves
+        # sum(buckets) rows/rank vs the padded kernel's n * maxsplit.
+        ragged_rows = int(sum(buckets))
+        padded_rows = n * int(maxsplit)
+        use_ragged = (_alltoall_mode == "ragged"
+                      or ragged_rows * 4 < padded_rows * 3)
+        _last_alltoall_stats.update(
+            path="ragged" if use_ragged else "padded",
+            wire_rows=ragged_rows if use_ragged else padded_rows,
+            ragged_rows=ragged_rows, padded_rows=padded_rows)
+        if use_ragged:
+            out = _alltoall_ragged(x, splits, recv_splits, pset,
+                                   matrix, buckets)
+            return out.astype(jnp.bool_) if was_bool else out
+    else:
+        _last_alltoall_stats.update(
+            path="padded", wire_rows=n * int(maxsplit),
+            ragged_rows=None, padded_rows=n * int(maxsplit))
     # Pack into (n, maxsplit, *rest) with chunk for dest i at [i].
     chunks = []
     off = 0

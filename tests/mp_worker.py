@@ -80,6 +80,27 @@ def main():
             np.asarray(out[off:off + want_recv[src]]), float(src))
         off += want_recv[src]
 
+    # SKEWED alltoall (the MoE hot path: most rows stay local). The
+    # ragged exchange must move ~sum(cross splits) rows on the wire,
+    # not n * maxsplit (reference: MPI_Alltoallv exact counts).
+    sends = [64 if d == r else 1 for d in range(n)]
+    x = jnp.concatenate(
+        [jnp.full((sends[d], 2), float(10 * r + d)) for d in range(n)])
+    out, recv = hvd.alltoall(x, splits=sends, name="t5s")
+    want_recv = [64 if src == r else 1 for src in range(n)]
+    np.testing.assert_array_equal(np.asarray(recv), want_recv)
+    off = 0
+    for src in range(n):
+        np.testing.assert_allclose(
+            np.asarray(out[off:off + want_recv[src]]),
+            float(10 * src + r))
+        off += want_recv[src]
+    from horovod_tpu.ops import dispatch as _dispatch
+    st = _dispatch.last_alltoall_stats()
+    assert st["path"] == "ragged", st
+    assert st["wire_rows"] == n - 1, st        # 1-row bucket per round
+    assert st["padded_rows"] == n * 64, st     # what padding would move
+
     # reducescatter
     x = jnp.ones((2 * n, 3)) * (r + 1)
     out = hvd.reducescatter(x, op=hvd.Sum, name="t6")

@@ -159,6 +159,32 @@ def test_alltoall_kernel(eight_device_mesh):
                 got_rows[j][i], np.full((maxsplit, 1), 10 * i + j))
 
 
+def test_ppermute_shift_kernel(eight_device_mesh):
+    mesh = eight_device_mesh
+    xs = np.stack([np.full((2, 1), float(i), np.float32)
+                   for i in range(N)])
+    for shift in (1, 3, 7):
+        kern = dispatch._ppermute_shift_kernel(
+            mesh, N, shift, dispatch._sig([jnp.asarray(xs[0])]))
+        out = kern(make_global(mesh, xs))
+        for j, got in enumerate(rows_of(out)):
+            np.testing.assert_array_equal(
+                got, np.full((2, 1), float((j - shift) % N)))
+
+
+def test_ragged_round_buckets():
+    mat = np.array([[5, 1, 0],
+                    [0, 7, 2],
+                    [3, 0, 9]])
+    # r=1: max(mat[0][1], mat[1][2], mat[2][0]) = 3 -> pow2 bucket 4
+    # r=2: max(mat[0][2], mat[1][0], mat[2][1]) = 0 -> no exchange
+    assert dispatch._ragged_round_buckets(mat) == [4, 0]
+    assert dispatch._pow2_bucket(0) == 0
+    assert dispatch._pow2_bucket(1) == 1
+    assert dispatch._pow2_bucket(8) == 8
+    assert dispatch._pow2_bucket(9) == 16
+
+
 def test_reducescatter_even(eight_device_mesh):
     mesh = eight_device_mesh
     rng = np.random.RandomState(3)
