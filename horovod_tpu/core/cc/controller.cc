@@ -388,14 +388,15 @@ void Controller::RunCoordinatorCycle() {
         e.batch_id = bid;
         e.active_ranks =
             opts_.size - static_cast<int>(joined_ranks_.size());
-        // Generic ops (broadcast/allgather/alltoall/barrier, sig
-        // prefix "g|") cannot zero-fill a joined rank's contribution
-        // the way allreduce can; agreeing them with a rank absent
-        // would leave the submitters blocked inside a global XLA
-        // collective the joined rank never launches. The reference
-        // rejects join with non-allreduce ops; do the same, cleanly.
+        // Non-allreduce ops (broadcast "bc|", allgather "ag|", and
+        // generic "g|" alltoall/barrier) cannot zero-fill a joined
+        // rank's contribution the way allreduce can (a joined root's
+        // broadcast payload is unfabricatable); agreeing them with a
+        // rank absent would leave the submitters blocked inside a
+        // global XLA collective the joined rank never launches. The
+        // reference rejects join with non-allreduce ops; same, cleanly.
         if (st.error.empty() && !joined_ranks_.empty() &&
-            st.sig.rfind("g|", 0) == 0) {
+            st.sig.rfind("ar|", 0) != 0) {
           st.error = "hvd.join() is only supported with "
                      "allreduce-style ops: op '" + e.name +
                      "' was agreed while " +

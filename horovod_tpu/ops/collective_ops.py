@@ -60,11 +60,27 @@ def _nbytes(tensors) -> int:
                    for t in tensors))
 
 
-def _run(st, name: str, nbytes: int, fn) -> int:
+def _controller_for(st, pset):
+    """The negotiated controller for this op, or None for the inline
+    path. Subset process sets dispatch inline: the negotiation is
+    WORLD-scoped (the coordinator waits for every non-joined rank), so
+    a subset op would block on non-members that never submit. Inline
+    subset ops follow the standard SPMD contract — members call them
+    in identical program order (reference analog: per-process-set
+    communicators; the world set keeps the any-order guarantee)."""
+    ctl = st.engine.controller
+    if ctl is None or pset.size != st.topology.size:
+        return None
+    return ctl
+
+
+def _run(st, name: str, nbytes: int, fn, pset=None) -> int:
     """Route an op through the negotiated controller when active (the
     agreed-order path), else dispatch inline via the engine."""
-    if st.engine.controller is not None:
-        return st.engine.controller.submit_generic(name, nbytes, fn).id
+    ctl = (_controller_for(st, pset) if pset is not None
+           else st.engine.controller)
+    if ctl is not None:
+        return ctl.submit_generic(name, nbytes, fn).id
     return st.engine.run(name, nbytes, fn).id
 
 
@@ -93,12 +109,13 @@ def grouped_allreduce_async(tensors: List[jax.Array], average=None,
     _check_inexact_for_average(rop, tensors)
     name = name or st.engine.auto_name("grouped_allreduce")
 
-    if st.engine.controller is not None:
+    ctl = _controller_for(st, pset)
+    if ctl is not None:
         # Same-dtype negotiation units (mixed-dtype groups split, as
         # the reference controller only fuses same-dtype responses).
         wires = [jnp.asarray(t) for t in tensors]
         if len({str(w.dtype) for w in wires}) == 1:
-            return st.engine.controller.submit_allreduce(
+            return ctl.submit_allreduce(
                 name, wires, pset, rop, prescale_factor,
                 postscale_factor, compression, grouped=True).id
         # mixed dtypes: one grouped submission per dtype bucket,
@@ -181,8 +198,9 @@ def allreduce_async(tensor, average=None, name=None, op=None,
     pset = _pset(process_set)
     rop = _resolve_op(op, average)
     _check_inexact_for_average(rop, [tensor])
-    if st.engine.controller is not None:
-        return st.engine.controller.submit_allreduce(
+    ctl = _controller_for(st, pset)
+    if ctl is not None:
+        return ctl.submit_allreduce(
             name, [tensor], pset, rop, prescale_factor,
             postscale_factor, compression).id
     wire, ctx = compression.compress(tensor)
@@ -224,17 +242,15 @@ def allgather_async(tensor, name: Optional[str] = None,
     if t.ndim == 0:
         t = t[None]
 
-    if st.engine.controller is not None:
+    ctl = _controller_for(st, pset)
+    if ctl is not None:
         # Uneven first-dim sizes ride the negotiation Request metadata
         # and come back aggregated on the agreed entry (reference: the
         # controller sizing uneven allgathers from Request shapes) —
         # no separate data-plane exchange, no host sync per call.
-        def fn_meta(metas):
-            sizes = [int(metas[r]) for r in pset.ranks]
-            return dispatch.allgather(t, pset, sizes)
-
-        return st.engine.controller.submit_generic(
-            name, _nbytes([t]), fn_meta, meta=str(t.shape[0])).id
+        # Fusable key: same-dtype/pset allgathers agreed in one cycle
+        # execute as ONE launch.
+        return ctl.submit_allgather(name, t, pset).id
 
     def fn():
         sizes = dispatch.exchange_int_vector([t.shape[0]], pset)[:, 0]
@@ -262,10 +278,16 @@ def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
     set_root = pset.ranks.index(root_rank)
     t = jnp.asarray(tensor)
 
+    ctl = _controller_for(st, pset)
+    if ctl is not None:
+        # Fusable key: same dtype/root/pset broadcasts agreed in one
+        # cycle land in one fused launch.
+        return ctl.submit_broadcast(name, t, set_root, pset).id
+
     def fn():
         return dispatch.broadcast(t, set_root, pset)
 
-    return _run(st, name, _nbytes([t]), fn)
+    return _run(st, name, _nbytes([t]), fn, pset=pset)
 
 
 def broadcast(tensor, root_rank: int, name=None,
@@ -298,7 +320,8 @@ def alltoall_async(tensor, splits: Optional[Sequence[int]] = None,
     if sum(splits) != t.shape[0]:
         raise ValueError("splits must sum to the first dimension")
 
-    if st.engine.controller is not None:
+    ctl = _controller_for(st, pset)
+    if ctl is not None:
         # Split vectors ride the negotiation metadata (see
         # allgather_async): fn receives every rank's splits.
         def fn_meta(metas):
@@ -312,7 +335,7 @@ def alltoall_async(tensor, splits: Optional[Sequence[int]] = None,
                                     split_matrix=mat)
             return out, jnp.asarray(recv, jnp.int32)
 
-        return st.engine.controller.submit_generic(
+        return ctl.submit_generic(
             name, _nbytes([t]), fn_meta,
             meta=",".join(str(s) for s in splits)).id
 
@@ -359,7 +382,7 @@ def reducescatter_async(tensor, op=None, name: Optional[str] = None,
         return dispatch.reducescatter(t, pset, rop, prescale_factor,
                                       postscale_factor)
 
-    return _run(st, name, _nbytes([t]), fn)
+    return _run(st, name, _nbytes([t]), fn, pset=pset)
 
 
 def reducescatter(tensor, op=None, name=None, prescale_factor=1.0,
@@ -376,10 +399,10 @@ def reducescatter(tensor, op=None, name=None, prescale_factor=1.0,
 def barrier(process_set: Optional[ProcessSet] = None) -> None:
     st = _require_init()
     pset = _pset(process_set)
-    if st.engine.controller is not None:
+    ctl = _controller_for(st, pset)
+    if ctl is not None:
         name = st.engine.auto_name("barrier")
-        h = st.engine.controller.submit_generic(
-            name, 4, lambda: dispatch.barrier(pset))
+        h = ctl.submit_generic(name, 4, lambda: dispatch.barrier(pset))
         synchronize(h.id)
         return
     dispatch.barrier(pset)

@@ -13,10 +13,16 @@ requirement to Horovod's "submit whenever ready" contract.
 
 Signature format (the Request metadata; reference: message.fbs):
   allreduce:  "ar|<wiredtype>|<op>|<pset>|<pre>|<post>#s0xs1,...;..."
-  generic:    "g|<name>#"        (never fuses with anything else)
+  broadcast:  "bc|<dtype>|<root>|<pset>#s0xs1..."
+  allgather:  "ag|<dtype>|<pset>#r0xr1..."  (trailing dims only; the
+              per-rank first-dim size rides the Request meta)
+  generic:    "g|<name>#"        (never fuses with anything else —
+              alltoall/barrier, whose data exchange is per-rank-shaped)
 The part before '#' is the fuse key; the coordinator only packs
-same-key tensors into one batch (same dtype/op/process-set/scales —
-the reference controller's FuseResponses rule).
+same-key tensors into one batch (same dtype/op/process-set/scales for
+allreduce, same dtype/root/pset for broadcast, same dtype/pset for
+allgather — the reference controller's FuseResponses rule, which
+packs non-allreduce responses of the same type too).
 """
 
 from __future__ import annotations
@@ -94,6 +100,25 @@ class _PendingGeneric:
         self.fn = fn
         self.handle = handle
         self.wants_meta = wants_meta  # fn takes the per-rank metas list
+
+
+class _PendingBroadcast:
+    __slots__ = ("tensor", "root", "pset", "handle")
+
+    def __init__(self, tensor, root, pset, handle):
+        self.tensor = tensor
+        self.root = root
+        self.pset = pset
+        self.handle = handle
+
+
+class _PendingAllgather:
+    __slots__ = ("tensor", "pset", "handle")
+
+    def __init__(self, tensor, pset, handle):
+        self.tensor = tensor
+        self.pset = pset
+        self.handle = handle
 
 
 class PythonCore:
@@ -214,6 +239,10 @@ class NegotiatedController:
         self._pushed_fusion = cfg.fusion_threshold
         self._pushed_cycle = cfg.cycle_time_ms
         self._last_cycle_mark = -1
+        # Introspection: per-kind [batches, entries] executed — a
+        # fused batch increments batches by 1 and entries by N
+        # (tests assert fusion actually happened).
+        self.exec_counts: Dict[str, List[int]] = {}
 
         if cfg.controller == "python" and topology.size > 1 and \
                 core is None:
@@ -300,6 +329,51 @@ class NegotiatedController:
         if self.engine.timeline is not None:
             self.engine.timeline.negotiate_start(name)
         self.core.submit(name, sig, nbytes)
+        return h
+
+    def submit_broadcast(self, name: str, tensor, set_root: int,
+                         pset) -> Any:
+        """Submit a broadcast with a fusable key: N eager broadcasts of
+        the same dtype/root/process-set agreed in one cycle land in ONE
+        fused XLA launch (reference: controller.cc FuseResponses packs
+        same-type broadcast responses into the fusion buffer too)."""
+        h = self.engine.new_handle(name)
+        t = jnp.asarray(tensor)
+        shape = "x".join(str(d) for d in t.shape)
+        sig = (f"bc|{t.dtype}|{set_root}|{pset.process_set_id}#{shape}")
+        nbytes = int(np.prod(t.shape) * jnp.dtype(t.dtype).itemsize)
+        with self._mu:
+            if name in self._pending:
+                h.set_error(ValueError(
+                    f"a collective named '{name}' is already pending"))
+                return h
+            self._pending[name] = _PendingBroadcast(t, set_root, pset, h)
+        if self.engine.timeline is not None:
+            self.engine.timeline.negotiate_start(name)
+        self.core.submit(name, sig, nbytes)
+        return h
+
+    def submit_allgather(self, name: str, tensor, pset) -> Any:
+        """Submit an allgather with a fusable key. The per-rank
+        first-dim size rides the Request meta (aggregated by the
+        coordinator); trailing dims live in the sig so cross-rank
+        mismatches become clean error entries."""
+        h = self.engine.new_handle(name)
+        t = jnp.asarray(tensor)
+        if t.ndim == 0:
+            t = t[None]
+        rest = "x".join(str(d) for d in t.shape[1:])
+        sig = f"ag|{t.dtype}|{pset.process_set_id}#{rest}"
+        nbytes = int(np.prod(t.shape) * jnp.dtype(t.dtype).itemsize)
+        with self._mu:
+            if name in self._pending:
+                h.set_error(ValueError(
+                    f"a collective named '{name}' is already pending"))
+                return h
+            self._pending[name] = _PendingAllgather(t, pset, h)
+        if self.engine.timeline is not None:
+            self.engine.timeline.negotiate_start(name)
+        self.core.submit(name, sig, nbytes, str(t.shape[0]))
         return h
 
     def submit_generic(self, name: str, nbytes: int,
@@ -431,8 +505,15 @@ class NegotiatedController:
             if len(live) > 1 and marked:
                 tl.fuse(marked[0].name, len(live))
         kind = live[0].sig.split("|", 1)[0]
+        c = self.exec_counts.setdefault(kind, [0, 0])
+        c[0] += 1
+        c[1] += len(live)
         if kind == "ar":
             self._execute_allreduce_batch(live)
+        elif kind == "bc":
+            self._execute_broadcast_batch(live)
+        elif kind == "ag":
+            self._execute_allgather_batch(live)
         else:
             self._execute_generic(live)
 
@@ -461,6 +542,72 @@ class NegotiatedController:
                 # so close the DISPATCH span here on the error path.
                 if self.engine.timeline is not None:
                     self.engine.timeline.done(e.name, error=True)
+
+    def _collect_fused(self, entries):
+        """Pop the pendings for a fused bc/ag batch. The coordinator
+        errors these kinds when any rank has joined (they cannot
+        zero-fill), so every live entry must have a local pending;
+        a miss is a protocol bug — fail that handle defensively."""
+        slots = []
+        for e in entries:
+            with self._mu:
+                p = self._pending.pop(e.name, None)
+            if p is None:  # pragma: no cover - defensive
+                hlog.error("agreed op '%s' was never submitted here",
+                           e.name)
+                continue
+            if self.engine.timeline is not None:
+                self.engine.timeline.dispatched(e.name)
+            slots.append((e, p))
+        return slots
+
+    def _deliver_fused(self, slots, run):
+        """Run the fused launch and deliver per-entry results; on
+        failure, error every handle and close timeline spans."""
+        try:
+            label = (f"[{len(slots)}]" if len(slots) > 1
+                     else f"::{slots[0][0].name}")
+            with jax.profiler.TraceAnnotation(f"hvd::fused{label}"):
+                outs = run()
+        except BaseException as ex:
+            for e, p in slots:
+                p.handle.set_error(ex)
+                if self.engine.timeline is not None:
+                    self.engine.timeline.done(e.name, error=True)
+            return
+        for (e, p), o in zip(slots, outs):
+            p.handle.set_result(o)
+
+    def _execute_broadcast_batch(self, entries):
+        """ONE fused launch for N same-root/dtype/pset broadcasts
+        (reference: FuseResponses packing broadcast responses)."""
+        slots = self._collect_fused(entries)
+        if not slots:
+            return
+        root = slots[0][1].root
+        pset = slots[0][1].pset
+        tensors = [p.tensor for _, p in slots]
+        self._deliver_fused(
+            slots, lambda: dispatch.broadcast_group(tensors, root, pset))
+
+    def _execute_allgather_batch(self, entries):
+        """ONE fused launch for N same-dtype/pset allgathers; per-rank
+        first-dim sizes come back aggregated on each agreed entry."""
+        slots = self._collect_fused(entries)
+        if not slots:
+            return
+        pset = slots[0][1].pset
+        tensors = [p.tensor for _, p in slots]
+
+        def run():
+            # metas are indexed by WORLD rank; project onto the set.
+            # Parsed inside the delivery guard so a malformed peer
+            # meta errors this batch's handles, not the worker loop.
+            rows = [[int(e.metas()[r]) for r in pset.ranks]
+                    for e, _ in slots]
+            return dispatch.allgather_group(tensors, pset, rows)
+
+        self._deliver_fused(slots, run)
 
     def _execute_allreduce_batch(self, entries):
         """One fused launch for the whole agreed batch (the fusion

@@ -311,6 +311,77 @@ def _allgather_kernel_hier(mesh, n: int, sizes: Tuple[int, ...],
 
 
 @functools.lru_cache(maxsize=None)
+def _allgather_group_kernel(mesh, n: int,
+                            rows_per_tensor: Tuple[Tuple[int, ...], ...],
+                            sig: Tuple):
+    """Fused allgather of a same-dtype group: flatten each (pre-padded)
+    tensor, concat into one buffer, ONE all_gather, then slice each
+    rank's real rows back out per tensor (the FuseResponses packing the
+    reference applies to allgather responses too — controller.cc packs
+    same-type allgathers into one fusion-buffer launch). `sig` carries
+    the padded (maxr, *rest) shapes; `rows_per_tensor[t][i]` is rank
+    i's true first-dim size for tensor t."""
+    shapes = [s for s, _ in sig]
+    flat_sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+
+    def body(*blocks):
+        flats = [b.reshape(-1) for b in blocks]
+        concat = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+        g = lax.all_gather(concat, "proc")            # (n, sum_flat)
+        outs = []
+        off = 0
+        for shape, fsz, rows in zip(shapes, flat_sizes,
+                                    rows_per_tensor):
+            block = g[:, off:off + fsz].reshape((n,) + shape)
+            pieces = [block[i, : rows[i]] for i in range(n)]
+            outs.append(jnp.concatenate(pieces, axis=0)[None])
+            off += fsz
+        return tuple(outs)
+
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=tuple(P("proc") for _ in sig),
+                       out_specs=tuple(P("proc") for _ in sig))
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _allgather_group_kernel_hier(mesh, n: int,
+                                 rows_per_tensor: Tuple[Tuple[int, ...],
+                                                        ...],
+                                 sig: Tuple):
+    """Hierarchical fused allgather group: gather the packed buffer
+    within the slice over ICI first, then exchange slice blocks over
+    DCN — same staging as _allgather_kernel_hier, same packing as
+    _allgather_group_kernel. Slice-aligned rank r = cross*L + local,
+    so local-then-cross reshape restores global rank order."""
+    shapes = [s for s, _ in sig]
+    flat_sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+
+    def body(*blocks):
+        flats = [b.reshape(-1) for b in blocks]
+        concat = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+        g_local = lax.all_gather(concat, "local")        # (L, B)
+        g = lax.all_gather(g_local, "cross")             # (n/L, L, B)
+        g = g.reshape(n, -1)
+        outs = []
+        off = 0
+        for shape, fsz, rows in zip(shapes, flat_sizes,
+                                    rows_per_tensor):
+            block = g[:, off:off + fsz].reshape((n,) + shape)
+            pieces = [block[i, : rows[i]] for i in range(n)]
+            outs.append(jnp.concatenate(pieces, axis=0)[None])
+            off += fsz
+        return tuple(outs)
+
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=tuple(P(("cross", "local"))
+                                      for _ in sig),
+                       out_specs=tuple(P(("cross", "local"))
+                                       for _ in sig))
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
 def _broadcast_kernel(mesh, n: int, root: int, sig: Tuple):
     def body(block):
         idx = lax.axis_index("proc")
@@ -582,6 +653,49 @@ def allgather(tensor: jax.Array, pset: ProcessSet,
         gin = to_global(x, pset)
     out = local_shard(kern(gin))
     return out.astype(jnp.bool_) if was_bool else out
+
+
+def allgather_group(tensors: List[jax.Array], pset: ProcessSet,
+                    rows_matrix: Sequence[Sequence[int]]
+                    ) -> List[jax.Array]:
+    """Fused allgather of a same-dtype group in ONE collective launch.
+    `rows_matrix[t][i]` is rank i's first-dim size for tensor t (from
+    the negotiation metadata). Tensors may have different trailing
+    shapes; bools ride as uint8."""
+    n = pset.size
+    xs = [_as_local(t) for t in tensors]
+    xs = [x[None] if x.ndim == 0 else x for x in xs]
+    bools = [x.dtype == jnp.bool_ for x in xs]
+    xs = [x.astype(jnp.uint8) if b else x for x, b in zip(xs, bools)]
+    if n == 1:
+        return [o.astype(jnp.bool_) if b else o
+                for o, b in zip(xs, bools)]
+    padded = []
+    rows = []
+    for x, rvec in zip(xs, rows_matrix):
+        rvec = tuple(int(r) for r in rvec)
+        rows.append(rvec)
+        maxr = max(rvec)
+        if x.shape[0] < maxr:
+            pad = [(0, maxr - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
+            x = jnp.pad(x, pad)
+        padded.append(x)
+    mesh2 = _hier_mesh(pset)
+    if mesh2 is not None:
+        # Keep the ICI-then-DCN staging under HOROVOD_HIERARCHICAL_*
+        # for fused gathers too.
+        kern = _allgather_group_kernel_hier(mesh2, n, tuple(rows),
+                                            _sig(padded))
+        spec = P(("cross", "local"))
+        gouts = kern(*[to_global(x, pset, mesh=mesh2, spec=spec)
+                       for x in padded])
+    else:
+        kern = _allgather_group_kernel(pset.mesh, n, tuple(rows),
+                                       _sig(padded))
+        gouts = kern(*[to_global(x, pset) for x in padded])
+    outs = [local_shard(g) for g in gouts]
+    return [o.astype(jnp.bool_) if b else o
+            for o, b in zip(outs, bools)]
 
 
 def broadcast(tensor: jax.Array, root: int, pset: ProcessSet) -> jax.Array:

@@ -42,7 +42,31 @@ def broadcast_parameters(params: Any, root_rank: int = 0,
     if not leaves:
         return params
     set_root = pset.ranks.index(root_rank)
-    out = _grouped_leaf_broadcast(leaves, set_root, pset)
+    if st.engine.controller is not None and \
+            pset.size == st.topology.size:
+        # Submit every leaf through the negotiated path: the
+        # coordinator fuses same-dtype broadcasts (fuse key
+        # bc|dtype|root|pset) into single launches, and dispatch stays
+        # on the single worker thread (the background-thread ownership
+        # model) instead of racing it from this caller thread.
+        # Leaves go out SORTED by dtype: the fusion planner only packs
+        # consecutive same-key entries, so an interleaved fp32/int32
+        # tree would otherwise break into one batch per leaf.
+        # Subset process sets keep the direct data-plane path — the
+        # negotiation is world-scoped, and waiting on non-member
+        # ranks that never submit would hang.
+        base = st.engine.auto_name("broadcast_parameters")
+        order = sorted(range(len(leaves)),
+                       key=lambda i: str(jnp.asarray(leaves[i]).dtype))
+        handles = {i: C.broadcast_async(leaves[i], root_rank,
+                                        name=f"{base}.{i}",
+                                        process_set=pset)
+                   for i in order}
+        out = [None] * len(leaves)
+        for i in order:
+            out[i] = C.synchronize(handles[i])
+    else:
+        out = _grouped_leaf_broadcast(leaves, set_root, pset)
     return jax.tree_util.tree_unflatten(treedef, out)
 
 

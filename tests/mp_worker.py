@@ -22,7 +22,9 @@ import horovod_tpu as hvd  # noqa: E402
 
 
 def main():
-    hvd.init()
+    nsz = int(os.environ.get("HOROVOD_SIZE", "1"))
+    half = hvd.ProcessSet(list(range(max(nsz // 2, 1))))
+    hvd.init(process_sets=[half])
     r, n = hvd.rank(), hvd.size()
     assert n == int(os.environ["HOROVOD_SIZE"]), (n, os.environ)
     print(f"worker rank={r} size={n} devices={jax.device_count()}")
@@ -136,6 +138,16 @@ def main():
     out = hvd.broadcast(jnp.asarray([True, False]), root_rank=0,
                         name="mx.bool.bc")
     assert bool(out[0]) and not bool(out[1])
+
+    # SUBSET process-set eager ops dispatch inline (the negotiation is
+    # world-scoped; waiting on non-members would hang) — must complete
+    # with member-only semantics while the world controller is live.
+    if r in half.ranks:
+        out = hvd.allreduce(jnp.full((3,), float(r + 1)), op=hvd.Sum,
+                            name="subset_ar", process_set=half)
+        np.testing.assert_allclose(
+            np.asarray(out),
+            np.full(3, float(sum(i + 1 for i in half.ranks))))
 
     # barrier + broadcast_parameters + optimizer functions
     hvd.barrier()

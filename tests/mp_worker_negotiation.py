@@ -142,6 +142,50 @@ def main():
                    str(e.get("args", {})) for e in events)
         print("rank 0: timeline phases OK")
 
+    # 3.7) generic-op fusion: same-dtype/root broadcasts agreed
+    # together execute as FUSED batches, one XLA launch each — not one
+    # cycle per tensor (reference: controller.cc FuseResponses packs
+    # non-allreduce responses too). exec_counts tracks
+    # [batches, entries] per kind on the dispatch worker.
+    ctl = st.engine.controller
+    bc0 = list(ctl.exec_counts.get("bc", [0, 0]))
+    hs = [hvd.broadcast_async(
+            jnp.full((4,), float(i) if r == 0 else -1.0),
+            root_rank=0, name=f"bc_fuse_{i}") for i in range(8)]
+    for i, h in enumerate(hs):
+        np.testing.assert_allclose(np.asarray(hvd.synchronize(h)),
+                                   np.full(4, float(i)))
+    bc1 = ctl.exec_counts["bc"]
+    bc_batches = bc1[0] - bc0[0]
+    bc_entries = bc1[1] - bc0[1]
+    assert bc_entries == 8, (bc0, bc1)
+    assert bc_batches < bc_entries, (
+        f"broadcasts never fused: {bc_batches} batches for "
+        f"{bc_entries} entries")
+    print(f"rank {r}: broadcast fusion OK "
+          f"({bc_entries} entries in {bc_batches} batch(es))")
+
+    # 3.8) fused UNEVEN allgathers: per-rank sizes ride the request
+    # meta; same-dtype gathers agreed together land in one launch.
+    ag0 = list(ctl.exec_counts.get("ag", [0, 0]))
+    hs = [hvd.allgather_async(jnp.full((r + 1, 2), float(10 * i + r)),
+                              name=f"ag_fuse_{i}") for i in range(6)]
+    for i, h in enumerate(hs):
+        expect = np.concatenate(
+            [np.full((rr + 1, 2), float(10 * i + rr))
+             for rr in range(n)])
+        np.testing.assert_allclose(np.asarray(hvd.synchronize(h)),
+                                   expect)
+    ag1 = ctl.exec_counts["ag"]
+    ag_batches = ag1[0] - ag0[0]
+    ag_entries = ag1[1] - ag0[1]
+    assert ag_entries == 6, (ag0, ag1)
+    assert ag_batches < ag_entries, (
+        f"allgathers never fused: {ag_batches} batches for "
+        f"{ag_entries} entries")
+    print(f"rank {r}: allgather fusion OK "
+          f"({ag_entries} entries in {ag_batches} batch(es))")
+
     # 4) join: rank 1 joins immediately; rank 0 keeps reducing, then
     # proves a generic op agreed while a rank has joined gets a CLEAN
     # error (reference: join unsupported for non-allreduce ops) —

@@ -313,6 +313,41 @@ def test_hier_mesh_alignment_rules():
     assert not aligned([0, 1, 2, 3], 0)   # disabled
 
 
+def test_allgather_group_kernel_flat_and_hier(eight_device_mesh):
+    """The fused allgather group (one launch for N uneven gathers)
+    must reproduce each per-tensor gather, on both the flat 'proc'
+    mesh and the hierarchical ('cross','local') staging."""
+    mesh2 = make_hier_mesh()
+    rows_a = (1, 4, 2, 3, 1, 2, 5, 2)
+    rows_b = (2,) * N
+    rng = np.random.RandomState(7)
+    maxa, maxb = max(rows_a), max(rows_b)
+    a = rng.randn(N, maxa, 3).astype(np.float32)
+    b = rng.randn(N, maxb).astype(np.float32)
+    want_a = np.concatenate([a[i, : rows_a[i]] for i in range(N)])
+    want_b = np.concatenate([b[i, : rows_b[i]] for i in range(N)])
+    sig = dispatch._sig([jnp.asarray(a[0]), jnp.asarray(b[0])])
+
+    kern = dispatch._allgather_group_kernel(
+        eight_device_mesh, N, (rows_a, rows_b), sig)
+    out_a, out_b = kern(make_global(eight_device_mesh, a),
+                        make_global(eight_device_mesh, b))
+    for got in rows_of(out_a):
+        np.testing.assert_allclose(got, want_a)
+    for got in rows_of(out_b):
+        np.testing.assert_allclose(got, want_b)
+
+    hier = dispatch._allgather_group_kernel_hier(
+        mesh2, N, (rows_a, rows_b), sig)
+    spec = NamedSharding(mesh2, P(("cross", "local")))
+    out_a, out_b = hier(jax.device_put(jnp.asarray(a), spec),
+                        jax.device_put(jnp.asarray(b), spec))
+    for got in rows_of(out_a):
+        np.testing.assert_allclose(got, want_a)
+    for got in rows_of(out_b):
+        np.testing.assert_allclose(got, want_b)
+
+
 @pytest.mark.parametrize("rows", [(3, 3, 3, 3, 3, 3, 3, 3),
                                   (1, 4, 2, 3, 1, 2, 5, 2)])
 def test_hierarchical_allgather_matches_flat(eight_device_mesh, rows):

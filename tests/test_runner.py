@@ -173,10 +173,13 @@ def run_launcher(np_, script, extra_env=None, timeout=240):
 
 @pytest.mark.integration
 class TestRealLaunch:
-    def test_two_process_collectives(self):
-        r = run_launcher(2, os.path.join("tests", "mp_worker.py"))
+    @pytest.mark.parametrize("np_", [2, 4])
+    def test_two_process_collectives(self, np_):
+        # np=4 additionally exercises a live 2-member SUBSET process
+        # set (inline dispatch path) alongside the world controller.
+        r = run_launcher(np_, os.path.join("tests", "mp_worker.py"))
         assert r.returncode == 0, r.stdout + r.stderr
-        assert r.stdout.count("ALL OK") == 2
+        assert r.stdout.count("ALL OK") == np_
 
     def test_failing_rank_propagates(self, tmp_path):
         bad = tmp_path / "bad.py"
