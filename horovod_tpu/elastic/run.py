@@ -30,11 +30,39 @@ def _reinitialize() -> None:
     The elastic driver re-publishes rank/size env via the rendezvous
     before workers reach this point (reference: the updated-rendezvous
     re-poll in horovod/runner/elastic/rendezvous.py).
+
+    Re-init runs under a BOUNDED timeout and retries with a fresh
+    assignment poll: under membership churn (resize B published while
+    workers are still re-initializing for resize A) different workers
+    can transiently hold assignments from DIFFERENT epochs and wait at
+    different coordinators — unbounded waits would deadlock the gang
+    until the coordination service's own (minutes-long, fatal) barrier
+    timeout. A short timeout + re-poll converges every worker onto the
+    newest epoch instead (HOROVOD_ELASTIC_INIT_TIMEOUT, default 120s
+    per attempt; overall bound HOROVOD_ELASTIC_TIMEOUT, default 600s).
     """
     basics.shutdown()
     from .worker import refresh_env_from_rendezvous
-    refresh_env_from_rendezvous()
-    basics.init()
+    attempt_timeout = os.environ.get("HOROVOD_ELASTIC_INIT_TIMEOUT",
+                                     "120")
+    deadline = time.time() + float(
+        os.environ.get("HOROVOD_ELASTIC_TIMEOUT", "600"))
+    while True:
+        try:
+            refresh_env_from_rendezvous()
+            os.environ["HOROVOD_START_TIMEOUT"] = attempt_timeout
+            basics.init()
+            return
+        except SystemExit:
+            raise  # removed by resize: clean exit, not a retry
+        except Exception as e:
+            basics.shutdown()
+            if time.time() > deadline:
+                raise
+            hlog.warning(
+                "elastic: re-init attempt failed (%s); re-polling the "
+                "rendezvous for a fresh assignment", e)
+            time.sleep(1.0)
 
 
 def run(func: Callable) -> Callable:

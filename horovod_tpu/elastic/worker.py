@@ -11,6 +11,8 @@ from __future__ import annotations
 import json
 import os
 import socket
+import time
+import urllib.error
 import urllib.request
 from typing import Optional
 
@@ -96,19 +98,39 @@ def register_with_rendezvous() -> None:
 
 def refresh_env_from_rendezvous() -> None:
     """Re-read rank/size/coordinator assignment from the rendezvous
-    KV server after a membership change. No-op outside elastic runs."""
+    KV server after a membership change. No-op outside elastic runs.
+
+    A persistent 404 means this slot is NOT part of the new world —
+    the driver shrank the job (graceful scale-down) and is waiting for
+    this worker to drain. Exit cleanly (reference: a removed host's
+    workers simply end; the reference driver counts that as normal
+    host removal, not failure). The brief retry absorbs the
+    publish/poke race on a loaded machine."""
     addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "")
     if not addr:
         return
     me = os.environ.get("HOROVOD_HOSTNAME", socket.gethostname())
     lr = os.environ.get("HOROVOD_LOCAL_RANK", "0")
     path = f"/rank/{me}/{lr}"
-    req = urllib.request.Request(
-        f"http://{addr}{path}",
-        headers={_secret.HEADER: _secret.sign(
-            _secret.from_env(), path.encode())})
-    with urllib.request.urlopen(req, timeout=30) as resp:
-        assignment = json.loads(resp.read().decode())
+    deadline = time.time() + 10.0
+    while True:
+        req = urllib.request.Request(
+            f"http://{addr}{path}",
+            headers={_secret.HEADER: _secret.sign(
+                _secret.from_env(), path.encode())})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                assignment = json.loads(resp.read().decode())
+            break
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+            if time.time() > deadline:
+                hlog.info("elastic: no assignment for %s:%s in the "
+                          "new world — removed by resize; exiting",
+                          me, lr)
+                raise SystemExit(0)
+            time.sleep(0.5)
     for k, v in assignment.items():
         os.environ[k] = str(v)
     hlog.info("elastic: refreshed assignment: %s", assignment)

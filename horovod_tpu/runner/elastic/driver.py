@@ -82,6 +82,10 @@ class ElasticDriver:
         self._io_lock = threading.Lock()
         self.blacklist: Dict[str, float] = {}  # host -> until timestamp
         self.blacklist_window = 60.0
+        # Removed-slot drain: (host, local_rank) -> (_Slot, deadline).
+        self._draining: Dict[Tuple[str, int], Tuple[_Slot, float]] = {}
+        self.drain_grace = float(
+            os.environ.get("HOROVOD_ELASTIC_DRAIN_GRACE", "30"))
 
     # ------------------------------------------------------------------
 
@@ -189,22 +193,71 @@ class ElasticDriver:
         return infos, table
 
     def _reconcile(self, infos: List[RankInfo], table: Dict) -> None:
-        """Start missing slot processes; stop processes whose slot
-        disappeared."""
+        """Start missing slot processes; drain processes whose slot
+        disappeared.
+
+        Graceful scale-down (reference:
+        horovod/runner/elastic/driver.py host-removal path): a removed
+        worker must NOT be killed mid-collective — that turns a
+        graceful resize into a hard failure for the survivors (on TPU
+        the coordination service fatally terminates peers of a dead
+        process). Instead the slot moves to a drain list and keeps its
+        notification registration: the hosts-updated poke reaches it,
+        it finishes the in-flight step with the old world, raises
+        HostsUpdatedInterrupt at its commit boundary, finds no
+        assignment at the rendezvous, and exits cleanly on its own.
+        Termination is the fallback for workers that ignore the poke
+        past the drain grace."""
         wanted = {(i.host, i.local_rank): i for i in infos}
         # stop removed
         for key in list(self.slots):
             if key not in wanted:
                 slot = self.slots.pop(key)
                 if slot.proc.poll() is None:
-                    hlog.info("elastic: removing rank on %s:%d", *key)
-                    slot.proc.terminate()
-                self.rendezvous.drop_notify(key)
+                    hlog.info("elastic: draining removed rank on "
+                              "%s:%d", *key)
+                    self._draining[key] = (slot,
+                                           time.time() + self.drain_grace)
+                else:
+                    self.rendezvous.drop_notify(key)
         # start missing
         for key, info in wanted.items():
+            if key not in self.slots and key in self._draining:
+                # Slot re-added while its old worker is still draining
+                # (remove-then-re-add churn): spawning a second
+                # process would produce a duplicate rank claim. The
+                # draining worker is already re-polling the rendezvous
+                # (404-retry window) — the new assignment is published,
+                # so it finds it and rejoins. Keep it.
+                slot, _ = self._draining.pop(key)
+                if slot.proc.poll() is None:
+                    hlog.info("elastic: re-adding draining rank on "
+                              "%s:%d in place", *key)
+                    self.slots[key] = slot
+                else:
+                    self.rendezvous.drop_notify(key)
             cur = self.slots.get(key)
             if cur is None or cur.proc.poll() is not None:
                 self.slots[key] = self._spawn(info, dict(table[key]))
+
+    def _reap_draining(self) -> None:
+        """Collect voluntarily-exited drained workers; hard-kill any
+        that outstayed the grace window."""
+        for key in list(self._draining):
+            slot, deadline = self._draining[key]
+            if slot.proc.poll() is not None:
+                hlog.info("elastic: drained rank on %s:%d exited "
+                          "(rc=%d)", key[0], key[1],
+                          slot.proc.returncode)
+            elif time.time() > deadline:
+                hlog.warning("elastic: drained rank on %s:%d ignored "
+                             "the resize for %.0fs; terminating",
+                             key[0], key[1], self.drain_grace)
+                slot.proc.terminate()
+            else:
+                continue
+            del self._draining[key]
+            self.rendezvous.drop_notify(key)
 
     # ------------------------------------------------------------------
 
@@ -230,12 +283,17 @@ class ElasticDriver:
             for slot in self.slots.values():
                 if slot.proc.poll() is None:
                     slot.proc.kill()
+            for slot, _ in self._draining.values():
+                if slot.proc.poll() is None:
+                    slot.proc.kill()
             self.rendezvous.stop()
 
     def _monitor(self, current: Dict[str, int]) -> int:
         last_poll = 0.0
         while True:
             time.sleep(0.1)
+            if self._draining:
+                self._reap_draining()
 
             # 1) process exits
             exited = {k: s for k, s in self.slots.items()
@@ -348,6 +406,12 @@ class ElasticDriver:
         """Hard-failure recovery: kill the remaining gang and relaunch
         on the latest discovered hosts (see module docstring for why
         survivors cannot be kept on TPU)."""
+        # Draining workers belong to the old world being torn down.
+        for key in list(self._draining):
+            slot, _ = self._draining.pop(key)
+            if slot.proc.poll() is None:
+                slot.proc.terminate()
+            self.rendezvous.drop_notify(key)
         for key, slot in list(self.slots.items()):
             if slot.proc.poll() is None:
                 slot.proc.terminate()

@@ -129,6 +129,121 @@ class TestElastic:
               if ln.startswith("step") and "world 3" in ln]
         assert w2 and w3 and min(w3) >= max(w2) - 1, (max(w2), min(w3))
 
+    def test_graceful_scale_down(self, tmp_path):
+        """Start at 3 procs; mid-run the discovery file shrinks to 2.
+        The removed rank drains voluntarily (clean exit at its commit
+        boundary — no SIGTERM mid-collective), survivors resize
+        without a gang restart, and committed progress carries over
+        (reference: horovod/runner/elastic/driver.py host-removal
+        path treats remove symmetrically with add)."""
+        hosts_file = tmp_path / "hosts.txt"
+        hosts_file.write_text("localhost:3\n")
+        script = write_discovery(tmp_path, f"cat {hosts_file}")
+        env = make_env(tmp_path, steps=40, sleep=0.25)
+        env["HOROVOD_LOG_LEVEL"] = "info"
+        p = launch(script, env)
+        try:
+            deadline = time.time() + 240
+            while time.time() < deadline:
+                if any("world 3" in ln for ln in read_logs(tmp_path)):
+                    break
+                if p.poll() is not None:
+                    break
+                time.sleep(0.5)
+            hosts_file.write_text("localhost:2\n")
+            out, _ = p.communicate(timeout=420)
+        finally:
+            if p.poll() is None:
+                p.kill()
+                out = p.communicate()[0]
+        assert p.returncode == 0, out
+        lines = read_logs(tmp_path)
+        assert any("world 3" in ln for ln in lines), lines
+        assert any("world 2" in ln for ln in lines), lines
+        # graceful: drain, not failure — no gang restart anywhere
+        assert "worker failure" not in out, out
+        assert "draining removed rank" in out, out
+        # the drained worker exits voluntarily with rc=0
+        assert "exited (rc=0)" in out, out
+        # exactly the 2 surviving ranks finish the job
+        dones = [ln for ln in lines if "done" in ln]
+        assert len(dones) == 2, (dones, out)
+        assert all("world 2" in ln for ln in dones), dones
+        # progress continuity across the shrink: the new world resumes
+        # at (or one past) the old world's last committed step
+        w3 = [int(ln.split()[1]) for ln in lines
+              if ln.startswith("step") and "world 3" in ln]
+        w2 = [int(ln.split()[1]) for ln in lines
+              if ln.startswith("step") and "world 2" in ln]
+        assert w3 and w2 and min(w2) >= max(w3) - 1, (max(w3), min(w2))
+
+    def test_scale_down_then_up_churn(self, tmp_path):
+        """Membership churn: 3 -> 2 -> 3. The re-added slot joins the
+        running job (fresh process, synced by rank 0) and all three
+        ranks complete (reference: remove-then-re-add cycle over the
+        same HostsUpdatedInterrupt machinery)."""
+        hosts_file = tmp_path / "hosts.txt"
+        hosts_file.write_text("localhost:3\n")
+        script = write_discovery(tmp_path, f"cat {hosts_file}")
+        env = make_env(tmp_path, steps=60, sleep=0.25)
+        env["HOROVOD_LOG_LEVEL"] = "info"
+        p = launch(script, env)
+        try:
+            def wait_for(pred, timeout=240):
+                deadline = time.time() + timeout
+                while time.time() < deadline:
+                    if pred(read_logs(tmp_path)) or p.poll() is not None:
+                        return
+                    time.sleep(0.5)
+
+            wait_for(lambda ls: any("world 3" in ln for ln in ls))
+            hosts_file.write_text("localhost:2\n")
+            wait_for(lambda ls: any("world 2" in ln for ln in ls))
+            hosts_file.write_text("localhost:3\n")
+            out, _ = p.communicate(timeout=600)
+        finally:
+            if p.poll() is None:
+                p.kill()
+                out = p.communicate()[0]
+        assert p.returncode == 0, out
+        lines = read_logs(tmp_path)
+        assert any("world 2" in ln for ln in lines), lines
+        assert "worker failure" not in out, out
+        # the job ends back at world 3, with all three ranks finishing
+        dones = [ln for ln in lines if "done" in ln]
+        assert len(dones) == 3, (dones, out)
+        assert all("world 3" in ln for ln in dones), dones
+
+    def test_scale_down_below_min_np_is_ignored(self, tmp_path):
+        """Discovery shrinking under --min-num-proc must NOT resize
+        the job below the floor: the world stays at 3 and completes
+        (reference: ElasticDriver honors min_num_proc on the way
+        down, not just at startup)."""
+        hosts_file = tmp_path / "hosts.txt"
+        hosts_file.write_text("localhost:3\n")
+        script = write_discovery(tmp_path, f"cat {hosts_file}")
+        env = make_env(tmp_path, steps=25, sleep=0.25)
+        p = launch(script, env, extra=("--min-num-proc", "3"))
+        try:
+            deadline = time.time() + 240
+            while time.time() < deadline:
+                if any("world 3" in ln for ln in read_logs(tmp_path)):
+                    break
+                if p.poll() is not None:
+                    break
+                time.sleep(0.5)
+            hosts_file.write_text("localhost:2\n")
+            out, _ = p.communicate(timeout=420)
+        finally:
+            if p.poll() is None:
+                p.kill()
+                out = p.communicate()[0]
+        assert p.returncode == 0, out
+        lines = read_logs(tmp_path)
+        assert not any("world 2" in ln for ln in lines), lines
+        dones = [ln for ln in lines if "done" in ln]
+        assert len(dones) == 3, (dones, out)
+
     def test_worker_failure_gang_restart(self, tmp_path):
         """Rank suicide mid-run: the driver restarts the gang and
         training completes (snapshot-level recovery)."""
