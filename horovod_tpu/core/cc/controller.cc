@@ -84,6 +84,7 @@ Controller::Controller(const ControllerOptions& opts) : opts_(opts) {
         return;
       }
       worker_fds_.assign(opts_.size, -1);
+      worker_claimed_.assign(opts_.size, 0);
       threads_.emplace_back(&Controller::ServerAcceptLoop, this);
     } else {
       coord_fd_ = ConnectTo(opts_.coord_host, opts_.coord_port,
@@ -162,7 +163,15 @@ void Controller::Abort() {
   // connection.
   if (opts_.rank == 0 && !worker_fds_.empty()) {
     std::lock_guard<std::mutex> lk(send_mu_);
-    for (int fd : worker_fds_)
+    // Snapshot under coord_mu_ (same send->coord order as
+    // BroadcastEntries): handshake threads may be publishing fds
+    // concurrently with an abort.
+    std::vector<int> fds;
+    {
+      std::lock_guard<std::mutex> clk(coord_mu_);
+      fds = worker_fds_;
+    }
+    for (int fd : fds)
       if (fd >= 0) SendMsg(fd, MsgType::kShutdown, "");
   }
   {
@@ -171,8 +180,11 @@ void Controller::Abort() {
   }
   if (coord_fd_ >= 0) ::shutdown(coord_fd_, SHUT_RDWR);
   if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
-  for (int fd : worker_fds_)
-    if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+  if (!worker_fds_.empty()) {
+    std::lock_guard<std::mutex> clk(coord_mu_);
+    for (int fd : worker_fds_)
+      if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
+  }
 }
 
 void Controller::Shutdown() {
@@ -181,8 +193,16 @@ void Controller::Shutdown() {
   for (auto& t : threads_)
     if (t.joinable() && t.get_id() != self) t.join();
   {
-    std::lock_guard<std::mutex> lk(reader_threads_mu_);
-    for (auto& t : reader_threads_)
+    // Swap out under the lock, join OUTSIDE it: exiting reader /
+    // handshake threads take reader_threads_mu_ in their reap-marker
+    // scope, so joining while holding it would deadlock.
+    std::vector<std::thread> readers;
+    {
+      std::lock_guard<std::mutex> lk(reader_threads_mu_);
+      readers.swap(reader_threads_);
+      finished_thread_ids_.clear();
+    }
+    for (auto& t : readers)
       if (t.joinable() && t.get_id() != self) t.join();
   }
   if (coord_fd_ >= 0) ::close(coord_fd_);
@@ -535,77 +555,121 @@ void Controller::DeliverEntries(const std::vector<Entry>& entries) {
 // --------------------------------------------------------------------------
 
 void Controller::ServerAcceptLoop() {
-  int connected = 0;
-  while (!shutdown_.load() && connected < opts_.size - 1) {
+  // Each accepted connection's handshake runs on its own thread (the
+  // thread then becomes that rank's reader), so N workers connecting
+  // at once negotiate CONCURRENTLY — a slow or hostile peer can
+  // stall only its own 10s handshake window, never the whole storm
+  // (the reference inherits this property from gloo's rendezvous;
+  // this build earns it here). The in-flight count is bounded so a
+  // connection flood cannot spawn unbounded threads.
+  while (!shutdown_.load()) {
     int fd = ::accept(listen_fd_, nullptr, nullptr);
     if (fd < 0) break;
+    if (handshaking_.load() > opts_.size + 16) {
+      ::close(fd);  // flood guard: legitimate ranks retry
+      continue;
+    }
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-    // Mutual challenge-response rank rendezvous (see
-    // ControllerOptions.auth_secret). The whole handshake runs
-    // against an ABSOLUTE deadline (per-read timeouts would reset on
-    // every dripped byte) with a tight pre-auth frame cap, so a
-    // hostile peer can hold the serial accept loop for at most 10s
-    // and cannot force large allocations.
-    double deadline = NowSeconds() + 10.0;
-    std::string coord_nonce = MakeNonce();
-    Buf ch;
-    ch.PutStr(coord_nonce);
-    SendMsg(fd, MsgType::kChallenge, ch.data());
-    MsgType t;
-    std::string payload;
-    if (!RecvMsgDeadline(fd, &t, &payload, deadline, 4096) ||
-        t != MsgType::kHello) {
-      ::close(fd);
-      continue;
-    }
-    Reader rd(payload);
-    uint32_t rank = 0;
-    std::string worker_nonce, mac;
-    rd.GetU32(&rank);
-    rd.GetStr(&worker_nonce);
-    rd.GetStr(&mac);
-    if (rank == 0 || rank >= static_cast<uint32_t>(opts_.size)) {
-      ::close(fd);
-      continue;
-    }
-    if (!opts_.auth_secret.empty() &&
-        !ConstTimeEq(mac, WorkerMac(opts_.auth_secret, coord_nonce,
-                                    rank))) {
-      HVD_LOG(kWarning,
-              "rejected control-plane hello for rank %u: bad auth "
-              "MAC", rank);
-      ::close(fd);
-      continue;
-    }
-    {
-      // Claim-once check and assignment under ONE lock: a second
-      // accept path (e.g. future elastic re-accept) must not be able
-      // to interleave between check and store.
-      std::lock_guard<std::mutex> lk(coord_mu_);
-      if (worker_fds_[rank] != -1) {
-        HVD_LOG(kWarning, "duplicate hello for rank %u rejected", rank);
-        ::close(fd);
-        continue;
+    handshaking_.fetch_add(1);
+    std::lock_guard<std::mutex> lk(reader_threads_mu_);
+    // Reap threads that announced completion (failed handshakes,
+    // closed readers) so repeated connect attempts over a long job
+    // cannot accumulate unbounded exited-but-joinable threads.
+    if (!finished_thread_ids_.empty()) {
+      for (auto id : finished_thread_ids_) {
+        for (auto it = reader_threads_.begin();
+             it != reader_threads_.end(); ++it) {
+          if (it->get_id() == id) {
+            it->join();  // already exited: returns immediately
+            reader_threads_.erase(it);
+            break;
+          }
+        }
       }
-      worker_fds_[rank] = fd;
+      finished_thread_ids_.clear();
     }
-    // Prove we hold the secret too (the worker will not trust agreed
-    // batches from an unauthenticated coordinator).
-    Buf wl;
-    wl.PutStr(opts_.auth_secret.empty()
-                  ? std::string()
-                  : CoordMac(opts_.auth_secret, worker_nonce));
-    SendMsg(fd, MsgType::kWelcome, wl.data());
-    {
-      std::lock_guard<std::mutex> lk(reader_threads_mu_);
-      reader_threads_.emplace_back(&Controller::ReaderLoop, this,
-                                   static_cast<int>(rank), fd);
-    }
-    ++connected;
-    HVD_LOG(kDebug, "rank %u connected (%d/%d)", rank, connected,
-            opts_.size - 1);
+    reader_threads_.emplace_back(&Controller::HandshakeConn, this, fd);
   }
+}
+
+void Controller::HandshakeConn(int fd) {
+  // Mutual challenge-response rank rendezvous (see
+  // ControllerOptions.auth_secret). The whole handshake runs against
+  // an ABSOLUTE deadline (per-read timeouts would reset on every
+  // dripped byte) with a tight pre-auth frame cap, so a hostile peer
+  // cannot force large allocations.
+  struct Scope {
+    Controller* self;
+    ~Scope() {
+      self->handshaking_.fetch_sub(1);
+      // Mark this thread reapable by the accept loop (it holds
+      // reader_threads_mu_ only briefly; we are off the hot path).
+      std::lock_guard<std::mutex> lk(self->reader_threads_mu_);
+      self->finished_thread_ids_.push_back(
+          std::this_thread::get_id());
+    }
+  } scope{this};
+  double deadline = NowSeconds() + 10.0;
+  std::string coord_nonce = MakeNonce();
+  Buf ch;
+  ch.PutStr(coord_nonce);
+  SendMsg(fd, MsgType::kChallenge, ch.data());
+  MsgType t;
+  std::string payload;
+  if (!RecvMsgDeadline(fd, &t, &payload, deadline, 4096) ||
+      t != MsgType::kHello) {
+    ::close(fd);
+    return;
+  }
+  Reader rd(payload);
+  uint32_t rank = 0;
+  std::string worker_nonce, mac;
+  rd.GetU32(&rank);
+  rd.GetStr(&worker_nonce);
+  rd.GetStr(&mac);
+  if (rank == 0 || rank >= static_cast<uint32_t>(opts_.size)) {
+    ::close(fd);
+    return;
+  }
+  if (!opts_.auth_secret.empty() &&
+      !ConstTimeEq(mac, WorkerMac(opts_.auth_secret, coord_nonce,
+                                  rank))) {
+    HVD_LOG(kWarning,
+            "rejected control-plane hello for rank %u: bad auth "
+            "MAC", rank);
+    ::close(fd);
+    return;
+  }
+  {
+    // Claim-once check under ONE lock: concurrent handshakes for the
+    // same rank must not be able to interleave between check and
+    // store.
+    std::lock_guard<std::mutex> lk(coord_mu_);
+    if (worker_claimed_[rank]) {
+      HVD_LOG(kWarning, "duplicate hello for rank %u rejected", rank);
+      ::close(fd);
+      return;
+    }
+    worker_claimed_[rank] = 1;
+  }
+  // Prove we hold the secret too (the worker will not trust agreed
+  // batches from an unauthenticated coordinator). The Welcome goes
+  // out BEFORE the fd becomes visible to BroadcastEntries: the
+  // worker requires kWelcome as the first frame, and two threads
+  // writing one fd would interleave frames.
+  Buf wl;
+  wl.PutStr(opts_.auth_secret.empty()
+                ? std::string()
+                : CoordMac(opts_.auth_secret, worker_nonce));
+  SendMsg(fd, MsgType::kWelcome, wl.data());
+  {
+    std::lock_guard<std::mutex> lk(coord_mu_);
+    worker_fds_[rank] = fd;
+  }
+  HVD_LOG(kDebug, "rank %u connected", rank);
+  // This thread is now the rank's reader.
+  ReaderLoop(static_cast<int>(rank), fd);
 }
 
 void Controller::ReaderLoop(int rank, int fd) {

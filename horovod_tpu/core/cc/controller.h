@@ -129,6 +129,7 @@ class Controller {
   void BroadcastEntries(const std::vector<Entry>& entries);
   void DeliverEntries(const std::vector<Entry>& entries);
   void ServerAcceptLoop();
+  void HandshakeConn(int fd);
   void ReaderLoop(int rank, int fd);
   void WorkerReaderLoop();
   void CheckStalls(double now);
@@ -201,13 +202,19 @@ class Controller {
   int listen_fd_ = -1;
   int coord_fd_ = -1;                 // worker->coordinator connection
   std::vector<int> worker_fds_;       // coordinator: fd per rank (idx)
+  std::vector<char> worker_claimed_;  // rank slot claimed (pre-fd)
+  std::atomic<int> handshaking_{0};   // in-flight handshake threads
   std::mutex send_mu_;                // serialize writes to workers
 
   std::vector<std::thread> threads_;
   // Per-connection reader threads, spawned by the accept loop while
-  // Shutdown may run concurrently — guarded separately.
+  // Shutdown may run concurrently — guarded separately. Threads that
+  // finish (failed handshake, closed connection) enqueue their id in
+  // finished_thread_ids_; the accept loop joins and prunes them
+  // before spawning the next, bounding thread accumulation.
   std::mutex reader_threads_mu_;
   std::vector<std::thread> reader_threads_;
+  std::vector<std::thread::id> finished_thread_ids_;
 };
 
 }  // namespace hvdtpu

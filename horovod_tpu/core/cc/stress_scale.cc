@@ -1,0 +1,215 @@
+// Control-plane scale stress: N in-process Controllers (rank-0
+// coordinator + N-1 workers) over loopback TCP — the ceiling probe
+// the reference never needed to ship because it leaned on MPI/gloo's
+// tree broadcasts (reference: horovod/common/gloo/gloo_controller.cc);
+// this build's coordinator speaks point-to-point TCP and must earn
+// its scaling numbers explicitly.
+//
+// Measures:
+//   1. connect-storm time: all N-1 worker handshakes fired
+//      CONCURRENTLY (each worker ctor blocks on its mutual
+//      challenge-response), racing the coordinator's accept loop.
+//   2. steady-state agreement latency: per round, every rank submits
+//      the same T tensor names (response-cache steady state after
+//      round 0) and drains its agreed entries; the round's latency is
+//      the slowest rank's submit->last-entry time. Reports p50/p95
+//      over many rounds.
+//
+// Usage: stress_scale <workers> [rounds] [tensors_per_round]
+// Prints ONE JSON line:
+//   {"workers":N,"connect_s":...,"round_p50_ms":...,"round_p95_ms":
+//    ...,"rounds":R,"tensors":T}
+// Exits non-zero on any controller error or order divergence.
+
+#include <pthread.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <memory>
+#include <netinet/in.h>
+#include <string>
+#include <sys/socket.h>
+#include <thread>
+#include <vector>
+
+#include "controller.h"
+
+using hvdtpu::Controller;
+using hvdtpu::ControllerOptions;
+using hvdtpu::Entry;
+
+namespace {
+
+int free_port() {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+  socklen_t len = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  int port = ntohs(addr.sin_port);
+  close(fd);
+  return port;
+}
+
+double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Drain NextBatch until `want` non-sentinel entries arrive; append
+// names to *order (single-threaded per rank). Returns false on
+// shutdown/error.
+bool drain(Controller* c, int want, std::vector<std::string>* order) {
+  int got = 0;
+  std::vector<Entry> entries;
+  while (got < want) {
+    entries.clear();
+    if (!c->NextBatch(5.0, &entries)) return false;
+    for (const auto& e : entries) {
+      if (e.name == hvdtpu::kAllJoined) continue;
+      if (!e.error.empty()) {
+        fprintf(stderr, "entry error: %s: %s\n", e.name.c_str(),
+                e.error.c_str());
+        return false;
+      }
+      order->push_back(e.name);
+      ++got;
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const int n = argc > 1 ? atoi(argv[1]) : 32;
+  const int rounds = argc > 2 ? atoi(argv[2]) : 50;
+  const int tensors = argc > 3 ? atoi(argv[3]) : 8;
+  const std::string secret = "stress-scale-secret";
+  const int port = free_port();
+
+  auto mkopts = [&](int rank) {
+    ControllerOptions o;
+    o.rank = rank;
+    o.size = n;
+    o.coord_host = "127.0.0.1";
+    o.coord_port = port;
+    o.cycle_time_ms = 1.0;
+    o.stall_warn_s = 60.0;
+    o.connect_timeout_s = 60.0;
+    o.auth_secret = secret;
+    return o;
+  };
+
+  // --- phase 1: concurrent connect storm --------------------------------
+  const double t0 = now_s();
+  std::vector<std::unique_ptr<Controller>> ctl(n);
+  ctl[0] = std::make_unique<Controller>(mkopts(0));
+  {
+    std::vector<std::thread> ctors;
+    ctors.reserve(n - 1);
+    for (int r = 1; r < n; ++r)
+      ctors.emplace_back(
+          [&, r] { ctl[r] = std::make_unique<Controller>(mkopts(r)); });
+    for (auto& t : ctors) t.join();
+  }
+  for (int r = 0; r < n; ++r) {
+    if (!ctl[r]->ok()) {
+      fprintf(stderr, "rank %d failed: %s\n", r,
+              ctl[r]->last_error().c_str());
+      return 1;
+    }
+  }
+  // Round 0 proves every handshake completed end-to-end (the accept
+  // loop may still be mid-handshake when ctors return on the worker
+  // side is impossible — the ctor blocks on kWelcome — but agreement
+  // additionally proves the coordinator registered every fd).
+  {
+    std::vector<std::thread> th;
+    std::atomic<bool> fail{false};
+    for (int r = 0; r < n; ++r)
+      th.emplace_back([&, r] {
+        for (int i = 0; i < tensors; ++i)
+          ctl[r]->Submit("t" + std::to_string(i), "f32|sum|#64", 256,
+                         "");
+        std::vector<std::string> order;
+        if (!drain(ctl[r].get(), tensors, &order)) fail = true;
+      });
+    for (auto& t : th) t.join();
+    if (fail) {
+      fprintf(stderr, "round 0 failed\n");
+      return 1;
+    }
+  }
+  const double connect_s = now_s() - t0;
+
+  // --- phase 2: steady-state agreement latency --------------------------
+  pthread_barrier_t barrier;
+  pthread_barrier_init(&barrier, nullptr, n);
+  std::vector<std::vector<double>> lat(n);
+  std::vector<std::vector<std::string>> orders(n);
+  std::atomic<bool> fail{false};
+  {
+    std::vector<std::thread> th;
+    for (int r = 0; r < n; ++r)
+      th.emplace_back([&, r] {
+        // A failed rank keeps hitting the barrier (skipping the
+        // work) so the other ranks' pthread_barrier_wait never
+        // deadlocks — the binary exits non-zero instead of hanging.
+        for (int round = 0; round < rounds; ++round) {
+          pthread_barrier_wait(&barrier);
+          if (fail.load()) continue;
+          const double t = now_s();
+          for (int i = 0; i < tensors; ++i)
+            ctl[r]->Submit("t" + std::to_string(i), "f32|sum|#64",
+                           256, "");
+          if (!drain(ctl[r].get(), tensors, &orders[r])) {
+            fail = true;
+            continue;
+          }
+          lat[r].push_back(now_s() - t);
+        }
+      });
+    for (auto& t : th) t.join();
+  }
+  pthread_barrier_destroy(&barrier);
+  if (fail) {
+    fprintf(stderr, "timed rounds failed\n");
+    return 1;
+  }
+  // Agreed-order guarantee must hold at scale too.
+  for (int r = 1; r < n; ++r) {
+    if (orders[r] != orders[0]) {
+      fprintf(stderr, "ORDER DIVERGED at rank %d\n", r);
+      return 1;
+    }
+  }
+
+  // Round latency = slowest rank that round (the gang moves at the
+  // pace of the last delivery).
+  std::vector<double> worst;
+  for (int round = 0; round < rounds; ++round) {
+    double w = 0;
+    for (int r = 0; r < n; ++r) w = std::max(w, lat[r][round]);
+    worst.push_back(w * 1e3);
+  }
+  std::sort(worst.begin(), worst.end());
+  const double p50 = worst[worst.size() / 2];
+  const double p95 = worst[(worst.size() * 95) / 100];
+
+  for (int r = 0; r < n; ++r) ctl[r]->Shutdown();
+
+  printf(
+      "{\"workers\":%d,\"connect_s\":%.3f,\"round_p50_ms\":%.2f,"
+      "\"round_p95_ms\":%.2f,\"rounds\":%d,\"tensors\":%d}\n",
+      n, connect_s, p50, p95, rounds, tensors);
+  return 0;
+}
