@@ -109,6 +109,301 @@ def aot_compile(step_fn, *args):
     return compiled, flops
 
 
+def _resolve_baseline(metric: str):
+    """Baseline for vs_baseline: BENCH_BASELINE_IMG_SEC env, else the
+    FIRST recorded round's value for `metric` in BENCH_r*.json beside
+    this script (cross-round progress on the same hardware)."""
+    baseline = float(os.environ.get("BENCH_BASELINE_IMG_SEC", "0")) or None
+    if baseline is not None:
+        return baseline
+    here = os.path.dirname(os.path.abspath(__file__))
+    for fname in sorted(os.listdir(here)):
+        if fname.startswith("BENCH_r") and fname.endswith(".json"):
+            try:
+                with open(os.path.join(here, fname)) as f:
+                    doc = json.load(f)
+                rec = doc.get("parsed") or {}
+                if rec.get("metric") == metric:
+                    baseline = float(rec["value"])
+                    log(f"bench: vs_baseline uses {fname} "
+                        f"({baseline:.1f})")
+                    return baseline
+            except (OSError, ValueError, KeyError, TypeError,
+                    AttributeError):
+                continue
+    return None
+
+
+def eager_main():
+    """Eager/negotiated-path benchmark: the reference's torch-hook
+    mechanism (reference: horovod/torch/optimizer.py
+    _DistributedOptimizer._make_hook — one allreduce_async_ per
+    parameter, named by the parameter, synchronize() before step)
+    driven through THIS framework's native C++ controller with the
+    response cache, tensor fusion, and fp16 compression all active.
+
+    Same ResNet-50 / synthetic-data contract as the jit bench so the
+    eager-vs-jit gap is directly comparable: gradient compute and the
+    optimizer update are jitted (the reference's backward/step are
+    compiled kernels too); ONLY the collective path is eager.
+
+    Two shapes (BENCH_EAGER_MODE / --eager-hooks):
+      grouped (default): hvd.DistributedOptimizer's eager path — ONE
+        grouped allreduce of the whole gradient pytree per step. The
+        negotiation unit is stable, so the fused kernel (compress +
+        concat + reduce + split + decompress in one XLA program)
+        compiles once and steady state costs ~3 launches/step.
+      hooks: the reference's per-parameter hook storm (one
+        allreduce_async per tensor, reverse layer order). Under XLA
+        this is the WORST case: every ragged cycle boundary yields a
+        new batch composition = a new compiled program. The recorded
+        gap vs grouped is the measured argument for why the TPU eager
+        API defaults to grouped submission (docs/benchmarks.md).
+    """
+    batch_per_chip = int(os.environ.get("BENCH_BATCH", "128"))
+    steps = int(os.environ.get("BENCH_STEPS", "60"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+    image = int(os.environ.get("BENCH_IMAGE", "224"))
+
+    # Force the full negotiation stack even at size 1 (auto mode would
+    # inline-dispatch): native core, response cache, fusion.
+    os.environ.setdefault("HOROVOD_CONTROLLER", "native")
+    # Cycle pacing matters far more under XLA than in the reference:
+    # a fused batch is a compiled program keyed on its composition, so
+    # ragged cycle boundaries = new compositions = recompiles every
+    # step. A cycle long enough to gather the whole backward pass
+    # yields ONE stable composition (161 tensors, ~50MB fp16 wire —
+    # under the 64MiB fusion threshold), compiled once. This is the
+    # knob the reference's ParameterManager tunes as cycle-time; the
+    # eager autotuner here reaches the same region.
+    hooks_default_cycle = ("--eager-hooks" in sys.argv or
+                           os.environ.get("BENCH_EAGER_MODE") == "hooks")
+    os.environ.setdefault(
+        "HOROVOD_CYCLE_TIME",
+        os.environ.get("BENCH_CYCLE_MS",
+                       "20" if hooks_default_cycle else "2"))
+    hvd.init()
+    from horovod_tpu.core import native as _native
+    from horovod_tpu.ops.compression import Compression
+    import horovod_tpu.ops.collective_ops as C
+    from horovod_tpu.common.basics import _state
+    ctl = _state.engine.controller
+    core_kind = type(ctl.core).__name__ if ctl is not None else "inline"
+    log(f"bench[eager]: controller core={core_kind} "
+        f"native_available={_native.available()} size={hvd.size()}")
+
+    model = create_resnet50(dtype=jnp.bfloat16)
+    variables = init_resnet(model, jax.random.PRNGKey(0), image)
+    params, batch_stats = variables["params"], variables["batch_stats"]
+
+    def loss_fn(params, batch_stats, images, labels):
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            images, train=True, mutable=["batch_stats"])
+        onehot = jax.nn.one_hot(labels, logits.shape[-1])
+        loss = jnp.mean(
+            -jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
+        return loss, updates["batch_stats"]
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
+
+    opt = optax.sgd(0.0125 * hvd.size(), momentum=0.9)
+    opt_state = opt.init(params)
+
+    flat0, treedef = jax.tree_util.tree_flatten_with_path(params)
+    # Stable per-parameter names (the response cache keys on them; the
+    # reference names hook allreduces after the parameter).
+    names = ["DistributedOptimizer.allreduce/" +
+             "/".join(str(getattr(k, "key", k)) for k in path)
+             for path, _ in flat0]
+    n_leaves = len(names)
+
+    @jax.jit
+    def apply_fn(params, opt_state, reduced_leaves):
+        grads = jax.tree_util.tree_unflatten(treedef, reduced_leaves)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(
+        rng.standard_normal((batch_per_chip, image, image, 3),
+                            dtype=np.float32))
+    labels = jnp.asarray(
+        rng.integers(0, 1000, batch_per_chip), jnp.int32)
+
+    hooks_mode = ("--eager-hooks" in sys.argv or
+                  os.environ.get("BENCH_EAGER_MODE", "") == "hooks")
+    log(f"bench[eager]: mode={'hooks' if hooks_mode else 'grouped'}")
+
+    def run_step(params, opt_state, batch_stats):
+        (loss, batch_stats), grads = grad_fn(
+            params, batch_stats, images, labels)
+        leaves = jax.tree_util.tree_flatten(grads)[0]
+        if hooks_mode:
+            # Reverse-layer-order storm, exactly like backward hooks.
+            handles = [None] * n_leaves
+            for i in range(n_leaves - 1, -1, -1):
+                handles[i] = C.allreduce_async(
+                    leaves[i], name=names[i],
+                    compression=Compression.fp16)
+            reduced = [C.synchronize(h) for h in handles]
+        else:
+            # hvd.DistributedOptimizer eager mechanism: one grouped
+            # submission of the whole gradient tree (stable fused
+            # composition, response-cache-friendly stable name).
+            reduced = C.grouped_allreduce(
+                leaves, name="DistributedOptimizer.grouped_allreduce",
+                compression=Compression.fp16)
+        params, opt_state = apply_fn(params, opt_state, reduced)
+        return params, opt_state, batch_stats, loss
+
+    t_c0 = time.perf_counter()
+    for _ in range(warmup):
+        params, opt_state, batch_stats, loss = run_step(
+            params, opt_state, batch_stats)
+    log(f"bench[eager]: warmup ({warmup} steps, compiles) "
+        f"{time.perf_counter() - t_c0:.1f}s loss={float(loss):.3f} "
+        f"leaves={n_leaves}")
+    cycles0 = ctl.core.cycles() if ctl is not None else 0
+    ctrl0 = ctl.core.control_bytes() if ctl is not None else 0
+
+    t0 = time.perf_counter()
+    tprev = t0
+    for i in range(steps):
+        params, opt_state, batch_stats, loss = run_step(
+            params, opt_state, batch_stats)
+        if os.environ.get("BENCH_STEP_TIMES"):
+            jax.block_until_ready(loss)
+            tnow = time.perf_counter()
+            log(f"bench[eager]: step {i} {tnow - tprev:.2f}s")
+            tprev = tnow
+    final_loss = float(loss)
+    dt = time.perf_counter() - t0
+
+    img_sec_chip = batch_per_chip * steps / dt
+    log(f"bench[eager]: {steps} steps in {dt:.2f}s -> "
+        f"{img_sec_chip:.1f} img/sec/chip loss={final_loss:.3f}")
+    if ctl is not None:
+        cyc = ctl.core.cycles() - cycles0
+        cb = ctl.core.control_bytes() - ctrl0
+        counts = dict(ctl.exec_counts)
+        log(f"bench[eager]: negotiation cycles={cyc} "
+            f"({cyc / max(steps, 1):.1f}/step) control_bytes={cb} "
+            f"({cb / max(steps, 1):.0f}/step) exec_counts={counts}")
+    jit_ref = _resolve_baseline(
+        "resnet50_synthetic_train_img_sec_per_chip")
+    if jit_ref:
+        log(f"bench[eager]: eager/jit gap: {img_sec_chip:.1f} vs "
+            f"{jit_ref:.1f} jit-path = {img_sec_chip / jit_ref:.3f}x")
+    vs = img_sec_chip / jit_ref if jit_ref else 1.0
+    print(json.dumps({
+        "metric": "resnet50_synthetic_eager_img_sec_per_chip",
+        "value": round(img_sec_chip, 2),
+        "unit": "img/sec/chip",
+        "vs_baseline": round(vs, 4),
+    }), flush=True)
+
+
+def transformer_main():
+    """Second headline: matmul-dominated flagship transformer
+    (BERT-Large dims: 24 x d1024 x h16, ff 4096, seq 512, bf16) on the
+    jitted DP path — tokens/sec/chip and MFU. Proves the framework
+    isn't the bottleneck behind the BN-bound ResNet number (reference:
+    docs/benchmarks.rst methodology; BASELINE.md config 3)."""
+    import dataclasses
+    from horovod_tpu.models import transformer as tfm
+
+    batch_per_chip = int(os.environ.get("BENCH_BATCH", "16"))
+    steps = int(os.environ.get("BENCH_STEPS", "60"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "5"))
+    seq = int(os.environ.get("BENCH_SEQ", "512"))
+
+    hvd.init()
+    mesh = data_parallel_mesh()
+    n_chips = mesh.devices.size
+    global_batch = batch_per_chip * n_chips
+    log(f"bench[transformer]: devices={n_chips} global_batch="
+        f"{global_batch} seq={seq}")
+
+    cfg = tfm.TransformerConfig(
+        vocab=32768, d_model=1024, n_layers=24, n_heads=16,
+        n_kv_heads=16, head_dim=64, d_ff=4096, max_seq=seq,
+        moe=False, dtype=jnp.bfloat16, remat=True,
+        tp_axis=None, sp_axis=None, ep_axis=None)
+    params = tfm.init_params(cfg, jax.random.PRNGKey(0))
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+    log(f"bench[transformer]: {n_params / 1e6:.1f}M params")
+
+    opt = optax.adamw(1e-4)
+    opt_state = opt.init(params)
+    step = build_train_step(
+        lambda p, b: tfm.loss_fn(cfg, p, b), opt, mesh,
+        batch_spec={"tokens": P("data"), "targets": P("data")},
+        donate=True)
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab, (global_batch, seq)), jnp.int32)
+    data_sh = NamedSharding(mesh, P("data"))
+    tokens = jax.device_put(tokens, data_sh)
+    batch = {"tokens": tokens,
+             "targets": jnp.roll(tokens, -1, axis=1)}
+
+    step_exec, flops_per_step = aot_compile(
+        step, params, opt_state, batch)
+
+    t_c0 = time.perf_counter()
+    for _ in range(warmup):
+        params, opt_state, metrics = step_exec(params, opt_state, batch)
+    log(f"bench[transformer]: warmup {warmup} steps "
+        f"{time.perf_counter() - t_c0:.1f}s "
+        f"loss={float(metrics['loss']):.3f}")
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, metrics = step_exec(params, opt_state, batch)
+    final_loss = float(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tok_sec_chip = global_batch * seq * steps / dt / n_chips
+    log(f"bench[transformer]: {steps} steps in {dt:.2f}s -> "
+        f"{tok_sec_chip:.0f} tokens/sec/chip loss={final_loss:.3f}")
+    peak = peak_tflops(jax.devices()[0])
+    # Analytic training FLOPs/token: XLA's cost_analysis counts a
+    # lax.scan body ONCE (and remat regions not at all), so the
+    # compiled number undercounts deep models by ~n_layers x. Matmul
+    # params: 2 FLOP/param fwd, 2x that in bwd, +1 fwd under remat;
+    # attention scores add 2*2*L*D per token per layer (causal ~halves
+    # it; keep the conservative full count).
+    n_mm = sum(int(np.prod(p.shape))
+               for path, p in
+               jax.tree_util.tree_flatten_with_path(params)[0]
+               if p.ndim >= 2)
+    fwd_per_tok = 2 * n_mm + 4 * cfg.n_layers * seq * cfg.d_model
+    mult = 3 + (1 if cfg.remat else 0)
+    analytic_per_tok = mult * fwd_per_tok
+    mfu = 0.0
+    if peak:
+        compiled_tok = (flops_per_step / (global_batch * seq)
+                        if flops_per_step else 0.0)
+        per_tok = max(compiled_tok, analytic_per_tok)
+        achieved = per_tok * tok_sec_chip / 1e12
+        mfu = achieved / peak
+        log(f"bench[transformer]: MFU {mfu * 100:.1f}% "
+            f"({achieved:.1f} of {peak:.0f} TFLOP/s/chip; "
+            f"{analytic_per_tok / 1e9:.2f} GFLOP/token analytic, "
+            f"{compiled_tok / 1e9:.2f} compiled)")
+    jit_ref = _resolve_baseline("flagship_transformer_tok_sec_per_chip")
+    vs = tok_sec_chip / jit_ref if jit_ref else 1.0
+    print(json.dumps({
+        "metric": "flagship_transformer_tok_sec_per_chip",
+        "value": round(tok_sec_chip, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs, 4),
+    }), flush=True)
+
+
 def main():
     batch_per_chip = int(os.environ.get("BENCH_BATCH", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "200"))
@@ -125,7 +420,17 @@ def main():
     log(f"bench: devices={n_chips} platform="
         f"{jax.devices()[0].platform} global_batch={global_batch}")
 
-    model = create_resnet50(dtype=jnp.bfloat16)
+    stages = os.environ.get("BENCH_RESNET_STAGES", "")
+    if stages:
+        # Reduced-depth variant for multi-process virtual-mesh runs
+        # (8 CPU procs compiling full ResNet-50 on shared cores takes
+        # tens of minutes; the mesh/collective accounting being
+        # validated is depth-independent).
+        from horovod_tpu.models.resnet import ResNet
+        model = ResNet(stage_sizes=[int(s) for s in stages.split(",")],
+                       dtype=jnp.bfloat16)
+    else:
+        model = create_resnet50(dtype=jnp.bfloat16)
     variables = init_resnet(model, jax.random.PRNGKey(0), image)
     params, batch_stats = variables["params"], variables["batch_stats"]
 
@@ -161,6 +466,24 @@ def main():
     step_exec, flops_per_step = aot_compile(
         step, params, opt_state,
         {"images": images, "labels": labels, "batch_stats": batch_stats})
+
+    if os.environ.get("BENCH_COLLECTIVE_STATS") and \
+            hasattr(step_exec, "as_text"):
+        # Per-step collective accounting from the compiled program:
+        # the DP step must contain cross-replica reduces moving (about)
+        # one gradient-sized payload (+ BN batch-stat pmeans / loss
+        # metrics). Recorded by the multi-process virtual-mesh artifact
+        # (benchmarks/MULTIPROC_bench_r03.json).
+        try:
+            hlo = step_exec.as_text()
+            n_ar = hlo.count(" all-reduce(") + hlo.count(" all-reduce-start(")
+            grad_bytes = int(sum(
+                np.prod(p.shape) * jnp.dtype(p.dtype).itemsize
+                for p in jax.tree_util.tree_leaves(params)))
+            log(f"bench: compiled collectives: {n_ar} all-reduce ops; "
+                f"gradient payload {grad_bytes / 1e6:.1f} MB/step")
+        except Exception as e:  # pragma: no cover - backend-dependent
+            log(f"bench: collective stats unavailable ({e})")
 
     def run_step(params, opt_state, batch_stats):
         batch = {"images": images, "labels": labels,
@@ -203,28 +526,12 @@ def main():
             f"{flops_per_step / global_batch / 1e9:.1f} GFLOP/img "
             f"compiled)")
 
-    baseline = float(os.environ.get("BENCH_BASELINE_IMG_SEC", "0")) or None
-    if baseline is None:
-        # BASELINE.json's `published` is empty (see BASELINE.md
-        # provenance note), so the most meaningful ratio is against
-        # the FIRST recorded round on this same hardware — cross-round
-        # progress rather than a vacuous 1.0.
-        here = os.path.dirname(os.path.abspath(__file__))
-        for fname in sorted(os.listdir(here)):
-            if fname.startswith("BENCH_r") and fname.endswith(".json"):
-                try:
-                    with open(os.path.join(here, fname)) as f:
-                        doc = json.load(f)
-                    rec = doc.get("parsed") or {}
-                    if rec.get("metric") == \
-                            "resnet50_synthetic_train_img_sec_per_chip":
-                        baseline = float(rec["value"])
-                        log(f"bench: vs_baseline uses {fname} "
-                            f"({baseline:.1f} img/sec/chip)")
-                        break
-                except (OSError, ValueError, KeyError, TypeError,
-                        AttributeError):
-                    continue
+    # BASELINE.json's `published` is empty (see BASELINE.md provenance
+    # note), so the most meaningful ratio is against the FIRST
+    # recorded round on this same hardware — cross-round progress
+    # rather than a vacuous 1.0.
+    baseline = _resolve_baseline(
+        "resnet50_synthetic_train_img_sec_per_chip")
     vs = img_sec_chip / baseline if baseline else 1.0
     print(json.dumps({
         "metric": "resnet50_synthetic_train_img_sec_per_chip",
@@ -235,4 +542,10 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--eager" in sys.argv:
+        eager_main()
+    elif "--model" in sys.argv and \
+            sys.argv[sys.argv.index("--model") + 1] == "transformer":
+        transformer_main()
+    else:
+        main()

@@ -54,6 +54,12 @@ class TransformerConfig:
     capacity_factor: float = 1.25
     rope_theta: float = 10000.0
     dtype: Any = jnp.bfloat16
+    # Rematerialize each layer in backward (jax.checkpoint on the scan
+    # body): activation memory drops from O(n_layers x per-layer
+    # temps) to O(n_layers x residual) + one layer's temps, trading
+    # ~33% more FLOPs — the standard TPU HBM/FLOPs trade for training
+    # large configs on a 16GB chip.
+    remat: bool = False
     # Live mesh axis names (None → that strategy is off). The model is
     # written once; trivial axes cost nothing.
     tp_axis: Optional[str] = TENSOR_AXIS
@@ -373,9 +379,15 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any],
     summed MoE aux loss. Operates on LOCAL param shards."""
     x = embed_lookup(cfg, params["embed"], tokens)
 
+    def one_layer(layer_p, x):
+        return _layer(cfg, layer_p, x)
+
+    if cfg.remat:
+        one_layer = jax.checkpoint(one_layer)
+
     def body(carry, layer_p):
         x, aux = carry
-        x, a = _layer(cfg, layer_p, x)
+        x, a = one_layer(layer_p, x)
         return (x, aux + a), None
 
     # aux init derived from x so its shard_map varying-axes type matches
@@ -387,9 +399,13 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any],
 
 
 def logits_fn(cfg: TransformerConfig, params, hidden) -> jax.Array:
-    """LM head, tied to the (vocab-sharded) embedding: (B, L, V_local)."""
-    return jnp.einsum("bld,vd->blv", hidden.astype(jnp.float32),
-                      params["embed"].astype(jnp.float32))
+    """LM head, tied to the (vocab-sharded) embedding: (B, L, V_local).
+    The matmul runs at the model's compute dtype (bf16 = MXU full
+    rate) with an f32 accumulator/output — the xent's LSE math needs
+    f32 logits, not an f32-rate matmul."""
+    return jnp.einsum("bld,vd->blv", hidden.astype(cfg.dtype),
+                      params["embed"].astype(cfg.dtype),
+                      preferred_element_type=jnp.float32)
 
 
 def loss_fn(cfg: TransformerConfig, params, batch) -> jax.Array:

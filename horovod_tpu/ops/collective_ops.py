@@ -124,16 +124,11 @@ def grouped_allreduce_async(tensors: List[jax.Array], average=None,
             st, name, wires, pset, rop, prescale_factor,
             postscale_factor, compression)
 
-    comp = [compression.compress(t) for t in tensors]
-    wire = [c[0] for c in comp]
-    ctxs = [c[1] for c in comp]
-
     def fn():
-        outs = _grouped_by_dtype(wire, pset, rop, prescale_factor,
-                                 postscale_factor)
-        return [compression.decompress(o, c) for o, c in zip(outs, ctxs)]
+        return _grouped_by_dtype(tensors, pset, rop, prescale_factor,
+                                 postscale_factor, compression)
 
-    h = st.engine.run(name, _nbytes(wire), fn)
+    h = st.engine.run(name, _wire_nbytes(tensors, compression), fn)
     return h.id
 
 
@@ -166,16 +161,31 @@ def _controller_mixed_group(st, name, wires, pset, rop, prescale,
     return umbrella.id
 
 
-def _grouped_by_dtype(tensors, pset, rop, prescale, postscale):
+def _wire_nbytes(tensors, compression) -> int:
+    from .compression import wire_dtype_of
+    return int(sum(
+        np.prod(t.shape) * wire_dtype_of(compression, t.dtype).itemsize
+        for t in tensors))
+
+
+def _grouped_by_dtype(tensors, pset, rop, prescale, postscale,
+                      compression=NoneCompressor):
     """Split a mixed-dtype group into same-dtype fused subgroups
-    (the reference controller only fuses same-dtype responses)."""
+    (the reference controller only fuses same-dtype responses).
+    Compression rides inside the fused dispatch kernel; Adasum's
+    recursive combine takes eagerly-compressed wires."""
     if rop == ADASUM:
-        return dispatch.group_by_dtype(
-            tensors, lambda g: adasum_allreduce(g, pset, prescale,
-                                                postscale))
+        def run_adasum(g):
+            pairs = [compression.compress(t) for t in g]
+            outs = adasum_allreduce([w for w, _ in pairs], pset,
+                                    prescale, postscale)
+            return [compression.decompress(o, ctx)
+                    for o, (_, ctx) in zip(outs, pairs)]
+        return dispatch.group_by_dtype(tensors, run_adasum)
     return dispatch.group_by_dtype(
-        tensors, lambda g: dispatch.allreduce_group(g, pset, rop,
-                                                    prescale, postscale))
+        tensors, lambda g: dispatch.allreduce_group(
+            g, pset, rop, prescale, postscale,
+            compressors=(compression,) * len(g)))
 
 
 def grouped_allreduce(tensors, average=None, name=None, op=None,
@@ -203,19 +213,17 @@ def allreduce_async(tensor, average=None, name=None, op=None,
         return ctl.submit_allreduce(
             name, [tensor], pset, rop, prescale_factor,
             postscale_factor, compression).id
-    wire, ctx = compression.compress(tensor)
-
     def fn():
         if rop == ADASUM:
+            wire, ctx = compression.compress(tensor)
             out = adasum_allreduce([wire], pset, prescale_factor,
                                    postscale_factor)[0]
-        else:
-            out = dispatch.allreduce_group([wire], pset, rop,
-                                           prescale_factor,
-                                           postscale_factor)[0]
-        return compression.decompress(out, ctx)
+            return compression.decompress(out, ctx)
+        return dispatch.allreduce_group(
+            [tensor], pset, rop, prescale_factor, postscale_factor,
+            compressors=(compression,))[0]
 
-    h = st.engine.run(name, _nbytes([wire]), fn)
+    h = st.engine.run(name, _wire_nbytes([tensor], compression), fn)
     return h.id
 
 
@@ -287,7 +295,8 @@ def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
     def fn():
         return dispatch.broadcast(t, set_root, pset)
 
-    return _run(st, name, _nbytes([t]), fn, pset=pset)
+    # _controller_for already returned None above; dispatch inline.
+    return st.engine.run(name, _nbytes([t]), fn).id
 
 
 def broadcast(tensor, root_rank: int, name=None,

@@ -59,6 +59,22 @@ class BF16Compressor(_CastCompressor):
     wire_dtype = jnp.bfloat16
 
 
+def wire_dtype_of(compression, dtype) -> jnp.dtype:
+    """The on-wire dtype a compressor produces for inputs of `dtype`,
+    WITHOUT materializing a cast. Used by the negotiation layer to
+    build fuse keys (same wire dtype == fusable) and by the dispatch
+    kernels, which run compress/decompress INSIDE the fused XLA
+    program — one launch per agreed batch instead of per-tensor cast
+    launches (the analog of the reference doing scale/cast as part of
+    MemcpyInFusionBuffer, horovod/common/ops/gpu_operations.cc batched
+    scale kernels)."""
+    dt = jnp.dtype(dtype)
+    wd = getattr(compression, "wire_dtype", None)
+    if wd is not None and jnp.issubdtype(dt, jnp.floating):
+        return jnp.dtype(wd)
+    return dt
+
+
 class Compression:
     """Namespace matching hvd.Compression."""
     none = NoneCompressor

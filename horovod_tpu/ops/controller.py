@@ -59,12 +59,15 @@ class JoinError(RuntimeError):
     pass
 
 
-def allreduce_sig(wire_tensors, rop: int, pset_id: int, prescale: float,
-                  postscale: float) -> str:
-    dt = str(wire_tensors[0].dtype)
+def allreduce_sig(wire_dtype, shapes_list, rop: int, pset_id: int,
+                  prescale: float, postscale: float) -> str:
+    """Fuse key + shape metadata. `wire_dtype` is the ON-WIRE dtype
+    (after compression) — computed WITHOUT casting; the cast itself
+    runs inside the fused dispatch kernel."""
     shapes = ";".join(
-        "x".join(str(d) for d in t.shape) for t in wire_tensors)
-    return f"ar|{dt}|{rop}|{pset_id}|{prescale}|{postscale}#{shapes}"
+        "x".join(str(d) for d in s) for s in shapes_list)
+    return (f"ar|{jnp.dtype(wire_dtype)}|{rop}|{pset_id}|{prescale}|"
+            f"{postscale}#{shapes}")
 
 
 def parse_allreduce_sig(sig: str):
@@ -77,13 +80,15 @@ def parse_allreduce_sig(sig: str):
 
 
 class _PendingAllreduce:
-    __slots__ = ("wire", "ctxs", "compression", "pset", "rop",
+    __slots__ = ("tensors", "compression", "pset", "rop",
                  "prescale", "postscale", "handle", "grouped")
 
-    def __init__(self, wire, ctxs, compression, pset, rop, prescale,
+    def __init__(self, tensors, compression, pset, rop, prescale,
                  postscale, handle, grouped):
-        self.wire = wire
-        self.ctxs = ctxs
+        # RAW tensors: the wire cast (compression) happens inside the
+        # fused dispatch kernel, not at submit time — zero extra XLA
+        # launches per tensor.
+        self.tensors = tensors
         self.compression = compression
         self.pset = pset
         self.rop = rop
@@ -309,13 +314,13 @@ class NegotiatedController:
                          rop: int, prescale: float, postscale: float,
                          compression, grouped: bool = False) -> Any:
         h = self.engine.new_handle(name)
-        comp = [compression.compress(jnp.asarray(t)) for t in tensors]
-        wire = [c[0] for c in comp]
-        ctxs = [c[1] for c in comp]
-        sig = allreduce_sig(wire, rop, pset.process_set_id, prescale,
-                            postscale)
-        nbytes = int(sum(np.prod(t.shape) * jnp.dtype(t.dtype).itemsize
-                         for t in wire))
+        from .compression import wire_dtype_of
+        tensors = [jnp.asarray(t) for t in tensors]
+        wire_dt = wire_dtype_of(compression, tensors[0].dtype)
+        sig = allreduce_sig(wire_dt, [t.shape for t in tensors], rop,
+                            pset.process_set_id, prescale, postscale)
+        nbytes = int(sum(np.prod(t.shape) for t in tensors)
+                     ) * wire_dt.itemsize
         with self._mu:
             if name in self._pending:
                 h.set_error(ValueError(
@@ -324,7 +329,7 @@ class NegotiatedController:
                     "the reference)"))
                 return h
             self._pending[name] = _PendingAllreduce(
-                wire, ctxs, compression, pset, rop, prescale,
+                tensors, compression, pset, rop, prescale,
                 postscale, h, grouped)
         if self.engine.timeline is not None:
             self.engine.timeline.negotiate_start(name)
@@ -617,21 +622,26 @@ class NegotiatedController:
         pset = self.engine.pset_table.get(pset_id)
         active = entries[0].active_ranks
 
+        from .compression import NoneCompressor
         tensors = []
+        compressors = []
         slots = []   # (entry, pending|None, count)
         for e in entries:
             with self._mu:
                 p = self._pending.pop(e.name, None)
             if p is None:
                 # joined rank: participate with zeros of the agreed
-                # shapes (reference: JoinOp zero contribution).
+                # shapes, ALREADY in wire dtype (reference: JoinOp
+                # zero contribution).
                 _, _, _, _, _, shapes = parse_allreduce_sig(e.sig)
                 zeros = [jnp.zeros(s, dt) for s in shapes]
                 tensors.extend(zeros)
+                compressors.extend([NoneCompressor] * len(zeros))
                 slots.append((e, None, len(zeros)))
             else:
-                tensors.extend(p.wire)
-                slots.append((e, p, len(p.wire)))
+                tensors.extend(p.tensors)
+                compressors.extend([p.compression] * len(p.tensors))
+                slots.append((e, p, len(p.tensors)))
                 if self.engine.timeline is not None:
                     self.engine.timeline.dispatched(e.name)
 
@@ -656,11 +666,21 @@ class NegotiatedController:
                      f"hvd::{entries[0].name}")
             with jax.profiler.TraceAnnotation(label):
                 if rop == ADASUM:
+                    # Adasum's recursive combine runs on wire tensors;
+                    # compress eagerly here (rare path), decompress
+                    # after.
                     from .adasum import adasum_allreduce
-                    outs = adasum_allreduce(tensors, pset, pre, post)
+                    pairs = [c.compress(t)
+                             for c, t in zip(compressors, tensors)]
+                    outs = adasum_allreduce([w for w, _ in pairs],
+                                            pset, pre, post)
+                    outs = [c.decompress(o, ctx)
+                            for c, o, (_, ctx) in
+                            zip(compressors, outs, pairs)]
                 else:
                     outs = dispatch.allreduce_group(
-                        tensors, pset, eff_op, pre, eff_post)
+                        tensors, pset, eff_op, pre, eff_post,
+                        compressors=compressors)
         except BaseException as ex:
             for e, p, cnt in slots:
                 if p is not None:
@@ -695,8 +715,9 @@ class NegotiatedController:
             i += cnt
             if p is None:
                 continue
-            res = [p.compression.decompress(o, c)
-                   for o, c in zip(outs_i, p.ctxs)]
+            # outs are already decompressed (the dispatch kernel folds
+            # the wire round-trip into the fused launch).
+            res = list(outs_i)
             p.handle.set_result(res if p.grouped else res[0])
             # success: Engine.synchronize closes the DISPATCH span
             # when the caller collects the handle.

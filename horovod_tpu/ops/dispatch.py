@@ -112,11 +112,18 @@ def group_by_dtype(arrs: Sequence[jax.Array], fn) -> List[jax.Array]:
 
 @functools.lru_cache(maxsize=None)
 def _allreduce_kernel(mesh, n: int, op: int, prescale: float,
-                      postscale: float, sig: Tuple):
+                      postscale: float, sig: Tuple,
+                      comps: Optional[Tuple] = None):
     """Fused allreduce over 'proc' for a group of tensors (group of one
     for plain allreduce). Flatten+concat per dtype happens inside the jit
     so XLA fuses the copies (the MemcpyInFusionBuffer analog,
-    reference: horovod/common/ops/collective_operations.cc)."""
+    reference: horovod/common/ops/collective_operations.cc).
+
+    `comps` (optional, one Compressor class per tensor): runs
+    compress before and decompress after the reduction INSIDE this
+    same program, so fp16/bf16 gradient compression costs zero extra
+    launches (the reference folds cast/scale into its fusion-buffer
+    memcpy kernels the same way)."""
     shapes = [s for s, _ in sig]
     sizes = [int(np.prod(s)) if s else 1 for s in shapes]
 
@@ -139,6 +146,11 @@ def _allreduce_kernel(mesh, n: int, op: int, prescale: float,
 
     def body(*blocks):
         # blocks: tuples of (1, *shape) per tensor.
+        ctxs = [None] * len(blocks)
+        if comps is not None:
+            pairs = [c.compress(b) for c, b in zip(comps, blocks)]
+            blocks = [w for w, _ in pairs]
+            ctxs = [ctx for _, ctx in pairs]
         flats = [b.reshape(-1) for b in blocks]
         concat = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
         if prescale != 1.0:
@@ -150,14 +162,35 @@ def _allreduce_kernel(mesh, n: int, op: int, prescale: float,
             red = red * jnp.asarray(postscale, red.dtype)
         outs = []
         off = 0
-        for s, sz in zip(shapes, sizes):
-            outs.append(red[off:off + sz].reshape((1,) + s))
+        for i, (s, sz) in enumerate(zip(shapes, sizes)):
+            o = red[off:off + sz].reshape((1,) + s)
+            if comps is not None:
+                o = comps[i].decompress(o, ctxs[i])
+            outs.append(o)
             off += sz
         return tuple(outs)
 
     fn = jax.shard_map(body, mesh=mesh,
                        in_specs=tuple(P("proc") for _ in sig),
                        out_specs=tuple(P("proc") for _ in sig))
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _compress_roundtrip_kernel(sig: Tuple, comps: Tuple, scale: float):
+    """Single-process fast path with compression active: the wire
+    round-trip (cast down, scale, cast back) for a whole group in ONE
+    jitted launch — numerics match the multi-process wire path."""
+
+    def fn(*xs):
+        outs = []
+        for x, comp in zip(xs, comps):
+            w, ctx = comp.compress(x)
+            if scale != 1.0:
+                w = w * jnp.asarray(scale, w.dtype)
+            outs.append(comp.decompress(w, ctx))
+        return tuple(outs)
+
     return jax.jit(fn)
 
 
@@ -222,11 +255,13 @@ def _hier_mesh(pset: ProcessSet):
 
 @functools.lru_cache(maxsize=None)
 def _allreduce_kernel_hier(mesh, n: int, op: int, prescale: float,
-                           postscale: float, sig: Tuple):
+                           postscale: float, sig: Tuple,
+                           comps: Optional[Tuple] = None):
     """Hierarchical fused allreduce over a ('cross', 'local') mesh:
     reduce-scatter(local) -> psum(cross) -> all-gather(local). Only
     sum-family ops decompose this way; min/max/product take the flat
-    kernel."""
+    kernel. `comps` folds compression into the program (see
+    _allreduce_kernel)."""
     shapes = [s for s, _ in sig]
     sizes = [int(np.prod(s)) if s else 1 for s in shapes]
     total = sum(sizes)
@@ -234,6 +269,11 @@ def _allreduce_kernel_hier(mesh, n: int, op: int, prescale: float,
     pad = (-total) % local_n
 
     def body(*blocks):
+        ctxs = [None] * len(blocks)
+        if comps is not None:
+            pairs = [c.compress(b) for c, b in zip(comps, blocks)]
+            blocks = [w for w, _ in pairs]
+            ctxs = [ctx for _, ctx in pairs]
         flats = [b.reshape(-1) for b in blocks]
         concat = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
         if prescale != 1.0:
@@ -257,8 +297,11 @@ def _allreduce_kernel_hier(mesh, n: int, op: int, prescale: float,
             red = red * jnp.asarray(postscale, red.dtype)
         outs = []
         off = 0
-        for s, sz in zip(shapes, sizes):
-            outs.append(red[off:off + sz].reshape((1,) + s))
+        for i, (s, sz) in enumerate(zip(shapes, sizes)):
+            o = red[off:off + sz].reshape((1,) + s)
+            if comps is not None:
+                o = comps[i].decompress(o, ctxs[i])
+            outs.append(o)
             off += sz
         return tuple(outs)
 
@@ -554,26 +597,43 @@ def _reducescatter_kernel(mesh, n: int, op: int, prescale: float,
 # ---------------------------------------------------------------------------
 
 def allreduce_group(tensors: List[jax.Array], pset: ProcessSet, op: int,
-                    prescale: float = 1.0, postscale: float = 1.0
+                    prescale: float = 1.0, postscale: float = 1.0,
+                    compressors: Optional[Sequence] = None
                     ) -> List[jax.Array]:
-    """Fused allreduce of a same-dtype group (group of 1 = plain)."""
+    """Fused allreduce of a group sharing one WIRE dtype (group of 1 =
+    plain). `compressors` (one Compressor class per tensor) folds the
+    fp16/bf16 wire cast into the same single XLA launch — no
+    per-tensor compress/decompress programs."""
     tensors = [_as_local(t) for t in tensors]
+    if compressors is not None:
+        from .compression import NoneCompressor
+        if all(c is NoneCompressor for c in compressors):
+            compressors = None
+        else:
+            compressors = tuple(compressors)
     n = pset.size
     if n == 1:
         scale = prescale * postscale
-        return [t * jnp.asarray(scale, t.dtype) if scale != 1.0 else t
-                for t in tensors]
+        if op == AVERAGE:
+            scale /= n  # n == 1: no-op, kept for clarity
+        if compressors is None:
+            return [t * jnp.asarray(scale, t.dtype) if scale != 1.0
+                    else t for t in tensors]
+        kern = _compress_roundtrip_kernel(_sig(tensors), compressors,
+                                          float(scale))
+        return list(kern(*tensors))
     sig = _sig(tensors)
     mesh2 = _hier_mesh(pset) if op in (SUM, AVERAGE, ADASUM) else None
     if mesh2 is not None:
         kern = _allreduce_kernel_hier(mesh2, n, op, float(prescale),
-                                      float(postscale), sig)
+                                      float(postscale), sig,
+                                      compressors)
         spec = P(("cross", "local"))
         gins = [to_global(t, pset, mesh=mesh2, spec=spec)
                 for t in tensors]
     else:
         kern = _allreduce_kernel(pset.mesh, n, op, float(prescale),
-                                 float(postscale), sig)
+                                 float(postscale), sig, compressors)
         gins = [to_global(t, pset) for t in tensors]
     gouts = kern(*gins)
     return [local_shard(g) for g in gouts]

@@ -42,8 +42,8 @@ def broadcast_parameters(params: Any, root_rank: int = 0,
     if not leaves:
         return params
     set_root = pset.ranks.index(root_rank)
-    if st.engine.controller is not None and \
-            pset.size == st.topology.size:
+    from ..ops.collective_ops import _controller_for
+    if _controller_for(st, pset) is not None:
         # Submit every leaf through the negotiated path: the
         # coordinator fuses same-dtype broadcasts (fuse key
         # bc|dtype|root|pset) into single launches, and dispatch stays
