@@ -110,12 +110,15 @@ def aot_compile(step_fn, *args):
 
 
 def _resolve_baseline(metric: str):
-    """Baseline for vs_baseline: BENCH_BASELINE_IMG_SEC env, else the
-    FIRST recorded round's value for `metric` in BENCH_r*.json beside
-    this script (cross-round progress on the same hardware)."""
-    baseline = float(os.environ.get("BENCH_BASELINE_IMG_SEC", "0")) or None
-    if baseline is not None:
-        return baseline
+    """Baseline for vs_baseline: BENCH_BASELINE_IMG_SEC env (img/sec
+    metrics only — a tokens/sec metric must not divide by it), else
+    the FIRST recorded round's value for `metric` in BENCH_r*.json
+    beside this script (cross-round progress on the same hardware)."""
+    if "img_sec" in metric:
+        baseline = float(
+            os.environ.get("BENCH_BASELINE_IMG_SEC", "0")) or None
+        if baseline is not None:
+            return baseline
     here = os.path.dirname(os.path.abspath(__file__))
     for fname in sorted(os.listdir(here)):
         if fname.startswith("BENCH_r") and fname.endswith(".json"):
@@ -176,12 +179,16 @@ def eager_main():
     # under the 64MiB fusion threshold), compiled once. This is the
     # knob the reference's ParameterManager tunes as cycle-time; the
     # eager autotuner here reaches the same region.
-    hooks_default_cycle = ("--eager-hooks" in sys.argv or
-                           os.environ.get("BENCH_EAGER_MODE") == "hooks")
+    hooks_mode = ("--eager-hooks" in sys.argv or
+                  os.environ.get("BENCH_EAGER_MODE", "") == "hooks")
     os.environ.setdefault(
-        "HOROVOD_CYCLE_TIME",
-        os.environ.get("BENCH_CYCLE_MS",
-                       "20" if hooks_default_cycle else "2"))
+        "HOROVOD_CYCLE_TIME", os.environ.get("BENCH_CYCLE_MS", "2"))
+    if hooks_mode:
+        # Quiescence batching: hold the cut until the per-parameter
+        # storm stops growing, so the fused batch has ONE stable
+        # composition (= one compiled program) instead of a ragged,
+        # recompiling-every-step composition.
+        os.environ.setdefault("HOROVOD_BATCH_QUIESCENCE", "5")
     hvd.init()
     from horovod_tpu.core import native as _native
     from horovod_tpu.ops.compression import Compression
@@ -231,14 +238,16 @@ def eager_main():
     labels = jnp.asarray(
         rng.integers(0, 1000, batch_per_chip), jnp.int32)
 
-    hooks_mode = ("--eager-hooks" in sys.argv or
-                  os.environ.get("BENCH_EAGER_MODE", "") == "hooks")
     log(f"bench[eager]: mode={'hooks' if hooks_mode else 'grouped'}")
 
+    phase_times = os.environ.get("BENCH_PHASE_TIMES")
+
     def run_step(params, opt_state, batch_stats):
+        t0 = time.perf_counter()
         (loss, batch_stats), grads = grad_fn(
             params, batch_stats, images, labels)
         leaves = jax.tree_util.tree_flatten(grads)[0]
+        t1 = time.perf_counter()
         if hooks_mode:
             # Reverse-layer-order storm, exactly like backward hooks.
             handles = [None] * n_leaves
@@ -246,7 +255,12 @@ def eager_main():
                 handles[i] = C.allreduce_async(
                     leaves[i], name=names[i],
                     compression=Compression.fp16)
+            t2 = time.perf_counter()
             reduced = [C.synchronize(h) for h in handles]
+            if phase_times:
+                t3 = time.perf_counter()
+                log(f"bench[eager]: phases grad={t1-t0:.3f} "
+                    f"submit={t2-t1:.3f} sync={t3-t2:.3f}")
         else:
             # hvd.DistributedOptimizer eager mechanism: one grouped
             # submission of the whole gradient tree (stable fused
@@ -310,7 +324,6 @@ def transformer_main():
     jitted DP path — tokens/sec/chip and MFU. Proves the framework
     isn't the bottleneck behind the BN-bound ResNet number (reference:
     docs/benchmarks.rst methodology; BASELINE.md config 3)."""
-    import dataclasses
     from horovod_tpu.models import transformer as tfm
 
     batch_per_chip = int(os.environ.get("BENCH_BATCH", "16"))
@@ -545,7 +558,8 @@ if __name__ == "__main__":
     if "--eager" in sys.argv:
         eager_main()
     elif "--model" in sys.argv and \
-            sys.argv[sys.argv.index("--model") + 1] == "transformer":
+            sys.argv[sys.argv.index("--model") + 1:
+                     sys.argv.index("--model") + 2] == ["transformer"]:
         transformer_main()
     else:
         main()

@@ -40,6 +40,16 @@ KNOBS: List[Knob] = [
     Knob("HOROVOD_CYCLE_TIME", float, 1.0,
          "Background engine cycle time in milliseconds: how often the "
          "pending-tensor queue is drained and negotiated."),
+    Knob("HOROVOD_BATCH_QUIESCENCE", int, 0,
+         "Quiescence batching (XLA-specific; no reference analog): the "
+         "coordinator holds fused-batch cuts until the fully-ready set "
+         "has been stable for this many cycles (or a batch fills the "
+         "fusion threshold). A per-tensor submission storm then agrees "
+         "as ONE batch with a step-stable composition — and a stable "
+         "composition is a stable compiled XLA program, where ragged "
+         "cuts would recompile nearly every step. 0 disables (cut "
+         "every cycle, the reference's behavior); 2-3 suits "
+         "hook-style per-parameter eager submission."),
     Knob("HOROVOD_CACHE_CAPACITY", int, 1024,
          "Response-cache capacity (entries). Tensors seen before skip full "
          "negotiation via a bit-vector exchange. 0 disables the cache."),
@@ -180,6 +190,7 @@ class Config:
     _ATTR_MAP = {
         "fusion_threshold": "HOROVOD_FUSION_THRESHOLD",
         "cycle_time_ms": "HOROVOD_CYCLE_TIME",
+        "batch_quiescence": "HOROVOD_BATCH_QUIESCENCE",
         "cache_capacity": "HOROVOD_CACHE_CAPACITY",
         "hierarchical_allreduce": "HOROVOD_HIERARCHICAL_ALLREDUCE",
         "controller": "HOROVOD_CONTROLLER",

@@ -147,6 +147,10 @@ void hvd_core_set_fusion_threshold(void* h, long long bytes) {
   static_cast<CoreHandle*>(h)->ctrl.SetFusionThreshold(bytes);
 }
 
+void hvd_core_set_quiescence(void* h, int cycles) {
+  static_cast<CoreHandle*>(h)->ctrl.SetQuiescence(cycles);
+}
+
 void hvd_core_set_cycle_time(void* h, double ms) {
   static_cast<CoreHandle*>(h)->ctrl.SetCycleTime(ms);
 }

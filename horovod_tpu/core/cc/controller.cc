@@ -380,10 +380,36 @@ void Controller::RunCoordinatorCycle() {
         ready_order_.push_back(kv.first);
       }
     }
+    // Quiescence gate (see SetQuiescence): while the fully-ready set
+    // is still growing, hold the cut so a submission storm agrees as
+    // ONE stable-composition batch — unless enough bytes are ready to
+    // fill the fusion threshold anyway.
+    bool hold = false;
+    int q = quiesce_cycles_.load();
+    if (q > 0 && !ready_order_.empty()) {
+      if (ready_order_.size() != quiesce_last_ready_) {
+        quiesce_last_ready_ = ready_order_.size();
+        quiesce_stable_ = 0;
+      } else {
+        ++quiesce_stable_;
+      }
+      if (quiesce_stable_ < q) {
+        int64_t ready_bytes = 0;
+        for (const auto& nm : ready_order_) {
+          auto it = tensors_.find(nm);
+          if (it != tensors_.end()) ready_bytes += it->second.nbytes;
+        }
+        hold = ready_bytes < fusion_threshold_.load();
+      }
+    }
+    if (!hold) {
+      quiesce_last_ready_ = 0;
+      quiesce_stable_ = 0;
+    }
     // Greedy fusion over the fully-ready FIFO (reference:
     // FuseResponses): consecutive same-fuse-key tensors pack into one
     // batch up to the threshold.
-    size_t i = 0;
+    size_t i = hold ? ready_order_.size() : 0;
     while (i < ready_order_.size()) {
       const std::string& name = ready_order_[i];
       auto it = tensors_.find(name);
@@ -464,7 +490,7 @@ void Controller::RunCoordinatorCycle() {
       }
       i = j;
     }
-    ready_order_.clear();
+    if (!hold) ready_order_.clear();
     // all-joined announcement
     if (!join_announced_ &&
         joined_ranks_.size() == static_cast<size_t>(opts_.size)) {

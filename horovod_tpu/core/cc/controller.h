@@ -105,6 +105,14 @@ class Controller {
   // Live-tunable cycle time (the other half of the reference
   // ParameterManager's search space).
   void SetCycleTime(double ms) { cycle_time_ms_.store(ms); }
+  // Quiescence batching (no reference analog — an XLA-specific knob):
+  // the coordinator defers cutting fused batches until the
+  // fully-ready set has been stable for `cycles` cycles (or a batch
+  // fills the fusion threshold). A per-tensor submission storm then
+  // lands in ONE batch with a step-stable composition — and a stable
+  // composition is a stable compiled XLA program, where a ragged cut
+  // would recompile nearly every step. 0 (default) disables.
+  void SetQuiescence(int cycles) { quiesce_cycles_.store(cycles); }
   bool ok() const { return ok_.load(); }
   // Returns a copy: the string may be rewritten by controller threads
   // (lost connection, reader errors) concurrently with this read.
@@ -137,6 +145,7 @@ class Controller {
   ControllerOptions opts_;
   std::atomic<int64_t> fusion_threshold_{64 << 20};
   std::atomic<double> cycle_time_ms_{1.0};
+  std::atomic<int> quiesce_cycles_{0};
   std::atomic<bool> shutdown_{false};
   std::atomic<bool> ok_{true};
   mutable std::mutex err_mu_;
@@ -197,6 +206,9 @@ class Controller {
   bool join_announced_ = false;
   int32_t next_batch_id_ = 1;
   int64_t stall_warned_gen_ = 0;
+  // Quiescence-gate state (coordinator cycle thread only).
+  size_t quiesce_last_ready_ = 0;
+  int quiesce_stable_ = 0;
 
   // --- sockets ---
   int listen_fd_ = -1;

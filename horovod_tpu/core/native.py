@@ -150,6 +150,8 @@ def load():
                 ctypes.c_void_p, ctypes.c_longlong]
             lib.hvd_core_set_cycle_time.argtypes = [
                 ctypes.c_void_p, ctypes.c_double]
+            lib.hvd_core_set_quiescence.argtypes = [
+                ctypes.c_void_p, ctypes.c_int]
             lib.hvd_core_control_bytes.argtypes = [ctypes.c_void_p]
             lib.hvd_core_control_bytes.restype = ctypes.c_longlong
             _lib = lib
@@ -266,6 +268,13 @@ class NativeCore:
 
     def set_cycle_time(self, ms: float) -> None:
         self._lib.hvd_core_set_cycle_time(self._h, float(ms))
+
+    def set_quiescence(self, cycles: int) -> None:
+        """Coordinator-side quiescence batching (see controller.h
+        SetQuiescence): hold fused-batch cuts until the ready set is
+        stable for N cycles, so submission storms agree as one
+        stable-composition (= stably-compiled) batch."""
+        self._lib.hvd_core_set_quiescence(self._h, int(cycles))
 
     def control_bytes(self) -> int:
         """Ready-announcement bytes this rank sent (0 on rank 0)."""

@@ -5,8 +5,11 @@ API parity with the reference's Python op layer
 (reference: horovod/torch/mpi_ops.py — allreduce / allreduce_async /
 grouped_allreduce / allgather / broadcast / alltoall / reducescatter /
 synchronize / poll; op constants Average/Sum/Adasum/Min/Max/Product),
-with jax.Arrays in place of torch tensors. Handles are integers, and
-`synchronize(handle)` blocks, exactly like the reference.
+with jax.Arrays in place of torch tensors. Handles are integers.
+`synchronize(handle)` blocks until the op is agreed/launched/delivered
+and raises framework errors, like the reference — but returns ASYNC
+jax.Arrays (device completion is awaited by consumption, the
+XLA-native semantics; see engine.Handle.wait for the measured why).
 """
 
 from __future__ import annotations

@@ -140,6 +140,7 @@ class PythonCore:
     def __init__(self, fusion_threshold: int, cycle_time_ms: float = 0.0):
         self.fusion_threshold = fusion_threshold
         self.cycle_time_ms = float(cycle_time_ms)
+        self.quiesce = 0
         self._mu = threading.Lock()
         self._cv = threading.Condition(self._mu)
         self._pending: collections.deque = collections.deque()
@@ -187,6 +188,25 @@ class PythonCore:
                     if left <= 0:
                         break
                     self._cv.wait(left)
+            if self.quiesce > 0:
+                # Quiescence batching (native-core SetQuiescence
+                # analog): keep lingering while the queue is still
+                # growing so a submission storm cuts as ONE
+                # stable-composition batch — unless enough bytes are
+                # already pending to fill the fusion threshold (the
+                # same escape the C++ coordinator applies).
+                tick = max(self.cycle_time_ms, 1.0) / 1e3
+                stable, last = 0, len(self._pending)
+                while not self._shutdown and stable < self.quiesce:
+                    if sum(nb for _, nb in self._pending) >= \
+                            self.fusion_threshold:
+                        break
+                    self._cv.wait(tick)
+                    if len(self._pending) == last:
+                        stable += 1
+                    else:
+                        last = len(self._pending)
+                        stable = 0
             self._cycles += 1
             # greedy same-key fusion from the front (mirrors the C++
             # coordinator's FuseResponses loop); deque keeps drain O(1)
@@ -214,6 +234,10 @@ class PythonCore:
         # same knob the NativeCore's coordinator cycle honors.
         with self._cv:
             self.cycle_time_ms = float(ms)
+
+    def set_quiescence(self, cycles: int) -> None:
+        with self._cv:
+            self.quiesce = int(cycles)
 
     def control_bytes(self) -> int:
         return 0  # nothing crosses a wire in-process
@@ -288,6 +312,9 @@ class NegotiatedController:
             raise RuntimeError(
                 "multi-process negotiation requires the native core "
                 "(build horovod_tpu/core/cc with `make`)")
+
+        if getattr(cfg, "batch_quiescence", 0):
+            self.core.set_quiescence(cfg.batch_quiescence)
 
         self._worker = threading.Thread(
             target=self._worker_loop, name="hvdtpu-controller",

@@ -60,10 +60,20 @@ class Handle:
         return True
 
     def wait(self) -> Any:
+        """Block until the op is agreed, launched, and delivered;
+        framework-level failures (negotiation errors, launch
+        exceptions) raise here, exactly like the reference's
+        synchronize(). The returned jax.Arrays are ASYNC futures —
+        consuming them awaits device completion (XLA-native
+        semantics). Deliberately NOT jax.block_until_ready here: a
+        per-handle device barrier costs one host<->device round trip
+        per tensor (measured 93 ms x 161 handles = 15 s/step on the
+        axon tunnel) and forfeits the async overlap the whole design
+        exists for; callers needing a hard device barrier call
+        jax.block_until_ready on the result."""
         self._done.wait()
         if self.error is not None:
             raise self.error
-        jax.block_until_ready(self.result)
         return self.result
 
 

@@ -154,6 +154,50 @@ class TestNativeCoreUnit:
         assert core.next_batch(5.0) is None
         core.destroy()
 
+    def test_quiescence_storm_cuts_one_batch(self):
+        """HOROVOD_BATCH_QUIESCENCE: a trickling submission storm
+        (gaps >> cycle time) must agree as ONE fused batch — the
+        coordinator holds the cut while the ready set still grows, so
+        the batch composition (= the compiled XLA program) is stable
+        step over step instead of ragged."""
+        import time
+        core = self.make_core(cycle_time_ms=1.0)
+        core.set_quiescence(5)
+        for i in range(8):
+            core.submit(f"q{i}", "ar|f32|1|0|1.0|1.0#8", 32)
+            time.sleep(0.004)  # 4x the cycle: would split without
+        batches = []
+        got = 0
+        while got < 8:
+            b = core.next_batch(0.3)
+            assert b is not None
+            if b:
+                batches.append([e.name for e in b])
+                got += len(b)
+        assert batches == [[f"q{i}" for i in range(8)]], batches
+        core.shutdown()
+        core.destroy()
+
+    def test_quiescence_python_core(self):
+        """PythonCore analog of the quiescence gate."""
+        import threading
+        import time
+        from horovod_tpu.ops.controller import PythonCore
+        core = PythonCore(1 << 20, cycle_time_ms=1.0)
+        core.set_quiescence(5)
+
+        def storm():
+            for i in range(8):
+                core.submit(f"p{i}", "ar|f32|1|0|1.0|1.0#8", 32)
+                time.sleep(0.004)
+
+        t = threading.Thread(target=storm)
+        t.start()
+        batch = core.next_batch(5.0)
+        t.join()
+        assert [e.name for e in batch] == [f"p{i}" for i in range(8)]
+        core.shutdown()
+
     def test_buffer_grow_keeps_batch(self):
         """A batch bigger than the ctypes buffer must survive the
         regrow-and-retry — the core serializes before consuming
