@@ -51,12 +51,29 @@ def _ensure_distributed(cfg: Config) -> bool:
     Returns True if this call performed jax.distributed.initialize().
     """
     if cfg.coordinator_addr and cfg.size > 1:
-        jax.distributed.initialize(
-            coordinator_address=cfg.coordinator_addr,
-            num_processes=cfg.size,
-            process_id=max(cfg.rank, 0),
-            initialization_timeout=int(max(cfg.start_timeout, 1)),
-        )
+        import os
+        # See the HOROVOD_SHUTDOWN_BARRIER_TIMEOUT knob doc: 0 = auto
+        # (60 under the elastic launcher, jax's 300 otherwise).
+        shutdown_timeout = int(cfg.shutdown_barrier_timeout) or (
+            60 if os.environ.get("HOROVOD_ELASTIC") else 300)
+        try:
+            jax.distributed.initialize(
+                coordinator_address=cfg.coordinator_addr,
+                num_processes=cfg.size,
+                process_id=max(cfg.rank, 0),
+                initialization_timeout=int(max(cfg.start_timeout, 1)),
+                shutdown_timeout_seconds=shutdown_timeout,
+            )
+        except Exception:
+            # A FAILED initialize can leave jax's global distributed
+            # state partially set (service bound, client half
+            # connected); without this teardown every retry would die
+            # on "initialize should only be called once".
+            try:
+                jax.distributed.shutdown()
+            except Exception as e2:  # pragma: no cover - best effort
+                hlog.debug("post-failure distributed teardown: %s", e2)
+            raise
         return True
     return False
 

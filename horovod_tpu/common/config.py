@@ -53,6 +53,12 @@ KNOBS: List[Knob] = [
     Knob("HOROVOD_CACHE_CAPACITY", int, 1024,
          "Response-cache capacity (entries). Tensors seen before skip full "
          "negotiation via a bit-vector exchange. 0 disables the cache."),
+    Knob("HOROVOD_SHUTDOWN_BARRIER_TIMEOUT", int, 0,
+         "Coordination-service shutdown-barrier timeout in seconds; a "
+         "straggler past it is FATALLY terminated by the service. 0 = "
+         "auto: 60 under the elastic launcher (worlds tear down often; "
+         "bound the blast radius of a raggedly-informed world), 300 "
+         "(the jax default) otherwise."),
     Knob("HOROVOD_HIERARCHICAL_ALLREDUCE", _parse_bool, False,
          "Use hierarchical allreduce: reduce-scatter over ICI within a "
          "slice, allreduce over DCN across slices, allgather over ICI."),
@@ -192,6 +198,7 @@ class Config:
         "cycle_time_ms": "HOROVOD_CYCLE_TIME",
         "batch_quiescence": "HOROVOD_BATCH_QUIESCENCE",
         "cache_capacity": "HOROVOD_CACHE_CAPACITY",
+        "shutdown_barrier_timeout": "HOROVOD_SHUTDOWN_BARRIER_TIMEOUT",
         "hierarchical_allreduce": "HOROVOD_HIERARCHICAL_ALLREDUCE",
         "controller": "HOROVOD_CONTROLLER",
         "timeline_path": "HOROVOD_TIMELINE",

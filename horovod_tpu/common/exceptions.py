@@ -1,0 +1,16 @@
+"""Shared exception types.
+
+HorovodInternalError lives here (not in elastic/) because the
+COLLECTIVE layer raises it: any op that cannot complete because the
+control plane went away (coordinator shut down mid-negotiation,
+connection lost) surfaces as this type, exactly like the reference
+(reference: horovod/common/exceptions.py HorovodInternalError raised
+from failed collectives), so `hvd.elastic.run`'s retry loop can
+restore committed state and re-initialize instead of crashing the
+worker — the graceful half of the recovery protocol (SURVEY.md §5.3).
+"""
+
+
+class HorovodInternalError(Exception):
+    """A collective failed because the control plane went away;
+    elastic training recovers by restore + re-init."""

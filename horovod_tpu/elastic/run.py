@@ -57,6 +57,16 @@ def _reinitialize() -> None:
             raise  # removed by resize: clean exit, not a retry
         except Exception as e:
             basics.shutdown()
+            # A failed basics.init can leave jax.distributed
+            # initialized without basics owning it (init raised after
+            # the coordination service came up); force the teardown or
+            # every retry dies on "initialize should only be called
+            # once". Idempotent no-op when already down.
+            try:
+                import jax
+                jax.distributed.shutdown()
+            except Exception:  # pragma: no cover - best effort
+                pass
             if time.time() > deadline:
                 raise
             hlog.warning(

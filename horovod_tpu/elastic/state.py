@@ -23,9 +23,9 @@ import jax.numpy as jnp
 import numpy as np
 
 
-class HorovodInternalError(Exception):
-    """A collective failed (peer died, control plane timeout); training
-    should restore committed state and re-initialize."""
+# Raised by the COLLECTIVE layer on control-plane loss; re-exported
+# here for API parity (hvd.elastic.HorovodInternalError).
+from ..common.exceptions import HorovodInternalError  # noqa: F401,E402
 
 
 class HostsUpdatedInterrupt(Exception):

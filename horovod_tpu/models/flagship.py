@@ -101,6 +101,47 @@ def make_flagship(mesh: Mesh,
     return cfg, params, opt_state, step
 
 
+def make_flagship_fsdp(mesh: Mesh,
+                       cfg: Optional[tfm.TransformerConfig] = None,
+                       optimizer: Optional[
+                           optax.GradientTransformation] = None,
+                       seed: int = 0,
+                       ) -> Tuple[Any, Any, Any, Any]:
+    """ZeRO-3 flagship: parameters AND optimizer state sharded over
+    the `fsdp` mesh axis, train step built on the constraint-based
+    GSPMD path so XLA derives the all-gather(param)/reduce-scatter
+    (grad) schedule (see parallel/fsdp.py). The model runs as a
+    GLOBAL-array program (strategy axes off) — fsdp composes with
+    plain data parallelism, which is its ZeRO semantics; combine with
+    tp/sp via the explicit path when model-parallel sharding is also
+    needed."""
+    from ..parallel.fsdp import zero3_param_shardings
+    from ..parallel.train import build_gspmd_train_step
+
+    cfg = dataclasses.replace(cfg or tfm.TransformerConfig(),
+                              tp_axis=None, sp_axis=None, ep_axis=None)
+    optimizer = optimizer or optax.adamw(3e-4)
+    params_host = tfm.init_params(cfg, jax.random.PRNGKey(seed))
+    shardings = zero3_param_shardings(params_host, mesh)
+    params = jax.tree.map(jax.device_put, params_host, shardings)
+    # Optimizer moments are params-shaped and take the SAME ZeRO
+    # shardings (explicitly: a jitted optax.init is shape-only, so
+    # XLA would constant-fold it onto one device instead of
+    # propagating input shardings).
+    p_specs = jax.tree.map(lambda s: s.spec, shardings)
+    opt_specs = infer_opt_state_specs(optimizer, params_host, p_specs)
+    o_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s),
+                               opt_specs,
+                               is_leaf=lambda x: isinstance(x, P))
+    opt_state = jax.tree.map(jax.device_put,
+                             optimizer.init(params_host), o_shardings)
+
+    step = build_gspmd_train_step(
+        lambda p, b: tfm.loss_fn(cfg, p, b), optimizer, mesh,
+        param_shardings=shardings)
+    return cfg, params, opt_state, step
+
+
 def make_batch(cfg: tfm.TransformerConfig, mesh: Mesh,
                global_batch: int, seq_len: int, seed: int = 1
                ) -> Dict[str, jax.Array]:

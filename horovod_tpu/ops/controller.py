@@ -265,6 +265,10 @@ class NegotiatedController:
         self._join_event = threading.Event()
         self._join_result = -1
         self._error: Optional[BaseException] = None
+        # Terminal marker: set (before _fail_pending) when the dispatch
+        # worker exits; submissions after that fail fast instead of
+        # waiting forever on a worker that will never deliver.
+        self._terminated: Optional[BaseException] = None
         self._pushed_fusion = cfg.fusion_threshold
         self._pushed_cycle = cfg.cycle_time_ms
         self._last_cycle_mark = -1
@@ -272,6 +276,11 @@ class NegotiatedController:
         # fused batch increments batches by 1 and entries by N
         # (tests assert fusion actually happened).
         self.exec_counts: Dict[str, List[int]] = {}
+        # Composition-churn detection: every distinct fused-batch
+        # composition is a distinct compiled XLA program. Many
+        # distinct compositions = recompiling instead of reusing.
+        self._ar_compositions: set = set()
+        self._churn_warned = False
 
         if cfg.controller == "python" and topology.size > 1 and \
                 core is None:
@@ -361,6 +370,7 @@ class NegotiatedController:
         if self.engine.timeline is not None:
             self.engine.timeline.negotiate_start(name)
         self.core.submit(name, sig, nbytes)
+        self._check_terminated(name, h)
         return h
 
     def submit_broadcast(self, name: str, tensor, set_root: int,
@@ -383,6 +393,7 @@ class NegotiatedController:
         if self.engine.timeline is not None:
             self.engine.timeline.negotiate_start(name)
         self.core.submit(name, sig, nbytes)
+        self._check_terminated(name, h)
         return h
 
     def submit_allgather(self, name: str, tensor, pset) -> Any:
@@ -406,6 +417,7 @@ class NegotiatedController:
         if self.engine.timeline is not None:
             self.engine.timeline.negotiate_start(name)
         self.core.submit(name, sig, nbytes, str(t.shape[0]))
+        self._check_terminated(name, h)
         return h
 
     def submit_generic(self, name: str, nbytes: int,
@@ -428,6 +440,7 @@ class NegotiatedController:
         if self.engine.timeline is not None:
             self.engine.timeline.negotiate_start(name)
         self.core.submit(name, f"g|{name}#", nbytes, meta or "")
+        self._check_terminated(name, h)
         return h
 
     def join(self, timeout_s: Optional[float] = None) -> int:
@@ -450,6 +463,7 @@ class NegotiatedController:
     # ------------------------------------------------------------------
 
     def _worker_loop(self):
+        from ..common.exceptions import HorovodInternalError
         try:
             while True:
                 batch = self.core.next_batch(0.05)
@@ -459,11 +473,19 @@ class NegotiatedController:
                     # arrived in the same final flush as the shutdown
                     # — poll it one last time, then fail anything
                     # still pending and unblock join() waiters so
-                    # nothing hangs.
-                    self._poll_join()
-                    self._fail_pending(RuntimeError(
+                    # nothing hangs. HorovodInternalError so elastic
+                    # training recovers (restore + re-init) instead of
+                    # crashing — e.g. a peer left for a resize this
+                    # rank hasn't processed yet (its next collective
+                    # lands here). The terminal marker is set FIRST:
+                    # submissions racing this exit fail fast in
+                    # submit_* instead of waiting on a dead worker.
+                    self._terminated = HorovodInternalError(
                         "collective cannot complete: the controller "
-                        "shut down"))
+                        "shut down"
+                        + (f" ({self._error})" if self._error else ""))
+                    self._poll_join()
+                    self._fail_pending(self._terminated)
                     self._join_event.set()
                     break
                 if batch:
@@ -472,6 +494,7 @@ class NegotiatedController:
         except BaseException as e:  # pragma: no cover - defensive
             hlog.error("controller worker died: %s", e)
             self._error = e
+            self._terminated = e
             self._fail_pending(e)
             self._join_event.set()
 
@@ -488,6 +511,21 @@ class NegotiatedController:
             self._pending.clear()
         for p in pending:
             p.handle.set_error(err)
+
+    def _check_terminated(self, name: str, h) -> bool:
+        """Fail-fast for submissions racing the dispatch worker's
+        exit: after the worker set _terminated and swept _pending, a
+        later submit would otherwise wait forever on a delivery that
+        cannot happen (the wedge: a peer left for a resize and this
+        rank's next collective was submitted after the control plane
+        closed)."""
+        if self._terminated is None:
+            return False
+        with self._mu:
+            p = self._pending.pop(name, None)
+        if p is not None:
+            h.set_error(self._terminated)
+        return True
 
     def _execute(self, batch):
         tl = self.engine.timeline
@@ -671,6 +709,26 @@ class NegotiatedController:
                 slots.append((e, p, len(p.tensors)))
                 if self.engine.timeline is not None:
                     self.engine.timeline.dispatched(e.name)
+
+        # Churn watch: a growing set of distinct batch compositions
+        # means each cut is compiling a NEW fused program (the
+        # measured 300x eager slowdown mode — docs/benchmarks.md).
+        # Point at the knob that stabilizes the cut.
+        if not self._churn_warned and not self.cfg.batch_quiescence:
+            self._ar_compositions.add(
+                tuple((tuple(t.shape), str(t.dtype)) for t in tensors))
+            if len(self._ar_compositions) > 16:
+                self._churn_warned = True
+                hlog.warning(
+                    "eager allreduce batches have taken %d distinct "
+                    "compositions — every new composition compiles a "
+                    "new fused XLA program. If you submit tensors "
+                    "individually (hook-style), set "
+                    "HOROVOD_BATCH_QUIESCENCE=5 (and/or raise "
+                    "HOROVOD_CYCLE_TIME) so each step's storm agrees "
+                    "as one stable batch, or use grouped_allreduce / "
+                    "DistributedOptimizer which submit one stable "
+                    "group", len(self._ar_compositions))
 
         tuner = self.engine.autotuner
         t0 = time.perf_counter() if tuner is not None else 0.0

@@ -15,6 +15,7 @@ from .sharding import (  # noqa: F401
     DEFAULT_RULES, Rules, replicated, shard_put, tree_shardings,
 )
 from .train import build_gspmd_train_step, build_train_step  # noqa: F401
+from .fsdp import zero3_param_shardings, zero3_spec  # noqa: F401
 from .ring_attention import attention, ring_attention  # noqa: F401
 from .ulysses import (  # noqa: F401
     gather_heads, scatter_heads, ulysses_attention,

@@ -74,6 +74,32 @@ class TestControllerSingleProcess:
         except ValueError as e:
             assert "already pending" in str(e)
 
+    def test_composition_churn_warning(self, hvd_ctrl):
+        """>16 distinct fused-batch compositions with quiescence off
+        must warn once, naming HOROVOD_BATCH_QUIESCENCE (every new
+        composition is a fresh compiled XLA program — the measured
+        eager slowdown mode, docs/benchmarks.md). The hvd logger has
+        propagate=False, so capture with an attached handler."""
+        import logging
+        from horovod_tpu.common.logging import logger
+
+        records = []
+
+        class Grab(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        h = Grab(level=logging.WARNING)
+        logger.addHandler(h)
+        try:
+            for i in range(20):
+                # unique shape per op -> unique composition
+                hvd_ctrl.allreduce(jnp.ones(3 + i), name=f"churn{i}")
+        finally:
+            logger.removeHandler(h)
+        hits = [m for m in records if "HOROVOD_BATCH_QUIESCENCE" in m]
+        assert len(hits) == 1, records
+
     def test_compression_roundtrip(self, hvd_ctrl):
         from horovod_tpu.ops.compression import Compression
         x = jnp.arange(8.0, dtype=jnp.float32)

@@ -205,6 +205,9 @@ class TestElastic:
             if p.poll() is None:
                 p.kill()
                 out = p.communicate()[0]
+            if os.environ.get("ELASTIC_TEST_DUMP"):
+                with open(os.environ["ELASTIC_TEST_DUMP"], "w") as f:
+                    f.write(out or "")
         assert p.returncode == 0, out
         lines = read_logs(tmp_path)
         assert any("world 2" in ln for ln in lines), lines

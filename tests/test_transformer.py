@@ -91,6 +91,83 @@ class TestShardedLossMatchesOracle:
                                    rtol=2e-3, atol=2e-3)
 
 
+class TestFSDP:
+    """ZeRO-3 on TPU (parallel/fsdp.py + make_flagship_fsdp):
+    parameters AND optimizer state sharded over the fsdp mesh axis;
+    XLA's partitioner derives the all-gather(param) /
+    reduce-scatter(grad) schedule; numerics match the replicated
+    run. The reference has no FSDP (SURVEY.md §2.6) — TPU-native
+    bonus."""
+
+    @staticmethod
+    def _has_fsdp(spec) -> bool:
+        return any(
+            a == "fsdp" or (isinstance(a, tuple) and "fsdp" in a)
+            for a in spec if a is not None)
+
+    def test_params_and_opt_state_actually_sharded(self):
+        mesh = build_mesh(MeshSpec(fsdp=2))  # dp4 x fsdp2
+        cfg, params, opt_state, step = flagship.make_flagship_fsdp(
+            mesh, SMALL, optax.adam(1e-2))
+        assert self._has_fsdp(params["embed"].sharding.spec), \
+            params["embed"].sharding
+        # every weight matrix is sharded (only tiny norm vectors may
+        # stay replicated)
+        for path, p in jax.tree_util.tree_leaves_with_path(params):
+            if p.ndim >= 2:
+                assert self._has_fsdp(p.sharding.spec), \
+                    (jax.tree_util.keystr(path), p.sharding)
+        # optimizer moments inherit the ZeRO sharding
+        mu_embed = opt_state[0].mu["embed"]
+        assert self._has_fsdp(mu_embed.sharding.spec), mu_embed.sharding
+
+    def test_fsdp_compiles_gathers(self):
+        """The compiled step must contain fsdp collectives — proof the
+        parameters really live sharded and are gathered for use."""
+        mesh = build_mesh(MeshSpec(fsdp=2))
+        cfg, params, opt_state, step = flagship.make_flagship_fsdp(
+            mesh, SMALL, optax.sgd(0.5))
+        batch = flagship.make_batch(cfg, mesh, 8, 32)
+        hlo = step.lower(params, opt_state, batch).compile().as_text()
+        assert "all-gather" in hlo or "all-gather-start" in hlo, \
+            hlo[:2000]
+
+    def test_fsdp_step_matches_replicated(self):
+        """One SGD step under ZeRO-3 sharding must equal the
+        single-device full-batch step: fsdp changes layout, never
+        math."""
+        mesh = build_mesh(MeshSpec(fsdp=2))
+        cfg, params, opt_state, step = flagship.make_flagship_fsdp(
+            mesh, SMALL, optax.sgd(0.5))
+        batch_host = make_host_batch(cfg, 8, 32)
+        params_host = jax.tree.map(np.asarray, jax.device_get(params))
+
+        from jax.sharding import NamedSharding
+        sh = NamedSharding(mesh, flagship.batch_spec(mesh))
+        batch = {k: jax.device_put(v, sh) for k, v in batch_host.items()}
+        new_params, _, metrics = step(params, opt_state, batch)
+        new_params_host = jax.tree.map(np.asarray,
+                                       jax.device_get(new_params))
+
+        l0 = float(oracle_loss(cfg, params_host, batch_host))
+        np.testing.assert_allclose(float(metrics["loss"]), l0,
+                                   rtol=1e-4, atol=1e-4)
+        grads = jax.grad(
+            lambda p: oracle_loss(cfg, p, batch_host))(params_host)
+        oracle = jax.tree.map(lambda p, g: p - 0.5 * g, params_host,
+                              grads)
+        flat2 = dict(
+            (jax.tree_util.keystr(k), v)
+            for k, v in jax.tree_util.tree_leaves_with_path(oracle))
+        for path, v in jax.tree_util.tree_leaves_with_path(
+                new_params_host):
+            np.testing.assert_allclose(
+                np.asarray(v),
+                np.asarray(flat2[jax.tree_util.keystr(path)]),
+                rtol=2e-4, atol=2e-4,
+                err_msg=jax.tree_util.keystr(path))
+
+
 class TestTrainingConverges:
     def test_loss_decreases_sharded(self):
         mesh = build_mesh(MeshSpec(tensor=2, seq=2))
