@@ -137,7 +137,7 @@ def _resolve_baseline(metric: str):
     return None
 
 
-def eager_main():
+def eager_main(model_name: str = "resnet50"):
     """Eager/negotiated-path benchmark: the reference's torch-hook
     mechanism (reference: horovod/torch/optimizer.py
     _DistributedOptimizer._make_hook — one allreduce_async_ per
@@ -199,18 +199,34 @@ def eager_main():
     log(f"bench[eager]: controller core={core_kind} "
         f"native_available={_native.available()} size={hvd.size()}")
 
-    model = create_resnet50(dtype=jnp.bfloat16)
-    variables = init_resnet(model, jax.random.PRNGKey(0), image)
-    params, batch_stats = variables["params"], variables["batch_stats"]
+    vgg = model_name == "vgg16"
+    if vgg:
+        # Multi-fusion-batch stress: ~276 MB fp16 wire/step spans
+        # several 64 MiB fusion buffers per cycle.
+        from horovod_tpu.models.vgg import create_vgg16, init_vgg
+        model = create_vgg16(dtype=jnp.bfloat16)
+        variables = init_vgg(model, jax.random.PRNGKey(0), image)
+        params, batch_stats = variables["params"], {}
+    else:
+        model = create_resnet50(dtype=jnp.bfloat16)
+        variables = init_resnet(model, jax.random.PRNGKey(0), image)
+        params, batch_stats = (variables["params"],
+                               variables["batch_stats"])
 
     def loss_fn(params, batch_stats, images, labels):
-        logits, updates = model.apply(
-            {"params": params, "batch_stats": batch_stats},
-            images, train=True, mutable=["batch_stats"])
+        if vgg:
+            logits = model.apply({"params": params}, images,
+                                 train=True)
+            new_stats = {}
+        else:
+            logits, updates = model.apply(
+                {"params": params, "batch_stats": batch_stats},
+                images, train=True, mutable=["batch_stats"])
+            new_stats = updates["batch_stats"]
         onehot = jax.nn.one_hot(labels, logits.shape[-1])
         loss = jnp.mean(
             -jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
-        return loss, updates["batch_stats"]
+        return loss, new_stats
 
     grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
 
@@ -304,14 +320,15 @@ def eager_main():
         log(f"bench[eager]: negotiation cycles={cyc} "
             f"({cyc / max(steps, 1):.1f}/step) control_bytes={cb} "
             f"({cb / max(steps, 1):.0f}/step) exec_counts={counts}")
+    mname = "vgg16" if vgg else "resnet50"
     jit_ref = _resolve_baseline(
-        "resnet50_synthetic_train_img_sec_per_chip")
+        f"{mname}_synthetic_train_img_sec_per_chip")
     if jit_ref:
         log(f"bench[eager]: eager/jit gap: {img_sec_chip:.1f} vs "
             f"{jit_ref:.1f} jit-path = {img_sec_chip / jit_ref:.3f}x")
     vs = img_sec_chip / jit_ref if jit_ref else 1.0
     print(json.dumps({
-        "metric": "resnet50_synthetic_eager_img_sec_per_chip",
+        "metric": f"{mname}_synthetic_eager_img_sec_per_chip",
         "value": round(img_sec_chip, 2),
         "unit": "img/sec/chip",
         "vs_baseline": round(vs, 4),
@@ -417,7 +434,7 @@ def transformer_main():
     }), flush=True)
 
 
-def main():
+def main(model_name: str = "resnet50"):
     batch_per_chip = int(os.environ.get("BENCH_BATCH", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "200"))
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
@@ -431,10 +448,20 @@ def main():
     n_chips = mesh.devices.size
     global_batch = batch_per_chip * n_chips
     log(f"bench: devices={n_chips} platform="
-        f"{jax.devices()[0].platform} global_batch={global_batch}")
+        f"{jax.devices()[0].platform} global_batch={global_batch} "
+        f"model={model_name}")
 
+    has_bn = model_name == "resnet50"
     stages = os.environ.get("BENCH_RESNET_STAGES", "")
-    if stages:
+    if model_name == "vgg16":
+        # The reference benchmark trio's comm-bound member: ~138M
+        # params = ~276 MB fp16 gradient wire per step (reference:
+        # docs/benchmarks.rst VGG-16 at 68% scaling vs ~90%).
+        from horovod_tpu.models.vgg import create_vgg16, init_vgg
+        model = create_vgg16(dtype=jnp.bfloat16)
+        variables = init_vgg(model, jax.random.PRNGKey(0), image)
+        params, batch_stats = variables["params"], {}
+    elif stages:
         # Reduced-depth variant for multi-process virtual-mesh runs
         # (8 CPU procs compiling full ResNet-50 on shared cores takes
         # tens of minutes; the mesh/collective accounting being
@@ -442,19 +469,27 @@ def main():
         from horovod_tpu.models.resnet import ResNet
         model = ResNet(stage_sizes=[int(s) for s in stages.split(",")],
                        dtype=jnp.bfloat16)
+        variables = init_resnet(model, jax.random.PRNGKey(0), image)
+        params, batch_stats = variables["params"], variables["batch_stats"]
     else:
         model = create_resnet50(dtype=jnp.bfloat16)
-    variables = init_resnet(model, jax.random.PRNGKey(0), image)
-    params, batch_stats = variables["params"], variables["batch_stats"]
+        variables = init_resnet(model, jax.random.PRNGKey(0), image)
+        params, batch_stats = variables["params"], variables["batch_stats"]
 
     def loss_fn(params, batch):
-        logits, updates = model.apply(
-            {"params": params, "batch_stats": batch["batch_stats"]},
-            batch["images"], train=True, mutable=["batch_stats"])
+        if has_bn:
+            logits, updates = model.apply(
+                {"params": params, "batch_stats": batch["batch_stats"]},
+                batch["images"], train=True, mutable=["batch_stats"])
+            new_stats = updates["batch_stats"]
+        else:
+            logits = model.apply({"params": params}, batch["images"],
+                                 train=True)
+            new_stats = {}
         onehot = jax.nn.one_hot(batch["labels"], logits.shape[-1])
         loss = jnp.mean(
             -jnp.sum(onehot * jax.nn.log_softmax(logits), axis=-1))
-        return loss, updates["batch_stats"]
+        return loss, new_stats
 
     opt = optax.sgd(0.0125 * n_chips, momentum=0.9)
     opt_state = opt.init(params)
@@ -543,11 +578,11 @@ def main():
     # note), so the most meaningful ratio is against the FIRST
     # recorded round on this same hardware — cross-round progress
     # rather than a vacuous 1.0.
-    baseline = _resolve_baseline(
-        "resnet50_synthetic_train_img_sec_per_chip")
+    metric = f"{model_name}_synthetic_train_img_sec_per_chip"
+    baseline = _resolve_baseline(metric)
     vs = img_sec_chip / baseline if baseline else 1.0
     print(json.dumps({
-        "metric": "resnet50_synthetic_train_img_sec_per_chip",
+        "metric": metric,
         "value": round(img_sec_chip, 2),
         "unit": "img/sec/chip",
         "vs_baseline": round(vs, 4),
@@ -555,11 +590,14 @@ def main():
 
 
 if __name__ == "__main__":
+    chosen = (sys.argv[sys.argv.index("--model") + 1:
+                       sys.argv.index("--model") + 2]
+              if "--model" in sys.argv else [])
     if "--eager" in sys.argv:
-        eager_main()
-    elif "--model" in sys.argv and \
-            sys.argv[sys.argv.index("--model") + 1:
-                     sys.argv.index("--model") + 2] == ["transformer"]:
+        eager_main("vgg16" if chosen == ["vgg16"] else "resnet50")
+    elif chosen == ["transformer"]:
         transformer_main()
+    elif chosen == ["vgg16"]:
+        main("vgg16")
     else:
         main()

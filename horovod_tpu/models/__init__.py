@@ -7,6 +7,7 @@ from .resnet import (  # noqa: F401
     ResNet, ResNet50, ResNet101, ResNet152, create_resnet50,
     init_resnet, resnet_loss_fn,
 )
+from .vgg import VGG16, create_vgg16, init_vgg  # noqa: F401
 from .transformer import (  # noqa: F401
     EXTRA_RULES, TransformerConfig, forward, init_params, logits_fn,
     loss_fn, param_logical_axes, vocab_parallel_xent,

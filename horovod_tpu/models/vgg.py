@@ -1,0 +1,57 @@
+"""VGG-16 (configuration D) — the param-heavy member of the
+reference's benchmark trio (reference: docs/benchmarks.rst measures
+Inception V3 / ResNet-101 at ~90% scaling and VGG-16 at ~68%,
+BECAUSE VGG's ~138M parameters make it communication-bound: ~276 MB
+of fp16 gradient wire per step vs ResNet-50's ~50 MB). Useful here
+for exactly that reason: it stresses the fusion engine across
+multiple fusion-threshold-sized batches per step.
+
+NHWC, bf16 compute (MXU-native), classifier Dense dims inferred from
+the input resolution so small-image tests run the same code path.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+# Convolution plan, configuration "D": channel counts with "M" = 2x2
+# max-pool between stages.
+_VGG16_PLAN: Sequence = (64, 64, "M", 128, 128, "M", 256, 256, 256,
+                         "M", 512, 512, 512, "M", 512, 512, 512, "M")
+
+
+class VGG16(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(nn.Conv, kernel_size=(3, 3),
+                                 padding="SAME", dtype=self.dtype)
+        x = x.astype(self.dtype)
+        for i, step in enumerate(_VGG16_PLAN):
+            if step == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.relu(conv(step, name=f"conv{i}")(x))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc1")(x))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc2")(x))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     name="fc3")(x)
+        return x.astype(jnp.float32)
+
+
+def create_vgg16(num_classes: int = 1000, dtype=jnp.bfloat16) -> VGG16:
+    return VGG16(num_classes=num_classes, dtype=dtype)
+
+
+def init_vgg(model: VGG16, key: jax.Array, image_size: int = 224) -> Any:
+    """Returns {'params': ...} (no batch stats — VGG has no BN)."""
+    dummy = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+    return model.init(key, dummy, train=False)

@@ -18,7 +18,7 @@ transient and XLA schedules them just-in-time).
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any
 
 import jax
 import numpy as np

@@ -204,6 +204,31 @@ class TestNativeCoreUnit:
         core.shutdown()
         core.destroy()
 
+    def test_submit_after_shutdown_fails_fast(self):
+        """Ops submitted after the dispatch worker exited must error
+        immediately with HorovodInternalError (the elastic-resize
+        wedge: a survivor's next collective would otherwise wait
+        forever on a control plane that already closed)."""
+        import time
+        import horovod_tpu as hvd
+        from horovod_tpu.common.basics import state
+        from horovod_tpu.common.exceptions import HorovodInternalError
+        hvd.init(config_overrides={"HOROVOD_CONTROLLER": "native"})
+        try:
+            ctl = state().engine.controller
+            # out-of-band core shutdown (what a coordinator loss looks
+            # like); wait for the worker loop to reach terminal state
+            ctl.core.shutdown()
+            deadline = time.time() + 10
+            while ctl._terminated is None and time.time() < deadline:
+                time.sleep(0.02)
+            assert ctl._terminated is not None
+            h = hvd.allreduce_async(jnp.ones(3), name="late")
+            with pytest.raises(HorovodInternalError):
+                hvd.synchronize(h)
+        finally:
+            hvd.shutdown()
+
     def test_quiescence_python_core(self):
         """PythonCore analog of the quiescence gate."""
         import threading

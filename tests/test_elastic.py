@@ -188,6 +188,7 @@ class TestElastic:
         env = make_env(tmp_path, steps=60, sleep=0.25)
         env["HOROVOD_LOG_LEVEL"] = "info"
         p = launch(script, env)
+        out = ""
         try:
             def wait_for(pred, timeout=240):
                 deadline = time.time() + timeout
