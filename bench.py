@@ -438,7 +438,8 @@ def main(model_name: str = "resnet50"):
     batch_per_chip = int(os.environ.get("BENCH_BATCH", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "200"))
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
-    image = int(os.environ.get("BENCH_IMAGE", "224"))
+    image = int(os.environ.get(
+        "BENCH_IMAGE", "299" if model_name == "inception3" else "224"))
     profile_dir = os.environ.get("BENCH_PROFILE", "")
     if "--profile" in sys.argv:
         profile_dir = profile_dir or "/tmp/hvdtpu_bench_trace"
@@ -451,9 +452,18 @@ def main(model_name: str = "resnet50"):
         f"{jax.devices()[0].platform} global_batch={global_batch} "
         f"model={model_name}")
 
-    has_bn = model_name == "resnet50"
+    has_bn = model_name in ("resnet50", "inception3")
     stages = os.environ.get("BENCH_RESNET_STAGES", "")
-    if model_name == "vgg16":
+    if model_name == "inception3":
+        # The lead model of the reference's benchmark table
+        # (docs/benchmarks.rst: Inception V3 ~90% scaling).
+        from horovod_tpu.models.inception import (create_inception_v3,
+                                                  init_inception)
+        model = create_inception_v3(dtype=jnp.bfloat16)
+        variables = init_inception(model, jax.random.PRNGKey(0), image)
+        params, batch_stats = (variables["params"],
+                               variables["batch_stats"])
+    elif model_name == "vgg16":
         # The reference benchmark trio's comm-bound member: ~138M
         # params = ~276 MB fp16 gradient wire per step (reference:
         # docs/benchmarks.rst VGG-16 at 68% scaling vs ~90%).
@@ -593,11 +603,16 @@ if __name__ == "__main__":
     chosen = (sys.argv[sys.argv.index("--model") + 1:
                        sys.argv.index("--model") + 2]
               if "--model" in sys.argv else [])
+    model = chosen[0] if chosen else "resnet50"
     if "--eager" in sys.argv:
-        eager_main("vgg16" if chosen == ["vgg16"] else "resnet50")
-    elif chosen == ["transformer"]:
+        if model not in ("resnet50", "vgg16"):
+            sys.exit(f"bench: --eager supports resnet50/vgg16, "
+                     f"got {model!r}")
+        eager_main(model)
+    elif model == "transformer":
         transformer_main()
-    elif chosen == ["vgg16"]:
-        main("vgg16")
+    elif model in ("resnet50", "vgg16", "inception3"):
+        main(model)
     else:
-        main()
+        sys.exit(f"bench: unknown --model {model!r} (choose "
+                 "resnet50, vgg16, inception3, transformer)")

@@ -8,6 +8,9 @@ from .resnet import (  # noqa: F401
     init_resnet, resnet_loss_fn,
 )
 from .vgg import VGG16, create_vgg16, init_vgg  # noqa: F401
+from .inception import (  # noqa: F401
+    InceptionV3, create_inception_v3, init_inception,
+)
 from .transformer import (  # noqa: F401
     EXTRA_RULES, TransformerConfig, forward, init_params, logits_fn,
     loss_fn, param_logical_axes, vocab_parallel_xent,

@@ -1,0 +1,180 @@
+"""Inception V3 — the lead model of the reference's benchmark table
+(reference: docs/benchmarks.rst / README "Benchmarks": Inception V3 at
+~90% scaling efficiency on 128 GPUs; examples/.../
+*_synthetic_benchmark.py drive the same shape).
+
+Canonical structure (Szegedy et al., "Rethinking the Inception
+Architecture", arXiv:1512.00567; matches the torchvision/TF-slim
+layout): conv stem -> 3x InceptionA -> ReductionA -> 4x InceptionB
+(7x7 factorized) -> ReductionB -> 2x InceptionC -> pool/dropout/fc.
+The auxiliary classifier head is omitted — synthetic throughput
+benchmarks train on the main loss only. NHWC, bf16 compute, BN
+without scale (gamma) as in the canonical model.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class ConvBN(nn.Module):
+    """Conv -> BatchNorm(no gamma) -> ReLU, the Inception building
+    block."""
+    features: int
+    kernel: Tuple[int, int] = (1, 1)
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "SAME"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(self.features, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-3, use_scale=False,
+                         dtype=self.dtype)(x)
+        return nn.relu(x)
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(64)(x, train)
+        b5 = cbn(48)(x, train)
+        b5 = cbn(64, (5, 5))(b5, train)
+        b3 = cbn(64)(x, train)
+        b3 = cbn(96, (3, 3))(b3, train)
+        b3 = cbn(96, (3, 3))(b3, train)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        bp = cbn(self.pool_features)(bp, train)
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class ReductionA(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        b3 = cbn(384, (3, 3), (2, 2), "VALID")(x, train)
+        bd = cbn(64)(x, train)
+        bd = cbn(96, (3, 3))(bd, train)
+        bd = cbn(96, (3, 3), (2, 2), "VALID")(bd, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """7x7-factorized block (c7 = the bottleneck width)."""
+    c7: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(192)(x, train)
+        b7 = cbn(self.c7)(x, train)
+        b7 = cbn(self.c7, (1, 7))(b7, train)
+        b7 = cbn(192, (7, 1))(b7, train)
+        bd = cbn(self.c7)(x, train)
+        bd = cbn(self.c7, (7, 1))(bd, train)
+        bd = cbn(self.c7, (1, 7))(bd, train)
+        bd = cbn(self.c7, (7, 1))(bd, train)
+        bd = cbn(192, (1, 7))(bd, train)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        bp = cbn(192)(bp, train)
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class ReductionB(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        b3 = cbn(192)(x, train)
+        b3 = cbn(320, (3, 3), (2, 2), "VALID")(b3, train)
+        b7 = cbn(192)(x, train)
+        b7 = cbn(192, (1, 7))(b7, train)
+        b7 = cbn(192, (7, 1))(b7, train)
+        b7 = cbn(192, (3, 3), (2, 2), "VALID")(b7, train)
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        b1 = cbn(320)(x, train)
+        b3 = cbn(384)(x, train)
+        b3 = jnp.concatenate(
+            [cbn(384, (1, 3))(b3, train),
+             cbn(384, (3, 1))(b3, train)], axis=-1)
+        bd = cbn(448)(x, train)
+        bd = cbn(384, (3, 3))(bd, train)
+        bd = jnp.concatenate(
+            [cbn(384, (1, 3))(bd, train),
+             cbn(384, (3, 1))(bd, train)], axis=-1)
+        bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        bp = cbn(192)(bp, train)
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cbn = functools.partial(ConvBN, dtype=self.dtype)
+        x = x.astype(self.dtype)
+        # stem (299x299 -> 35x35x192)
+        x = cbn(32, (3, 3), (2, 2), "VALID")(x, train)
+        x = cbn(32, (3, 3), padding="VALID")(x, train)
+        x = cbn(64, (3, 3))(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = cbn(80, (1, 1), padding="VALID")(x, train)
+        x = cbn(192, (3, 3), padding="VALID")(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # 35x35
+        x = InceptionA(32, dtype=self.dtype)(x, train)
+        x = InceptionA(64, dtype=self.dtype)(x, train)
+        x = InceptionA(64, dtype=self.dtype)(x, train)
+        x = ReductionA(dtype=self.dtype)(x, train)
+        # 17x17
+        x = InceptionB(128, dtype=self.dtype)(x, train)
+        x = InceptionB(160, dtype=self.dtype)(x, train)
+        x = InceptionB(160, dtype=self.dtype)(x, train)
+        x = InceptionB(192, dtype=self.dtype)(x, train)
+        x = ReductionB(dtype=self.dtype)(x, train)
+        # 8x8
+        x = InceptionC(dtype=self.dtype)(x, train)
+        x = InceptionC(dtype=self.dtype)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+def create_inception_v3(num_classes: int = 1000,
+                        dtype=jnp.bfloat16) -> InceptionV3:
+    return InceptionV3(num_classes=num_classes, dtype=dtype)
+
+
+def init_inception(model: InceptionV3, key: jax.Array,
+                   image_size: int = 299) -> Any:
+    """Returns {'params': ..., 'batch_stats': ...}."""
+    dummy = jnp.zeros((1, image_size, image_size, 3), jnp.float32)
+    return model.init(key, dummy, train=False)
