@@ -109,6 +109,16 @@ def aot_compile(step_fn, *args):
     return compiled, flops
 
 
+def _make_reduced_resnet(stages: str):
+    """Reduced-depth ResNet for multi-process CPU runs (8 procs
+    compiling full ResNet-50 on shared cores takes tens of minutes;
+    the mesh/collective accounting being validated is
+    depth-independent)."""
+    from horovod_tpu.models.resnet import ResNet
+    return ResNet(stage_sizes=[int(s) for s in stages.split(",")],
+                  dtype=jnp.bfloat16)
+
+
 def _resolve_baseline(metric: str):
     """Baseline for vs_baseline: BENCH_BASELINE_IMG_SEC env (img/sec
     metrics only — a tokens/sec metric must not divide by it), else
@@ -208,7 +218,9 @@ def eager_main(model_name: str = "resnet50"):
         variables = init_vgg(model, jax.random.PRNGKey(0), image)
         params, batch_stats = variables["params"], {}
     else:
-        model = create_resnet50(dtype=jnp.bfloat16)
+        stages = os.environ.get("BENCH_RESNET_STAGES", "")
+        model = (_make_reduced_resnet(stages) if stages
+                 else create_resnet50(dtype=jnp.bfloat16))
         variables = init_resnet(model, jax.random.PRNGKey(0), image)
         params, batch_stats = (variables["params"],
                                variables["batch_stats"])
@@ -472,13 +484,7 @@ def main(model_name: str = "resnet50"):
         variables = init_vgg(model, jax.random.PRNGKey(0), image)
         params, batch_stats = variables["params"], {}
     elif stages:
-        # Reduced-depth variant for multi-process virtual-mesh runs
-        # (8 CPU procs compiling full ResNet-50 on shared cores takes
-        # tens of minutes; the mesh/collective accounting being
-        # validated is depth-independent).
-        from horovod_tpu.models.resnet import ResNet
-        model = ResNet(stage_sizes=[int(s) for s in stages.split(",")],
-                       dtype=jnp.bfloat16)
+        model = _make_reduced_resnet(stages)
         variables = init_resnet(model, jax.random.PRNGKey(0), image)
         params, batch_stats = variables["params"], variables["batch_stats"]
     else:
@@ -600,10 +606,15 @@ def main(model_name: str = "resnet50"):
 
 
 if __name__ == "__main__":
-    chosen = (sys.argv[sys.argv.index("--model") + 1:
-                       sys.argv.index("--model") + 2]
-              if "--model" in sys.argv else [])
-    model = chosen[0] if chosen else "resnet50"
+    if "--model" in sys.argv:
+        chosen = sys.argv[sys.argv.index("--model") + 1:
+                          sys.argv.index("--model") + 2]
+        if not chosen:
+            sys.exit("bench: --model requires a value (resnet50, "
+                     "vgg16, inception3, transformer)")
+        model = chosen[0]
+    else:
+        model = "resnet50"
     if "--eager" in sys.argv:
         if model not in ("resnet50", "vgg16"):
             sys.exit(f"bench: --eager supports resnet50/vgg16, "

@@ -6,10 +6,12 @@
 Canonical structure (Szegedy et al., "Rethinking the Inception
 Architecture", arXiv:1512.00567; matches the torchvision/TF-slim
 layout): conv stem -> 3x InceptionA -> ReductionA -> 4x InceptionB
-(7x7 factorized) -> ReductionB -> 2x InceptionC -> pool/dropout/fc.
-The auxiliary classifier head is omitted — synthetic throughput
-benchmarks train on the main loss only. NHWC, bf16 compute, BN
-without scale (gamma) as in the canonical model.
+(7x7 factorized) -> ReductionB -> 2x InceptionC -> pool/fc.
+The auxiliary classifier head AND the pre-logits dropout are omitted
+— synthetic throughput benchmarks train the main loss only and want
+a deterministic forward (dropout would also require threading an rng
+through every apply). NHWC, bf16 compute, BN without scale (gamma)
+as in the canonical TF-slim model.
 """
 
 from __future__ import annotations
