@@ -382,8 +382,11 @@ void Controller::RunCoordinatorCycle() {
     }
     // Quiescence gate (see SetQuiescence): while the fully-ready set
     // is still growing, hold the cut so a submission storm agrees as
-    // ONE stable-composition batch — unless enough bytes are ready to
-    // fill the fusion threshold anyway.
+    // ONE stable-composition batch — unless some single fuse key has
+    // enough ready bytes to fill the fusion threshold anyway. Per-KEY,
+    // not whole-set: a cut only fuses one key, so a mixed-key backlog
+    // must not release the hold when no single batch would fill the
+    // threshold.
     bool hold = false;
     int q = quiesce_cycles_.load();
     if (q > 0 && !ready_order_.empty()) {
@@ -394,12 +397,16 @@ void Controller::RunCoordinatorCycle() {
         ++quiesce_stable_;
       }
       if (quiesce_stable_ < q) {
-        int64_t ready_bytes = 0;
+        std::map<std::string, int64_t> key_bytes;
+        int64_t max_key_bytes = 0;
         for (const auto& nm : ready_order_) {
           auto it = tensors_.find(nm);
-          if (it != tensors_.end()) ready_bytes += it->second.nbytes;
+          if (it == tensors_.end()) continue;
+          int64_t& b = key_bytes[FuseKey(it->second.sig)];
+          b += it->second.nbytes;
+          if (b > max_key_bytes) max_key_bytes = b;
         }
-        hold = ready_bytes < fusion_threshold_.load();
+        hold = max_key_bytes < fusion_threshold_.load();
       }
     }
     if (!hold) {

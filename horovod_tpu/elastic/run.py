@@ -43,36 +43,50 @@ def _reinitialize() -> None:
     """
     basics.shutdown()
     from .worker import refresh_env_from_rendezvous
+    # The override below is scoped to the re-init loop and restored
+    # afterwards so later inits see the caller's value. NOT defaulted
+    # to HOROVOD_START_TIMEOUT: the elastic driver spawns workers with
+    # HOROVOD_START_TIMEOUT=elastic_timeout (600 s), which would make a
+    # single stuck attempt eat the whole retry deadline — the short
+    # per-attempt bound is what makes churn re-polling converge.
+    user_start_timeout = os.environ.get("HOROVOD_START_TIMEOUT")
     attempt_timeout = os.environ.get("HOROVOD_ELASTIC_INIT_TIMEOUT",
                                      "120")
     deadline = time.time() + float(
         os.environ.get("HOROVOD_ELASTIC_TIMEOUT", "600"))
-    while True:
-        try:
-            refresh_env_from_rendezvous()
-            os.environ["HOROVOD_START_TIMEOUT"] = attempt_timeout
-            basics.init()
-            return
-        except SystemExit:
-            raise  # removed by resize: clean exit, not a retry
-        except Exception as e:
-            basics.shutdown()
-            # A failed basics.init can leave jax.distributed
-            # initialized without basics owning it (init raised after
-            # the coordination service came up); force the teardown or
-            # every retry dies on "initialize should only be called
-            # once". Idempotent no-op when already down.
+    try:
+        while True:
             try:
-                import jax
-                jax.distributed.shutdown()
-            except Exception:  # pragma: no cover - best effort
-                pass
-            if time.time() > deadline:
-                raise
-            hlog.warning(
-                "elastic: re-init attempt failed (%s); re-polling the "
-                "rendezvous for a fresh assignment", e)
-            time.sleep(1.0)
+                refresh_env_from_rendezvous()
+                os.environ["HOROVOD_START_TIMEOUT"] = attempt_timeout
+                basics.init()
+                return
+            except SystemExit:
+                raise  # removed by resize: clean exit, not a retry
+            except Exception as e:
+                basics.shutdown()
+                # A failed basics.init can leave jax.distributed
+                # initialized without basics owning it (init raised
+                # after the coordination service came up); force the
+                # teardown or every retry dies on "initialize should
+                # only be called once". Idempotent no-op when already
+                # down.
+                try:
+                    import jax
+                    jax.distributed.shutdown()
+                except Exception:  # pragma: no cover - best effort
+                    pass
+                if time.time() > deadline:
+                    raise
+                hlog.warning(
+                    "elastic: re-init attempt failed (%s); re-polling "
+                    "the rendezvous for a fresh assignment", e)
+                time.sleep(1.0)
+    finally:
+        if user_start_timeout is None:
+            os.environ.pop("HOROVOD_START_TIMEOUT", None)
+        else:
+            os.environ["HOROVOD_START_TIMEOUT"] = user_start_timeout
 
 
 def run(func: Callable) -> Callable:

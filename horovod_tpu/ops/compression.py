@@ -75,6 +75,23 @@ def wire_dtype_of(compression, dtype) -> jnp.dtype:
     return dt
 
 
+def compressor_for(raw_dtype, wire_dtype):
+    """The Compressor class whose compress() maps `raw_dtype` to
+    `wire_dtype`. Used by joined ranks to reconstruct the live ranks'
+    compressor from the negotiated signature so a zero-fill entry
+    lowers the identical fused program (same compress cast) the live
+    ranks do."""
+    raw, wire = jnp.dtype(raw_dtype), jnp.dtype(wire_dtype)
+    if wire == raw:
+        return NoneCompressor
+    if wire == jnp.float16:
+        return FP16Compressor
+    if wire == jnp.bfloat16:
+        return BF16Compressor
+    raise ValueError(
+        f"no compressor maps {raw} to wire dtype {wire}")
+
+
 class Compression:
     """Namespace matching hvd.Compression."""
     none = NoneCompressor

@@ -12,7 +12,7 @@ order — the core does — which is what relaxes JAX's same-program-order
 requirement to Horovod's "submit whenever ready" contract.
 
 Signature format (the Request metadata; reference: message.fbs):
-  allreduce:  "ar|<wiredtype>|<op>|<pset>|<pre>|<post>#s0xs1,...;..."
+  allreduce:  "ar|<wiredtype>|<rawdtype>|<op>|<pset>|<pre>|<post>#s0xs1,...;..."
   broadcast:  "bc|<dtype>|<root>|<pset>#s0xs1..."
   allgather:  "ag|<dtype>|<pset>#r0xr1..."  (trailing dims only; the
               per-rank first-dim size rides the Request meta)
@@ -59,24 +59,29 @@ class JoinError(RuntimeError):
     pass
 
 
-def allreduce_sig(wire_dtype, shapes_list, rop: int, pset_id: int,
-                  prescale: float, postscale: float) -> str:
+def allreduce_sig(wire_dtype, raw_dtype, shapes_list, rop: int,
+                  pset_id: int, prescale: float, postscale: float) -> str:
     """Fuse key + shape metadata. `wire_dtype` is the ON-WIRE dtype
     (after compression) — computed WITHOUT casting; the cast itself
-    runs inside the fused dispatch kernel."""
+    runs inside the fused dispatch kernel. `raw_dtype` (the submitted
+    tensors' dtype) rides the key too so a joined rank can zero-fill
+    raw-dtype tensors and lower the IDENTICAL fused program the live
+    ranks do (the compress cast included) — wire-dtype-only zero-fill
+    made ranks jit different programs around one collective."""
     shapes = ";".join(
         "x".join(str(d) for d in s) for s in shapes_list)
-    return (f"ar|{jnp.dtype(wire_dtype)}|{rop}|{pset_id}|{prescale}|"
-            f"{postscale}#{shapes}")
+    return (f"ar|{jnp.dtype(wire_dtype)}|{jnp.dtype(raw_dtype)}|{rop}|"
+            f"{pset_id}|{prescale}|{postscale}#{shapes}")
 
 
 def parse_allreduce_sig(sig: str):
     head, shapes = sig.split("#", 1)
-    _, dt, rop, pset_id, pre, post = head.split("|")
+    _, wire_dt, raw_dt, rop, pset_id, pre, post = head.split("|")
     shape_list = []
     for s in shapes.split(";"):
         shape_list.append(tuple(int(d) for d in s.split("x") if d))
-    return dt, int(rop), int(pset_id), float(pre), float(post), shape_list
+    return (wire_dt, raw_dt, int(rop), int(pset_id), float(pre),
+            float(post), shape_list)
 
 
 class _PendingAllreduce:
@@ -192,13 +197,20 @@ class PythonCore:
                 # Quiescence batching (native-core SetQuiescence
                 # analog): keep lingering while the queue is still
                 # growing so a submission storm cuts as ONE
-                # stable-composition batch — unless enough bytes are
-                # already pending to fill the fusion threshold (the
-                # same escape the C++ coordinator applies).
+                # stable-composition batch — unless some single fuse
+                # key already has enough bytes to fill the fusion
+                # threshold (the same escape the C++ coordinator
+                # applies). Per-KEY, not whole-queue: a cut only fuses
+                # one key, so a mixed-key backlog must not release the
+                # hold when no single batch would fill the threshold.
                 tick = max(self.cycle_time_ms, 1.0) / 1e3
                 stable, last = 0, len(self._pending)
                 while not self._shutdown and stable < self.quiesce:
-                    if sum(nb for _, nb in self._pending) >= \
+                    per_key: Dict[str, int] = {}
+                    for e, nb in self._pending:
+                        k = e.sig.split("#", 1)[0]
+                        per_key[k] = per_key.get(k, 0) + nb
+                    if per_key and max(per_key.values()) >= \
                             self.fusion_threshold:
                         break
                     self._cv.wait(tick)
@@ -353,7 +365,8 @@ class NegotiatedController:
         from .compression import wire_dtype_of
         tensors = [jnp.asarray(t) for t in tensors]
         wire_dt = wire_dtype_of(compression, tensors[0].dtype)
-        sig = allreduce_sig(wire_dt, [t.shape for t in tensors], rop,
+        sig = allreduce_sig(wire_dt, tensors[0].dtype,
+                            [t.shape for t in tensors], rop,
                             pset.process_set_id, prescale, postscale)
         nbytes = int(sum(np.prod(t.shape) for t in tensors)
                      ) * wire_dt.itemsize
@@ -682,12 +695,22 @@ class NegotiatedController:
     def _execute_allreduce_batch(self, entries):
         """One fused launch for the whole agreed batch (the fusion
         buffer analog: same fuse key == same dtype/op/pset/scales)."""
-        dt, rop, pset_id, pre, post, _ = parse_allreduce_sig(
-            entries[0].sig)
+        wire_dt, raw_dt, rop, pset_id, pre, post, _ = \
+            parse_allreduce_sig(entries[0].sig)
         pset = self.engine.pset_table.get(pset_id)
         active = entries[0].active_ranks
 
-        from .compression import NoneCompressor
+        from .compression import compressor_for
+        # Zero-fill compressor reconstructed ONCE, outside the pop
+        # loop: if it cannot be reconstructed (a custom compressor's
+        # wire dtype no built-in maps to), every handle in the batch
+        # must error cleanly — raising mid-loop would strand
+        # already-popped handles in synchronize() forever.
+        zcomp, zcomp_err = None, None
+        try:
+            zcomp = compressor_for(raw_dt, wire_dt)
+        except ValueError as ex:
+            zcomp_err = ex
         tensors = []
         compressors = []
         slots = []   # (entry, pending|None, count)
@@ -696,12 +719,25 @@ class NegotiatedController:
                 p = self._pending.pop(e.name, None)
             if p is None:
                 # joined rank: participate with zeros of the agreed
-                # shapes, ALREADY in wire dtype (reference: JoinOp
-                # zero contribution).
-                _, _, _, _, _, shapes = parse_allreduce_sig(e.sig)
-                zeros = [jnp.zeros(s, dt) for s in shapes]
+                # shapes in the RAW dtype, compressed by the same
+                # compressor class the live ranks use, so every rank
+                # lowers the identical fused kernel (reference: JoinOp
+                # zero contribution; multi-controller JAX requires the
+                # same program on every rank).
+                if zcomp is None:
+                    for _, pp, _ in slots:
+                        if pp is not None:
+                            pp.handle.set_error(zcomp_err)
+                    for e2 in entries:
+                        with self._mu:
+                            p2 = self._pending.pop(e2.name, None)
+                        if p2 is not None:
+                            p2.handle.set_error(zcomp_err)
+                    return
+                _, _, _, _, _, _, shapes = parse_allreduce_sig(e.sig)
+                zeros = [jnp.zeros(s, raw_dt) for s in shapes]
                 tensors.extend(zeros)
-                compressors.extend([NoneCompressor] * len(zeros))
+                compressors.extend([zcomp] * len(zeros))
                 slots.append((e, None, len(zeros)))
             else:
                 tensors.extend(p.tensors)

@@ -194,6 +194,20 @@ def main():
         last = hvd.join()
     else:
         out = hvd.allreduce(jnp.full((3,), 10.0), name="after_join_1")
+        # join + COMPRESSION: rank 1 zero-fills this entry from the
+        # negotiated sig alone. The sig carries the raw dtype, so the
+        # joined rank lowers the identical fused program (fp32 zeros +
+        # the same fp16 compress/decompress casts) the live rank does —
+        # wire-dtype-only zero-fill made ranks jit DIFFERENT programs
+        # around one collective (round-3 advisory, medium).
+        outc = hvd.allreduce(jnp.full((5,), 6.0, jnp.float32),
+                             name="after_join_fp16", op=hvd.Sum,
+                             compression=hvd.Compression.fp16)
+        # every rank but the joined rank 1 contributes 6.0
+        np.testing.assert_allclose(np.asarray(outc),
+                                   np.full(5, 6.0 * (n - 1)))
+        assert outc.dtype == jnp.float32, outc.dtype
+        print(f"rank {r}: join+compression zero-fill OK")
         # join-aware Average: only rank 0 contributes once others join.
         # (rank 1 may or may not have joined yet when this reduces; the
         # sum of contributions is 10 either way it is divided by the
