@@ -98,6 +98,7 @@ def init(config_overrides: Optional[Dict[str, Any]] = None,
         # because shutdown() early-returns while !initialized.
         from ..ops import dispatch as _dispatch
         _dispatch.set_alltoall_mode(cfg.alltoall_mode)
+        _dispatch.set_span_devices(cfg.eager_span_devices)
         _state._owns_distributed = _ensure_distributed(cfg)
         _state.topology = detect(cfg)
         hlog.set_rank(_state.topology.rank)
@@ -194,6 +195,7 @@ def shutdown() -> None:
         from ..ops import dispatch as _dispatch
         _dispatch.set_hierarchical(0)
         _dispatch.set_alltoall_mode("auto")
+        _dispatch.set_span_devices("auto")
 
 
 atexit.register(shutdown)

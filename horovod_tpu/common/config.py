@@ -66,6 +66,15 @@ KNOBS: List[Knob] = [
     # TPU analog — XLA fuses bucket gather/scatter copies and owns the
     # launch lanes. Deliberately NOT declared: a knob that silently
     # does nothing is worse than an unknown-variable warning.)
+    Knob("HOROVOD_EAGER_SPAN_DEVICES", str, "auto",
+         "Device-spanning eager data plane (no reference analog — the "
+         "reference runs one rank per accelerator): when member "
+         "processes own several chips, shard each fused allreduce "
+         "bucket across ALL local chips (each chip reduces 1/D over "
+         "its own ICI links, then an intra-host all_gather "
+         "reassembles). 'auto' (default) enables it for payloads "
+         "large enough to split; 1 forces, 0 keeps the one-"
+         "representative-device-per-process mesh."),
     Knob("HOROVOD_ALLTOALL_MODE", str, "auto",
          "alltoallv exchange layout: 'padded' = one all_to_all padded "
          "to the global max split (n*max wire bytes); 'ragged' = "
@@ -210,6 +219,7 @@ class Config:
         "autotune_steps_per_sample": "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE",
         "adasum_pallas": "HOROVOD_ADASUM_PALLAS",
         "alltoall_mode": "HOROVOD_ALLTOALL_MODE",
+        "eager_span_devices": "HOROVOD_EAGER_SPAN_DEVICES",
         "order_check": "HOROVOD_ORDER_CHECK",
         "stall_check_disable": "HOROVOD_STALL_CHECK_DISABLE",
         "stall_check_time": "HOROVOD_STALL_CHECK_TIME_SECONDS",

@@ -75,6 +75,28 @@ def process_device(process_index: int) -> jax.Device:
     return min(devs, key=lambda d: d.id)
 
 
+def process_local_devices(process_index: int) -> List[jax.Device]:
+    """ALL devices owned by a process, in id order. Row material for
+    the device-spanning eager mesh (see ProcessSet.device_mesh)."""
+    devs = [d for d in jax.devices() if d.process_index == process_index]
+    if not devs:
+        raise RuntimeError(f"no devices for process {process_index}")
+    return sorted(devs, key=lambda d: d.id)
+
+
+def device_matrix(ranks: List[int]):
+    """(len(ranks), D) grid of EVERY device of every member process
+    (row r = process ranks[r]'s devices in id order), or None when
+    members own differing device counts (a device-spanning mesh needs
+    a rectangle). numpy object array, ready for jax.sharding.Mesh."""
+    import numpy as np
+    rows = [process_local_devices(r) for r in ranks]
+    width = len(rows[0])
+    if any(len(r) != width for r in rows):
+        return None
+    return np.array(rows)
+
+
 def process_mesh_devices(ranks: Optional[List[int]] = None) -> List[jax.Device]:
     """One device per process, in rank order (optionally a subset)."""
     n = jax.process_count()

@@ -11,13 +11,16 @@ subset collective only involves the member processes.
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 from jax.sharding import Mesh
 
 from ..common import logging as hlog
-from ..common.topology import Topology, process_mesh_devices
+from ..common.topology import (Topology, device_matrix,
+                               process_mesh_devices)
+
+_UNSET = object()
 
 
 class ProcessSet:
@@ -29,6 +32,9 @@ class ProcessSet:
         self.ranks: List[int] = sorted(int(r) for r in ranks)
         self.process_set_id: Optional[int] = None
         self._mesh: Optional[Mesh] = None
+        self._device_mesh: Any = _UNSET
+        self._local_device_row: Any = _UNSET
+        self._local_mesh: Any = _UNSET
         self._table: Optional["ProcessSetTable"] = None
 
     # -- membership ----------------------------------------------------------
@@ -63,6 +69,49 @@ class ProcessSet:
     @property
     def my_device(self) -> jax.Device:
         return self.mesh.devices.flat[self.rank()]
+
+    @property
+    def device_mesh(self) -> Optional[Mesh]:
+        """('proc', 'dev') mesh over EVERY device of every member
+        process — the device-spanning eager data plane (round-3
+        verdict: the classic eager API must own all local chips, not
+        one representative per process; reference contract is one rank
+        per accelerator, SURVEY.md §0). None when members own a single
+        device each (the representative mesh already spans everything)
+        or differing device counts (no rectangle)."""
+        if self._device_mesh is _UNSET:
+            rows = device_matrix(self.ranks)
+            if rows is None or rows.shape[1] == 1:
+                self._device_mesh = None
+            else:
+                self._device_mesh = Mesh(rows,
+                                         axis_names=("proc", "dev"))
+        return self._device_mesh
+
+    @property
+    def local_device_row(self):
+        """This process's row of device_mesh (its local devices in the
+        mesh's order); None when device_mesh is None or this process
+        is not a member."""
+        if self._local_device_row is _UNSET:
+            dm = self.device_mesh
+            me = self.rank()
+            self._local_device_row = (
+                None if dm is None or me < 0
+                else list(dm.devices[me]))
+        return self._local_device_row
+
+    @property
+    def local_device_mesh(self) -> Optional[Mesh]:
+        """1-D ('dev',) mesh over local_device_row, cached — it sits
+        on the wide allreduce's per-batch hot path (the bucket scatter
+        across local chips) and is invariant for the set."""
+        if self._local_mesh is _UNSET:
+            row = self.local_device_row
+            import numpy as np
+            self._local_mesh = (None if row is None else
+                                Mesh(np.array(row), axis_names=("dev",)))
+        return self._local_mesh
 
     def __repr__(self):
         return (f"ProcessSet(id={self.process_set_id}, ranks={self.ranks})")

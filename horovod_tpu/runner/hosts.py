@@ -49,6 +49,58 @@ class RankInfo:
         }
 
 
+# Per-chip launch mode (reference contract: one rank per accelerator,
+# SURVEY.md §0 / hard-part #4 "rank != device"). libtpu multi-process-
+# per-host env: each slot sees exactly one chip, and the processes of
+# a slice coordinate through TPU_PROCESS_ADDRESSES. Port base mirrors
+# libtpu's default.
+TPU_PORT_BASE = 8476
+
+# Default process-grid guesses per world size (x,y,z). Physical ICI
+# topology varies by TPU generation; override with
+# HOROVOD_TPU_PROCESS_BOUNDS when the guess doesn't match (e.g. v5p's
+# 3-D torus).
+_PROCESS_BOUNDS_DEFAULT = {
+    1: "1,1,1", 2: "2,1,1", 4: "2,2,1", 8: "2,4,1", 16: "4,4,1",
+    32: "4,8,1", 64: "8,8,1",
+}
+
+
+def per_chip_env(info: RankInfo, all_infos: List["RankInfo"],
+                 process_bounds: Optional[str] = None,
+                 chips_per_process_bounds: Optional[str] = None,
+                 port_base: int = TPU_PORT_BASE) -> dict:
+    """TPU chip-pinning env for one slot under --per-chip: the slot's
+    process sees ONE chip (rank == accelerator, as the reference's
+    gloo_run per-rank env gives each rank one GPU, SURVEY.md §3.4).
+    Both TPU_VISIBLE_CHIPS and TPU_VISIBLE_DEVICES are set — libtpu
+    versions differ on the name; the unused one is ignored.
+
+    The job's slots are assumed to form ONE slice (the hvdrun -H
+    contract lists the slice's hosts); TPU_PROCESS_ADDRESSES lists
+    every slot host:port in rank order so the per-process TPU runtimes
+    can rendezvous."""
+    import os as _os
+    nproc = len(all_infos)
+    bounds = (process_bounds
+              or _os.environ.get("HOROVOD_TPU_PROCESS_BOUNDS")
+              or _PROCESS_BOUNDS_DEFAULT.get(nproc, f"{nproc},1,1"))
+    chips = (chips_per_process_bounds
+             or _os.environ.get("HOROVOD_TPU_CHIPS_PER_PROCESS_BOUNDS")
+             or "1,1,1")
+    addrs = ",".join(f"{i.host}:{port_base + i.local_rank}"
+                     for i in all_infos)
+    return {
+        "TPU_VISIBLE_CHIPS": str(info.local_rank),
+        "TPU_VISIBLE_DEVICES": str(info.local_rank),
+        "TPU_CHIPS_PER_PROCESS_BOUNDS": chips,
+        "TPU_PROCESS_BOUNDS": bounds,
+        "TPU_PROCESS_ADDRESSES": addrs,
+        "TPU_PROCESS_PORT": str(port_base + info.local_rank),
+        "CLOUD_TPU_TASK_ID": str(info.rank),
+    }
+
+
 def parse_hosts(hosts: Optional[str], np_: int) -> List[HostSlots]:
     """Parse "-H h1:2,h2:2"; default = all ranks on localhost."""
     if not hosts:

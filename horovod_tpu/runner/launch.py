@@ -30,7 +30,7 @@ import time
 from typing import Dict, List, Optional
 
 from . import secret as _secret
-from .hosts import RankInfo, assign_ranks, parse_hosts
+from .hosts import RankInfo, assign_ranks, parse_hosts, per_chip_env
 
 # Env vars forwarded to workers in addition to explicitly-set ones
 # (reference: mpi_run's -x passthrough list).
@@ -70,9 +70,14 @@ def _prefix_pump(stream, tag: str, sink, lock: threading.Lock):
 
 
 def build_env(info: RankInfo, coordinator: str,
-              base_env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+              base_env: Optional[Dict[str, str]] = None,
+              per_chip: bool = False,
+              all_infos: Optional[List[RankInfo]] = None
+              ) -> Dict[str, str]:
     env = dict(base_env if base_env is not None else os.environ)
     env.update(info.env())
+    if per_chip:
+        env.update(per_chip_env(info, all_infos or [info]))
     env["HOROVOD_COORDINATOR_ADDR"] = coordinator
     return env
 
@@ -127,6 +132,7 @@ def run(command: List[str], np_: int = 1, hosts: Optional[str] = None,
         output_filename: Optional[str] = None,
         ssh_port: Optional[int] = None,
         start_timeout: float = 30.0,
+        per_chip: bool = False,
         verbose: bool = False) -> int:
     """Programmatic launch API (reference: horovod.run()). Returns the
     job's exit code (first nonzero child, else 0)."""
@@ -161,7 +167,8 @@ def run(command: List[str], np_: int = 1, hosts: Optional[str] = None,
     job_secret = _secret.make_secret()
     try:
         for info in infos:
-            child_env = build_env(info, coordinator, env)
+            child_env = build_env(info, coordinator, env,
+                                  per_chip=per_chip, all_infos=infos)
             child_env["HOROVOD_CONTROL_ADDR"] = control
             child_env["HOROVOD_START_TIMEOUT"] = str(start_timeout)
             child_env[_secret.ENV_VAR] = job_secret
@@ -243,6 +250,7 @@ def run_with_driver(command: List[str], np_: int = 1,
                     ssh_port: Optional[int] = None,
                     start_timeout: float = 30.0,
                     network_interfaces: Optional[List[str]] = None,
+                    per_chip: bool = False,
                     verbose: bool = False) -> int:
     """Probed launch path (reference: horovodrun's default flow through
     driver_service.py): start a task service on every host, wait for
@@ -316,6 +324,8 @@ def run_with_driver(command: List[str], np_: int = 1,
         for info in infos:
             child = dict(base)
             child.update(info.env())
+            if per_chip:
+                child.update(per_chip_env(info, infos))
             child["HOROVOD_COORDINATOR_ADDR"] = coordinator
             child["HOROVOD_CONTROL_ADDR"] = control
             child["HOROVOD_START_TIMEOUT"] = str(start_timeout)
@@ -378,6 +388,13 @@ def make_parser() -> argparse.ArgumentParser:
                         "candidate addresses and the driver's own "
                         "(reference: horovodrun --network-interface); "
                         "no effect without --driver")
+    p.add_argument("--per-chip", action="store_true",
+                   help="pin ONE TPU chip per slot (rank == chip, the "
+                        "reference's one-rank-per-accelerator "
+                        "contract): sets TPU_VISIBLE_CHIPS / "
+                        "TPU_PROCESS_BOUNDS / TPU_PROCESS_ADDRESSES "
+                        "per rank; grid override via "
+                        "HOROVOD_TPU_PROCESS_BOUNDS")
     p.add_argument("--driver", action="store_true",
                    help="launch through per-host task services with "
                         "NIC routability probing (reference: the "
@@ -548,6 +565,9 @@ def main(argv: Optional[List[str]] = None) -> int:
             print("warning: --network-interfaces only affects the "
                   "probed launch path; add --driver (ignored on the "
                   "plain ssh path)", file=sys.stderr)
+    if args.per_chip and args.host_discovery_script:
+        print("warning: --per-chip is not supported on the elastic "
+              "path and will be ignored", file=sys.stderr)
     if args.host_discovery_script:
         from .elastic import ElasticDriver, HostDiscoveryScript
         min_np = args.min_num_proc if args.min_num_proc is not None \
@@ -568,9 +588,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             env=env, output_filename=args.output_filename,
             ssh_port=args.ssh_port,
             start_timeout=args.start_timeout,
-            network_interfaces=nics, verbose=args.verbose)
+            network_interfaces=nics, per_chip=args.per_chip,
+            verbose=args.verbose)
     return run(command, np_=args.num_proc, hosts=args.hosts,
                env=env,
                output_filename=args.output_filename,
                ssh_port=args.ssh_port,
-               start_timeout=args.start_timeout, verbose=args.verbose)
+               start_timeout=args.start_timeout,
+               per_chip=args.per_chip, verbose=args.verbose)

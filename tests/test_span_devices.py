@@ -1,0 +1,89 @@
+"""The eager data plane must span every local device (round-3 verdict
+Missing #1): multi-process runs where each process owns SEVERAL
+devices — the CPU stand-in for multi-chip TPU hosts — plus the
+launcher's per-chip pinning env (tested as string construction, the
+reference's own launcher test technique, SURVEY.md §4 item 4)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.integration
+@pytest.mark.parametrize("np_,devs", [(2, 2), (8, 2)])
+def test_eager_span_devices(np_, devs):
+    """`hvd.allreduce` reduces over (processes x local devices): the
+    wide mesh covers every device and the summed payload is exact."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devs}"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", str(np_),
+         sys.executable, os.path.join("tests", "mp_worker_span.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + "\n" + r.stderr
+    assert r.stdout.count("SPAN ALL OK") == np_
+
+
+class TestPerChipLaunchEnv:
+    """Per-chip launch mode: the launcher pins one chip per slot so
+    rank == accelerator, the reference's contract (SURVEY.md §0,
+    hard-part #4). No TPU hosts in CI — assert the env construction."""
+
+    def make_infos(self, hosts, np_):
+        from horovod_tpu.runner.hosts import assign_ranks, parse_hosts
+        return assign_ranks(parse_hosts(hosts, np_), np_)
+
+    def test_single_host_four_chips(self):
+        from horovod_tpu.runner.hosts import per_chip_env
+        infos = self.make_infos("localhost:4", 4)
+        env = per_chip_env(infos[1], infos)
+        assert env["TPU_VISIBLE_CHIPS"] == "1"
+        assert env["TPU_VISIBLE_DEVICES"] == "1"
+        assert env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,1,1"
+        assert env["TPU_PROCESS_BOUNDS"] == "2,2,1"
+        assert env["CLOUD_TPU_TASK_ID"] == "1"
+        assert env["TPU_PROCESS_PORT"] == "8477"  # base + local_rank
+        assert env["TPU_PROCESS_ADDRESSES"] == (
+            "localhost:8476,localhost:8477,"
+            "localhost:8478,localhost:8479")
+
+    def test_two_hosts_eight_chips(self):
+        from horovod_tpu.runner.hosts import per_chip_env
+        infos = self.make_infos("h1:4,h2:4", 8)
+        env = per_chip_env(infos[5], infos)  # rank 5 = h2 slot 1
+        assert env["TPU_VISIBLE_CHIPS"] == "1"
+        assert env["CLOUD_TPU_TASK_ID"] == "5"
+        assert env["TPU_PROCESS_BOUNDS"] == "2,4,1"
+        assert env["TPU_PROCESS_ADDRESSES"] == (
+            "h1:8476,h1:8477,h1:8478,h1:8479,"
+            "h2:8476,h2:8477,h2:8478,h2:8479")
+        assert env["TPU_PROCESS_PORT"] == "8477"
+
+    def test_bounds_override(self):
+        from horovod_tpu.runner.hosts import per_chip_env
+        infos = self.make_infos("localhost:4", 4)
+        env = per_chip_env(infos[0], infos,
+                           process_bounds="4,1,1",
+                           chips_per_process_bounds="1,1,1")
+        assert env["TPU_PROCESS_BOUNDS"] == "4,1,1"
+
+    def test_launcher_flag_injects_env(self):
+        """--per-chip threads the pinning env into each child's env."""
+        from horovod_tpu.runner import launch
+        from horovod_tpu.runner.hosts import assign_ranks, parse_hosts
+        infos = assign_ranks(parse_hosts("localhost:2", 2), 2)
+        env = launch.build_env(infos[1], "localhost:1234",
+                               base_env={}, per_chip=True,
+                               all_infos=infos)
+        assert env["TPU_VISIBLE_CHIPS"] == "1"
+        assert env["HOROVOD_RANK"] == "1"
+        # without the flag, no TPU pinning vars appear
+        env2 = launch.build_env(infos[1], "localhost:1234", base_env={})
+        assert "TPU_VISIBLE_CHIPS" not in env2
