@@ -99,6 +99,8 @@ def init(config_overrides: Optional[Dict[str, Any]] = None,
         from ..ops import dispatch as _dispatch
         _dispatch.set_alltoall_mode(cfg.alltoall_mode)
         _dispatch.set_span_devices(cfg.eager_span_devices)
+        from ..ops import adasum as _adasum
+        _adasum.set_adasum_mode(cfg.adasum_mode)
         _state._owns_distributed = _ensure_distributed(cfg)
         _state.topology = detect(cfg)
         hlog.set_rank(_state.topology.rank)
@@ -196,6 +198,8 @@ def shutdown() -> None:
         _dispatch.set_hierarchical(0)
         _dispatch.set_alltoall_mode("auto")
         _dispatch.set_span_devices("auto")
+        from ..ops import adasum as _adasum
+        _adasum.set_adasum_mode("auto")
 
 
 atexit.register(shutdown)

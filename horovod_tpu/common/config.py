@@ -81,10 +81,22 @@ KNOBS: List[Knob] = [
          "shift-round ppermutes with per-round bucketed maxima (wire "
          "bytes track the real split matrix — the MPI_Alltoallv exact-"
          "counts analog); 'auto' picks ragged for skewed routing."),
+    Knob("HOROVOD_ADASUM_MODE", str, "auto",
+         "Adasum exchange schedule: 'vhdd' = recursive vector-halving/"
+         "distance-doubling (log2(n) ppermute rounds, O(bucket) wire "
+         "and HBM per rank — the reference's adasum.h schedule; "
+         "power-of-two sets only); 'gather' = one all_gather + local "
+         "binary-tree fold (O(n*bucket) per rank, any size); 'auto' "
+         "(default) = vhdd when the set size is a power of two "
+         "(complex dtypes and a forced HOROVOD_ADASUM_PALLAS=1 fall "
+         "back to gather; an explicit vhdd outranks the pallas "
+         "force)."),
     Knob("HOROVOD_ADASUM_PALLAS", str, "auto",
          "Adasum pair-combine implementation: 'auto' = fused Pallas "
          "kernel on TPU / plain jnp elsewhere; 1 forces the Pallas "
-         "path (interpreter off-TPU), 0 forces jnp."),
+         "path (interpreter off-TPU; under HOROVOD_ADASUM_MODE=auto "
+         "this also selects the gather schedule, the only one running "
+         "the Pallas pair-combine), 0 forces jnp."),
     # -- controller / backends ----------------------------------------------
     Knob("HOROVOD_CONTROLLER", str, "auto",
          "Control-plane implementation: 'native' (C++ core), 'python' "
@@ -217,6 +229,7 @@ class Config:
         "autotune_mode": "HOROVOD_AUTOTUNE_MODE",
         "autotune_warmup_samples": "HOROVOD_AUTOTUNE_WARMUP_SAMPLES",
         "autotune_steps_per_sample": "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE",
+        "adasum_mode": "HOROVOD_ADASUM_MODE",
         "adasum_pallas": "HOROVOD_ADASUM_PALLAS",
         "alltoall_mode": "HOROVOD_ALLTOALL_MODE",
         "eager_span_devices": "HOROVOD_EAGER_SPAN_DEVICES",

@@ -220,6 +220,31 @@ def test_reducescatter_uneven(eight_device_mesh):
         assert got_rows[i].shape[0] == maxr
 
 
+def test_reducescatter_group_fused(eight_device_mesh):
+    """Fused rs group: mixed shapes (even + uneven first dims) in one
+    launch; each rank's trimmed block matches the per-tensor rule."""
+    mesh = eight_device_mesh
+    rng = np.random.RandomState(6)
+    a = rng.randn(N, 16, 2).astype(np.float32)   # even: 2 rows each
+    b = rng.randn(N, 11).astype(np.float32)      # uneven: (2,2,2,1,...)
+    sig = dispatch._sig([jnp.asarray(a[0]), jnp.asarray(b[0])])
+    rows = (dispatch.reducescatter_rows(16, N),
+            dispatch.reducescatter_rows(11, N))
+    kern = dispatch._reducescatter_group_kernel(
+        mesh, N, SUM, 1.0, 1.0, rows, sig)
+    out_a, out_b = kern(make_global(mesh, a), make_global(mesh, b))
+    ta, tb = a.sum(0), b.sum(0)
+    offs_a = np.concatenate([[0], np.cumsum(rows[0])])
+    offs_b = np.concatenate([[0], np.cumsum(rows[1])])
+    for i, (ga, gb) in enumerate(zip(rows_of(out_a), rows_of(out_b))):
+        np.testing.assert_allclose(
+            ga[:rows[0][i]], ta[offs_a[i]:offs_a[i] + rows[0][i]],
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            gb[:rows[1][i]], tb[offs_b[i]:offs_b[i] + rows[1][i]],
+            rtol=1e-5)
+
+
 def test_adasum_kernel_matches_numpy(eight_device_mesh):
     from horovod_tpu.ops.adasum import _adasum_kernel, adasum_reference
     mesh = eight_device_mesh
@@ -231,6 +256,80 @@ def test_adasum_kernel_matches_numpy(eight_device_mesh):
     want = adasum_reference([xs[i] for i in range(N)])
     for got in rows_of(out):
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+class TestAdasumVHDD:
+    """The scalable halving-doubling schedule (reference: adasum.h
+    DispatchFusedAllreduce) must match both the numpy oracle and the
+    gather+fold kernel, and its per-rank wire must not scale with n."""
+
+    def submesh(self, mesh, n):
+        from jax.sharding import Mesh
+        return Mesh(mesh.devices.flat[:n], axis_names=("proc",))
+
+    @pytest.mark.parametrize("n", [2, 4, 8])
+    def test_matches_oracle_and_fold(self, eight_device_mesh, n):
+        from horovod_tpu.ops.adasum import (_adasum_kernel,
+                                            _adasum_kernel_vhdd,
+                                            adasum_reference)
+        mesh = self.submesh(eight_device_mesh, n)
+        rng = np.random.RandomState(7 + n)
+        xs = rng.randn(n, 37).astype(np.float32)  # odd length: pads
+        sig = dispatch._sig([jnp.asarray(xs[0])])
+        (out_v,) = _adasum_kernel_vhdd(mesh, n, sig)(
+            make_global(mesh, xs))
+        (out_g,) = _adasum_kernel(mesh, n, sig)(make_global(mesh, xs))
+        want = adasum_reference([xs[i] for i in range(n)])
+        got_v = [np.asarray(s.data[0]) for s in sorted(
+            out_v.addressable_shards, key=lambda s: s.index[0].start)]
+        got_g = [np.asarray(s.data[0]) for s in sorted(
+            out_g.addressable_shards, key=lambda s: s.index[0].start)]
+        for gv, gg in zip(got_v, got_g):
+            np.testing.assert_allclose(gv, want, rtol=1e-4, atol=1e-5)
+            np.testing.assert_allclose(gv, gg, rtol=1e-4, atol=1e-5)
+
+    def test_grouped_tensors(self, eight_device_mesh):
+        from horovod_tpu.ops.adasum import (_adasum_kernel_vhdd,
+                                            adasum_reference)
+        n = 4
+        mesh = self.submesh(eight_device_mesh, n)
+        rng = np.random.RandomState(11)
+        a = rng.randn(n, 5).astype(np.float32)
+        b = rng.randn(n, 3, 2).astype(np.float32)
+        sig = dispatch._sig([jnp.asarray(a[0]), jnp.asarray(b[0])])
+        out_a, out_b = _adasum_kernel_vhdd(mesh, n, sig)(
+            make_global(mesh, a), make_global(mesh, b))
+        # fused: the fold runs over the CONCATENATED bucket
+        flat = [np.concatenate([a[i].ravel(), b[i].ravel()])
+                for i in range(n)]
+        want = adasum_reference(flat)
+        got_a = np.asarray(out_a.addressable_shards[0].data[0])
+        got_b = np.asarray(out_b.addressable_shards[0].data[0])
+        np.testing.assert_allclose(got_a, want[:5].reshape(5),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(got_b, want[5:].reshape(3, 2),
+                                   rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("n", [4, 8])
+    def test_wire_does_not_scale_with_n(self, eight_device_mesh, n):
+        """Per-rank collective payloads are O(bucket), independent of
+        n: no all-gather of the (n, total) stack anywhere in the
+        program, and the largest collective message is bucket/2."""
+        import re
+        from horovod_tpu.ops.adasum import _adasum_kernel_vhdd
+        total = 4096
+        mesh = self.submesh(eight_device_mesh, n)
+        sig = dispatch._sig([jnp.zeros((total,), jnp.float32)])
+        kern = _adasum_kernel_vhdd(mesh, n, sig)
+        txt = kern.lower(
+            jax.ShapeDtypeStruct((n, total), jnp.float32)).as_text()
+        assert "all_gather" not in txt and "all-gather" not in txt, \
+            "vhdd must not gather the full contribution stack"
+        # collective_permute payload widths: f32<K> operands
+        sizes = [int(m) for m in re.findall(
+            r"collective_permute.*?tensor<(\d+)xf32>", txt)]
+        assert sizes, "expected ppermute exchanges in the program"
+        assert max(sizes) <= total // 2, sizes
 
 
 def test_adasum_orthogonal_is_sum():
