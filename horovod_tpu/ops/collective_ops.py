@@ -389,6 +389,18 @@ def reducescatter_async(tensor, op=None, name: Optional[str] = None,
     name = name or st.engine.auto_name("reducescatter")
     t = jnp.asarray(tensor)
     _check_inexact_for_average(rop, [t])
+    # No pre-submit shape raise here: raising on one rank after peers
+    # already submitted would hang them in negotiation. Shape errors
+    # surface AFTER agreement (sig mismatch -> error entries on every
+    # rank; uniform-but-too-small first dims raise in the fused
+    # kernel, delivered to every handle).
+
+    ctl = _controller_for(st, pset)
+    if ctl is not None:
+        # Fusable negotiation key (rs|dtype|op|pset|scales): same-key
+        # submissions agreed together run as ONE psum_scatter launch.
+        return ctl.submit_reducescatter(
+            name, t, pset, rop, prescale_factor, postscale_factor).id
 
     def fn():
         return dispatch.reducescatter(t, pset, rop, prescale_factor,

@@ -1047,13 +1047,103 @@ def reducescatter(tensor: jax.Array, pset: ProcessSet, op: int,
     if d0 < n:
         raise ValueError(
             f"reducescatter needs first dim >= set size ({d0} < {n})")
-    base, rem = divmod(d0, n)
-    rows = tuple(base + (1 if i < rem else 0) for i in range(n))
+    rows = reducescatter_rows(d0, n)
     kern = _reducescatter_kernel(pset.mesh, n, op, float(prescale),
                                  float(postscale), rows, _sig([x]))
     out = local_shard(kern(to_global(x, pset)))
     my_rows = rows[pset.rank()]
     return out[:my_rows]
+
+
+@functools.lru_cache(maxsize=None)
+def _reducescatter_group_kernel(mesh, n: int, op: int, prescale: float,
+                                postscale: float,
+                                rows_per_tensor: Tuple[Tuple[int, ...],
+                                                       ...],
+                                sig: Tuple):
+    """Fused reduce-scatter of a same-dtype/op group in ONE collective
+    launch (reference: controller.cc FuseResponses packs same-type
+    reducescatter responses into the fusion buffer too). Layout: the
+    packed buffer is DESTINATION-major — [rank0's rows of t0, rank0's
+    rows of t1, ..., rank1's rows of t0, ...], each tensor's chunk
+    padded to its per-rank row maximum so every destination block has
+    identical size — then one tiled psum_scatter hands each rank its
+    block. Outputs come back padded to maxr; the caller trims to the
+    rank's true rows (same contract as _reducescatter_kernel)."""
+    shapes = [s for s, _ in sig]
+    rests = [int(np.prod(s[1:])) if len(s) > 1 else 1 for s in shapes]
+    maxrs = [max(rv) for rv in rows_per_tensor]
+    offsets = [np.concatenate([[0], np.cumsum(rv)]).tolist()
+               for rv in rows_per_tensor]
+
+    def body(*blocks):
+        xs = [b[0] for b in blocks]
+        segs = []
+        for dest in range(n):
+            for t, x in enumerate(xs):
+                rv = rows_per_tensor[t]
+                c = x[offsets[t][dest]:offsets[t][dest] + rv[dest]]
+                if rv[dest] < maxrs[t]:
+                    pad_cfg = [(0, maxrs[t] - rv[dest])] + \
+                        [(0, 0)] * (x.ndim - 1)
+                    c = jnp.pad(c, pad_cfg)
+                segs.append(c.reshape(-1))
+        buf = jnp.concatenate(segs)
+        if prescale != 1.0:
+            buf = buf * jnp.asarray(prescale, buf.dtype)
+        red = lax.psum_scatter(buf, "proc", scatter_dimension=0,
+                               tiled=True)
+        if op == AVERAGE:
+            red = red / jnp.asarray(n, red.dtype)
+        if postscale != 1.0:
+            red = red * jnp.asarray(postscale, red.dtype)
+        outs = []
+        off = 0
+        for t, s in enumerate(shapes):
+            sz = maxrs[t] * rests[t]
+            outs.append(red[off:off + sz].reshape(
+                (1, maxrs[t]) + tuple(s[1:])))
+            off += sz
+        return tuple(outs)
+
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=tuple(P("proc") for _ in sig),
+                       out_specs=tuple(P("proc") for _ in sig))
+    return jax.jit(fn)
+
+
+def reducescatter_rows(d0: int, n: int) -> Tuple[int, ...]:
+    """The reference's uneven sizing rule: first dim split across
+    ranks with the remainder going to low ranks."""
+    base, rem = divmod(d0, n)
+    return tuple(base + (1 if i < rem else 0) for i in range(n))
+
+
+def reducescatter_group(tensors: List[jax.Array], pset: ProcessSet,
+                        op: int, prescale: float = 1.0,
+                        postscale: float = 1.0) -> List[jax.Array]:
+    """Fused reduce-scatter of a group; each output is this rank's
+    trimmed row block of the corresponding reduction."""
+    xs = [_as_local(t) for t in tensors]
+    n = pset.size
+    if n == 1:
+        scale = prescale * postscale
+        return [x * jnp.asarray(scale, x.dtype) if scale != 1.0 else x
+                for x in xs]
+    for x in xs:
+        if x.shape[0] < n:
+            raise ValueError(
+                f"reducescatter needs first dim >= set size "
+                f"({x.shape[0]} < {n})")
+    rows = tuple(reducescatter_rows(x.shape[0], n) for x in xs)
+    kern = _reducescatter_group_kernel(pset.mesh, n, op,
+                                       float(prescale),
+                                       float(postscale), rows,
+                                       _sig(xs))
+    gouts = kern(*[to_global(x, pset) for x in xs])
+    me = pset.rank()
+    return [local_shard(g)[:rows[t][me]]
+            for t, g in enumerate(gouts)]
 
 
 def barrier(pset: ProcessSet) -> None:

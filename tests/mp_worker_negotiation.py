@@ -186,6 +186,42 @@ def main():
     print(f"rank {r}: allgather fusion OK "
           f"({ag_entries} entries in {ag_batches} batch(es))")
 
+    # 3.9) fused reducescatters: same dtype/op submitted together
+    # agree as batches and execute as ONE psum_scatter launch each
+    # (rs|... fuse key; reference: FuseResponses packs same-type
+    # reducescatter responses too). Mixed first dims fuse — the group
+    # kernel tracks per-tensor row splits.
+    rs0 = list(ctl.exec_counts.get("rs", [0, 0]))
+    d0s = [n * 2, n * 2 + 1, n * 3, n * 2, n * 2 + 3, n * 2]
+    # tensors built BEFORE the submit loop: the storm must be tight or
+    # the coordinator legitimately cuts single-entry batches between
+    # slow submissions (this asserts fusion, not pacing).
+    vals = [jnp.arange(d0s[i] * 2, dtype=jnp.float32
+                       ).reshape(d0s[i], 2) + float(r + i)
+            for i in range(6)]
+    hs = [hvd.reducescatter_async(vals[i], op=hvd.Sum,
+                                  name=f"rs_fuse_{i}")
+          for i in range(6)]
+    for i, h in enumerate(hs):
+        full = sum(np.arange(d0s[i] * 2, dtype=np.float32
+                             ).reshape(d0s[i], 2) + float(rr + i)
+                   for rr in range(n))
+        base, rem = divmod(d0s[i], n)
+        rows = [base + (1 if j < rem else 0) for j in range(n)]
+        off = sum(rows[:r])
+        np.testing.assert_allclose(
+            np.asarray(hvd.synchronize(h)), full[off:off + rows[r]],
+            rtol=1e-5)
+    rs1 = ctl.exec_counts["rs"]
+    rs_batches = rs1[0] - rs0[0]
+    rs_entries = rs1[1] - rs0[1]
+    assert rs_entries == 6, (rs0, rs1)
+    assert rs_batches < rs_entries, (
+        f"reducescatters never fused: {rs_batches} batches for "
+        f"{rs_entries} entries")
+    print(f"rank {r}: reducescatter fusion OK "
+          f"({rs_entries} entries in {rs_batches} batch(es))")
+
     # 4) join: rank 1 joins immediately; rank 0 keeps reducing, then
     # proves a generic op agreed while a rank has joined gets a CLEAN
     # error (reference: join unsupported for non-allreduce ops) —
