@@ -79,6 +79,21 @@ def make_flagship(mesh: Mesh,
                                   tp=tp, ep=ep)
 
     p_specs = flagship_param_specs(cfg, mesh)
+    from ..parallel.mesh import FSDP_AXIS
+    fsdp_n = mesh.shape.get(FSDP_AXIS, 1)
+    if fsdp_n > 1:
+        # ZeRO-3 on the explicit path, composable with tp/sp/ep: every
+        # parameter's largest unsharded dim shards over fsdp; the
+        # train step gathers it back inside the differentiated loss
+        # (so the transpose is the gradient reduce-scatter) while the
+        # tensor-parallel dims stay sharded for the model's own
+        # collectives (parallel/fsdp.py add_fsdp_to_spec).
+        from ..parallel.fsdp import add_fsdp_to_spec
+        import numpy as _np
+        p_specs = jax.tree.map(
+            lambda s, p: add_fsdp_to_spec(s, _np.shape(p), fsdp_n),
+            p_specs, params_host,
+            is_leaf=lambda x: isinstance(x, P))
     p_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), p_specs,
                                is_leaf=lambda x: isinstance(x, P))
     params = jax.tree.map(jax.device_put, params_host, p_shardings)

@@ -31,15 +31,30 @@ def zero3_spec(shape, n: int, axis: str = FSDP_AXIS) -> P:
     """Shard the largest dimension divisible by `n` over `axis`
     (earliest wins ties); fully replicated when nothing divides —
     small scalars/norm vectors aren't worth a gather."""
-    best = -1
-    best_size = 0
-    for i, d in enumerate(shape):
-        if d % n == 0 and d >= n and d > best_size:
-            best, best_size = i, d
-    if best < 0:
-        return P()
-    parts = [None] * len(shape)
-    parts[best] = axis
+    return add_fsdp_to_spec(P(), shape, n, axis)
+
+
+def add_fsdp_to_spec(spec: P, shape, n: int,
+                     axis: str = FSDP_AXIS) -> P:
+    """Compose ZeRO-3 with an existing (model-parallel) spec: shard
+    the largest still-unsharded dim divisible by `n` over `axis`,
+    leaving tensor/expert/seq dims untouched. Used by the explicit-
+    collective flagship path, where the train step all-gathers the
+    fsdp axis inside the differentiated loss (parallel/train.py
+    _fsdp_gather_fn) so the model still sees full values on those
+    dims while tp collectives run on the still-sharded ones."""
+    parts = list(spec) + [None] * (len(shape) - len(spec))
+    taken = any(axis == e or (isinstance(e, tuple) and axis in e)
+                for e in parts)
+    best, best_size = -1, 0
+    if not taken:
+        for i, (d, e) in enumerate(zip(shape, parts)):
+            if e is None and d % n == 0 and d >= n and d > best_size:
+                best, best_size = i, d
+    if best >= 0:
+        parts[best] = axis
+    while parts and parts[-1] is None:
+        parts.pop()
     return P(*parts)
 
 

@@ -29,8 +29,60 @@ from jax import lax
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .mesh import batch_axes
+from .mesh import FSDP_AXIS, batch_axes
 from .sharding import Rules, replicated
+
+
+def _fsdp_gather_fn(param_specs, mesh):
+    """ZeRO-3 on the explicit-collective path: returns a pytree map
+    that all_gathers every fsdp-sharded parameter dim over the `fsdp`
+    axis (tiled, in-place dim). Running it INSIDE the differentiated
+    loss means JAX's transpose turns each gather into the
+    psum_scatter of the gradients — the all-gather(param)/
+    reduce-scatter(grad) ZeRO schedule, hand-derived here exactly
+    where the GSPMD path lets XLA derive it. Composes with tp/sp/ep:
+    only the fsdp axis is gathered, model-parallel dims stay sharded
+    for the model's own collectives. None when the mesh doesn't carry
+    a live fsdp axis or no spec names it."""
+    if mesh.shape.get(FSDP_AXIS, 1) <= 1:
+        return None
+
+    def dims_of(spec):
+        out = []
+        if not isinstance(spec, P):
+            return out
+        for d, entry in enumerate(spec):
+            names = entry if isinstance(entry, tuple) else (entry,)
+            if FSDP_AXIS in names:
+                if names[0] != FSDP_AXIS:
+                    raise ValueError(
+                        f"fsdp must be the major axis of a combined "
+                        f"dim sharding to gather in place, got {spec}")
+                out.append(d)
+        return out
+
+    any_fsdp = any(
+        dims_of(s) for s in jax.tree.leaves(
+            param_specs, is_leaf=lambda x: isinstance(x, P)))
+    if not any_fsdp:
+        return None
+
+    def gather(params):
+        def one(p, spec):
+            for d in dims_of(spec):
+                p = lax.all_gather(p, FSDP_AXIS, axis=d, tiled=True)
+            return p
+        return jax.tree.map(one, params,
+                            _broadcast_specs(param_specs, params))
+
+    return gather
+
+
+def _broadcast_specs(specs, tree):
+    """Expand a single P into a per-leaf spec tree when needed."""
+    if isinstance(specs, P):
+        return jax.tree.map(lambda _: specs, tree)
+    return specs
 
 
 def _psum_axes(x, axes: Tuple[str, ...]):
@@ -136,12 +188,19 @@ def build_train_step(
         return jax.tree.map(
             lambda g: g * jnp.asarray(inv, g.dtype), grads)
 
+    # ZeRO-3 leg of the explicit path: gather fsdp-sharded params
+    # inside the differentiated region (transpose = grad scatter).
+    fsdp_gather = _fsdp_gather_fn(param_specs, mesh)
+    eff_loss = (loss_fn if fsdp_gather is None else
+                (lambda params, batch: loss_fn(fsdp_gather(params),
+                                               batch)))
+
     def local_step(params, opt_state, batch):
         if loss_has_aux:
             (loss, aux), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(params, batch)
+                eff_loss, has_aux=True)(params, batch)
         else:
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            loss, grads = jax.value_and_grad(eff_loss)(params, batch)
             aux = None
         grads = reduce_grads(grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)

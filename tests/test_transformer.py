@@ -132,6 +132,42 @@ class TestFSDP:
         assert "all-gather" in hlo or "all-gather-start" in hlo, \
             hlo[:2000]
 
+    def test_fsdp_x_tp_explicit_path(self):
+        """fsdp AND tensor both live on the explicit-collective path
+        (round-3 verdict Next #5): parameters shard over fsdp, the
+        step all-gathers them inside the differentiated region (so
+        the transpose is the grad reduce-scatter), tp collectives run
+        as usual — and one SGD step equals the single-device oracle."""
+        mesh = build_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+        cfg, params, opt_state, step = flagship.make_flagship(
+            mesh, SMALL, optax.sgd(0.5))
+        # params actually sharded over fsdp
+        assert TestFSDP._has_fsdp(params["embed"].sharding.spec), \
+            params["embed"].sharding
+        # and the compiled step contains fsdp collectives
+        batch_host = make_host_batch(cfg, 8, 32)
+        from jax.sharding import NamedSharding
+        sh = NamedSharding(mesh, flagship.batch_spec(mesh))
+        batch = {k: jax.device_put(v, sh)
+                 for k, v in batch_host.items()}
+        hlo = step.lower(params, opt_state, batch).compile().as_text()
+        assert "all-gather" in hlo or "all-gather-start" in hlo
+
+        params_host = jax.tree.map(np.asarray, jax.device_get(params))
+        new_params, _, metrics = step(params, opt_state, batch)
+
+        # oracle: replicated single-program SGD step on the host params
+        def mean_loss(p):
+            return oracle_loss(cfg, p, batch_host)
+        l0, g = jax.value_and_grad(mean_loss)(params_host)
+        np.testing.assert_allclose(float(metrics["loss"]), float(l0),
+                                   rtol=1e-4, atol=1e-4)
+        want = jax.tree.map(lambda p, gg: p - 0.5 * gg, params_host, g)
+        got = jax.tree.map(np.asarray, jax.device_get(new_params))
+        jax.tree.map(
+            lambda w, o: np.testing.assert_allclose(
+                o, w, rtol=2e-3, atol=2e-4), want, got)
+
     def test_fsdp_step_matches_replicated(self):
         """One SGD step under ZeRO-3 sharding must equal the
         single-device full-batch step: fsdp changes layout, never
