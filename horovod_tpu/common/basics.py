@@ -99,6 +99,11 @@ def init(config_overrides: Optional[Dict[str, Any]] = None,
         from ..ops import dispatch as _dispatch
         _dispatch.set_alltoall_mode(cfg.alltoall_mode)
         _dispatch.set_span_devices(cfg.eager_span_devices)
+        _dispatch.set_launch_profile(
+            overhead_s=(cfg.launch_overhead_us / 1e6
+                        if cfg.launch_overhead_us >= 0 else None),
+            bytes_per_s=cfg.wire_bytes_per_sec,
+            max_rounds=cfg.alltoall_max_rounds)
         from ..ops import adasum as _adasum
         _adasum.set_adasum_mode(cfg.adasum_mode)
         _state._owns_distributed = _ensure_distributed(cfg)
@@ -198,6 +203,7 @@ def shutdown() -> None:
         _dispatch.set_hierarchical(0)
         _dispatch.set_alltoall_mode("auto")
         _dispatch.set_span_devices("auto")
+        _dispatch.set_launch_profile(None, 4e10, 16)
         from ..ops import adasum as _adasum
         _adasum.set_adasum_mode("auto")
 

@@ -81,6 +81,21 @@ KNOBS: List[Knob] = [
          "shift-round ppermutes with per-round bucketed maxima (wire "
          "bytes track the real split matrix — the MPI_Alltoallv exact-"
          "counts analog); 'auto' picks ragged for skewed routing."),
+    Knob("HOROVOD_LAUNCH_OVERHEAD_US", float, -1.0,
+         "Per-XLA-launch dispatch overhead (microseconds) used by the "
+         "alltoall auto heuristic's cost model. -1 (default) measures "
+         "it once per process with a few tiny dispatches; pin it for "
+         "deterministic decisions (0 = byte-only comparison)."),
+    Knob("HOROVOD_WIRE_BYTES_PER_SEC", float, 4e10,
+         "Assumed collective wire rate (bytes/s) for the alltoall "
+         "auto cost model; only its ratio to the launch overhead "
+         "matters."),
+    Knob("HOROVOD_ALLTOALL_MAX_ROUNDS", int, 16,
+         "Auto mode never picks the ragged alltoall when it would "
+         "need more than this many ppermute rounds (n-1 launches "
+         "dominate on high-latency hosts regardless of byte "
+         "savings); forced HOROVOD_ALLTOALL_MODE=ragged ignores the "
+         "cap."),
     Knob("HOROVOD_ADASUM_MODE", str, "auto",
          "Adasum exchange schedule: 'vhdd' = recursive vector-halving/"
          "distance-doubling (log2(n) ppermute rounds, O(bucket) wire "
@@ -233,6 +248,9 @@ class Config:
         "adasum_pallas": "HOROVOD_ADASUM_PALLAS",
         "alltoall_mode": "HOROVOD_ALLTOALL_MODE",
         "eager_span_devices": "HOROVOD_EAGER_SPAN_DEVICES",
+        "launch_overhead_us": "HOROVOD_LAUNCH_OVERHEAD_US",
+        "wire_bytes_per_sec": "HOROVOD_WIRE_BYTES_PER_SEC",
+        "alltoall_max_rounds": "HOROVOD_ALLTOALL_MAX_ROUNDS",
         "order_check": "HOROVOD_ORDER_CHECK",
         "stall_check_disable": "HOROVOD_STALL_CHECK_DISABLE",
         "stall_check_time": "HOROVOD_STALL_CHECK_TIME_SECONDS",

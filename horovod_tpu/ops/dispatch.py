@@ -651,10 +651,73 @@ def _ppermute_shift_kernel(mesh, n: int, shift: int, sig: Tuple):
 # alltoall split-exchange mode (HOROVOD_ALLTOALL_MODE): "padded" = one
 # all_to_all padded to the global max split; "ragged" = n-1 ppermute
 # rounds with per-round bucketed maxima (wire bytes track the real
-# split matrix, not n * global-max); "auto" picks ragged when the
-# split matrix is skewed enough that it moves < 3/4 of the padded
-# bytes despite the extra launches.
+# split matrix, not n * global-max); "auto" models BOTH costs — wire
+# bytes AND per-launch overhead (the dominant cost on a high-latency
+# host, where n-1 extra launches can eat any byte savings) — and
+# picks the cheaper schedule.
 _alltoall_mode = "auto"
+
+# Launch-cost profile for the auto heuristic. Overhead is MEASURED
+# lazily (one-time, ~5 tiny dispatches) unless pinned via
+# HOROVOD_LAUNCH_OVERHEAD_US; wire rate and the round cap are
+# declared knobs (a per-chip ICI link order-of-magnitude default —
+# the decision only needs the ratio overhead/rate to the right
+# order).
+_launch_overhead_s: Optional[float] = None
+_wire_bytes_per_s: float = 4e10
+_alltoall_max_rounds: int = 16
+
+
+def set_launch_profile(overhead_s: Optional[float] = None,
+                       bytes_per_s: Optional[float] = None,
+                       max_rounds: Optional[int] = None) -> None:
+    """Pin the auto-heuristic's cost model (tests, config). Passing
+    overhead_s=None re-arms the lazy measurement."""
+    global _launch_overhead_s, _wire_bytes_per_s, _alltoall_max_rounds
+    _launch_overhead_s = overhead_s
+    if bytes_per_s is not None:
+        _wire_bytes_per_s = float(bytes_per_s)
+    if max_rounds is not None:
+        _alltoall_max_rounds = int(max_rounds)
+
+
+def _measured_launch_overhead() -> float:
+    """Per-launch dispatch overhead, measured once per process with a
+    trivial compiled program (the autotuner's sampling idea applied to
+    the launch path). On a tunnel-attached host this lands in the tens
+    of milliseconds and correctly steers the heuristic to padded."""
+    global _launch_overhead_s
+    if _launch_overhead_s is not None:
+        return _launch_overhead_s
+    import time
+    f = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((8,), jnp.float32)
+    np.asarray(f(x))  # compile + settle
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        np.asarray(f(x))  # force completion (block_until_ready is
+        #                   unreliable on tunnel transports)
+    _launch_overhead_s = (time.perf_counter() - t0) / reps
+    return _launch_overhead_s
+
+
+def _choose_alltoall_path(n: int, buckets: Sequence[int],
+                          padded_rows: int, row_bytes: int) -> bool:
+    """True = ragged. Cost model per rank: ragged pays one launch per
+    nonzero round plus its bucketed bytes; padded pays one launch
+    plus n*maxsplit bytes. The round cap guards mismeasured overhead
+    at large n, where the linear launch count is the known wall
+    (this host's measured benches: launch count dominates)."""
+    if n - 1 > _alltoall_max_rounds:
+        return False
+    rounds = sum(1 for b in buckets if b > 0)
+    L = _measured_launch_overhead()
+    bw = _wire_bytes_per_s
+    ragged_rows = int(sum(buckets))
+    t_ragged = rounds * L + ragged_rows * row_bytes / bw
+    t_padded = L + padded_rows * row_bytes / bw
+    return t_ragged < t_padded
 
 # Introspection for tests/benchmarks: rows moved by the last alltoall
 # on this rank vs what the padded kernel would have moved.
@@ -999,11 +1062,16 @@ def alltoall(tensor: jax.Array, splits: Sequence[int],
         buckets = _ragged_round_buckets(matrix)
         # Every rank moves the same padded volume per round (SPMD), so
         # the rank-level comparison is global: ragged moves
-        # sum(buckets) rows/rank vs the padded kernel's n * maxsplit.
+        # sum(buckets) rows/rank vs the padded kernel's n * maxsplit —
+        # but also pays one LAUNCH per round, which the cost model
+        # weighs against the byte savings (see _choose_alltoall_path).
         ragged_rows = int(sum(buckets))
         padded_rows = n * int(maxsplit)
+        row_bytes = int(np.prod(rest)) * jnp.dtype(x.dtype).itemsize \
+            if rest else jnp.dtype(x.dtype).itemsize
         use_ragged = (_alltoall_mode == "ragged"
-                      or ragged_rows * 4 < padded_rows * 3)
+                      or _choose_alltoall_path(n, buckets, padded_rows,
+                                               row_bytes))
         _last_alltoall_stats.update(
             path="ragged" if use_ragged else "padded",
             wire_rows=ragged_rows if use_ragged else padded_rows,

@@ -10,6 +10,10 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 # under conftest.py's jax_enable_x64).
 os.environ.setdefault("JAX_ENABLE_X64", "1")
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+# Pin the launch-overhead term to zero so the skewed-alltoall phase
+# asserts the BYTE side of the auto heuristic deterministically (the
+# launch-aware side is unit-tested in test_dispatch_kernels).
+os.environ.setdefault("HOROVOD_LAUNCH_OVERHEAD_US", "0")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
