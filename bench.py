@@ -130,20 +130,28 @@ def _resolve_baseline(metric: str):
         if baseline is not None:
             return baseline
     here = os.path.dirname(os.path.abspath(__file__))
-    for fname in sorted(os.listdir(here)):
-        if fname.startswith("BENCH_r") and fname.endswith(".json"):
-            try:
-                with open(os.path.join(here, fname)) as f:
-                    doc = json.load(f)
-                rec = doc.get("parsed") or {}
-                if rec.get("metric") == metric:
-                    baseline = float(rec["value"])
-                    log(f"bench: vs_baseline uses {fname} "
-                        f"({baseline:.1f})")
-                    return baseline
-            except (OSError, ValueError, KeyError, TypeError,
-                    AttributeError):
-                continue
+    candidates = [os.path.join(here, f) for f in sorted(os.listdir(here))
+                  if f.startswith("BENCH_r") and f.endswith(".json")]
+    bdir = os.path.join(here, "benchmarks")
+    if os.path.isdir(bdir):
+        # Builder-recorded per-model artifacts (the driver snapshots
+        # only carry the headline resnet metric).
+        candidates += [os.path.join(bdir, f)
+                       for f in sorted(os.listdir(bdir))
+                       if f.startswith("BENCH_") and f.endswith(".json")]
+    for path in candidates:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            rec = doc.get("parsed") or {}
+            if rec.get("metric") == metric:
+                baseline = float(rec["value"])
+                log(f"bench: vs_baseline uses "
+                    f"{os.path.basename(path)} ({baseline:.1f})")
+                return baseline
+        except (OSError, ValueError, KeyError, TypeError,
+                AttributeError):
+            continue
     return None
 
 
@@ -173,10 +181,19 @@ def eager_main(model_name: str = "resnet50"):
         gap vs grouped is the measured argument for why the TPU eager
         API defaults to grouped submission (docs/benchmarks.md).
     """
-    batch_per_chip = int(os.environ.get("BENCH_BATCH", "128"))
+    transformer = model_name == "transformer"
+    batch_per_chip = int(os.environ.get(
+        "BENCH_BATCH", "16" if transformer else "128"))
     steps = int(os.environ.get("BENCH_STEPS", "60"))
     warmup = int(os.environ.get("BENCH_WARMUP", "5"))
     image = int(os.environ.get("BENCH_IMAGE", "224"))
+    seq = int(os.environ.get("BENCH_SEQ", "512"))
+    # BASELINE.md config 4 (Llama-class DP + Adasum + fp16): op=Adasum
+    # routes every grouped/hook submission through the negotiated
+    # Adasum path (vhdd schedule multi-rank; single-rank it still
+    # exercises the wire compression round-trip).
+    adasum = ("--eager-adasum" in sys.argv or
+              os.environ.get("BENCH_EAGER_OP", "") == "adasum")
 
     # Force the full negotiation stack even at size 1 (auto mode would
     # inline-dispatch): native core, response cache, fusion.
@@ -210,7 +227,21 @@ def eager_main(model_name: str = "resnet50"):
         f"native_available={_native.available()} size={hvd.size()}")
 
     vgg = model_name == "vgg16"
-    if vgg:
+    tfm_cfg = None
+    if transformer:
+        # BASELINE.md config 3 (BERT-Large-class fp16+fusion stress)
+        # on the EAGER path: same dims/optimizer as the jit
+        # transformer bench so the gap is directly comparable.
+        from horovod_tpu.models import transformer as tfm
+        tfm_cfg = tfm.TransformerConfig(
+            vocab=32768, d_model=1024, n_layers=24, n_heads=16,
+            n_kv_heads=16, head_dim=64, d_ff=4096, max_seq=seq,
+            moe=False, dtype=jnp.bfloat16, remat=True,
+            tp_axis=None, sp_axis=None, ep_axis=None)
+        params = tfm.init_params(tfm_cfg, jax.random.PRNGKey(0))
+        batch_stats = {}
+        model = None
+    elif vgg:
         # Multi-fusion-batch stress: ~276 MB fp16 wire/step spans
         # several 64 MiB fusion buffers per cycle.
         from horovod_tpu.models.vgg import create_vgg16, init_vgg
@@ -226,6 +257,11 @@ def eager_main(model_name: str = "resnet50"):
                                variables["batch_stats"])
 
     def loss_fn(params, batch_stats, images, labels):
+        if transformer:
+            from horovod_tpu.models import transformer as tfm
+            loss = tfm.loss_fn(tfm_cfg, params,
+                               {"tokens": images, "targets": labels})
+            return loss, {}
         if vgg:
             logits = model.apply({"params": params}, images,
                                  train=True)
@@ -242,7 +278,8 @@ def eager_main(model_name: str = "resnet50"):
 
     grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=True))
 
-    opt = optax.sgd(0.0125 * hvd.size(), momentum=0.9)
+    opt = (optax.adamw(1e-4) if transformer
+           else optax.sgd(0.0125 * hvd.size(), momentum=0.9))
     opt_state = opt.init(params)
 
     flat0, treedef = jax.tree_util.tree_flatten_with_path(params)
@@ -260,13 +297,21 @@ def eager_main(model_name: str = "resnet50"):
         return optax.apply_updates(params, updates), opt_state
 
     rng = np.random.default_rng(0)
-    images = jnp.asarray(
-        rng.standard_normal((batch_per_chip, image, image, 3),
-                            dtype=np.float32))
-    labels = jnp.asarray(
-        rng.integers(0, 1000, batch_per_chip), jnp.int32)
+    if transformer:
+        tokens = jnp.asarray(
+            rng.integers(0, tfm_cfg.vocab, (batch_per_chip, seq)),
+            jnp.int32)
+        images, labels = tokens, jnp.roll(tokens, -1, axis=1)
+    else:
+        images = jnp.asarray(
+            rng.standard_normal((batch_per_chip, image, image, 3),
+                                dtype=np.float32))
+        labels = jnp.asarray(
+            rng.integers(0, 1000, batch_per_chip), jnp.int32)
 
-    log(f"bench[eager]: mode={'hooks' if hooks_mode else 'grouped'}")
+    rop = hvd.Adasum if adasum else None
+    log(f"bench[eager]: mode={'hooks' if hooks_mode else 'grouped'}"
+        f" op={'Adasum' if adasum else 'Average'}")
 
     phase_times = os.environ.get("BENCH_PHASE_TIMES")
 
@@ -281,7 +326,7 @@ def eager_main(model_name: str = "resnet50"):
             handles = [None] * n_leaves
             for i in range(n_leaves - 1, -1, -1):
                 handles[i] = C.allreduce_async(
-                    leaves[i], name=names[i],
+                    leaves[i], name=names[i], op=rop,
                     compression=Compression.fp16)
             t2 = time.perf_counter()
             reduced = [C.synchronize(h) for h in handles]
@@ -295,7 +340,7 @@ def eager_main(model_name: str = "resnet50"):
             # composition, response-cache-friendly stable name).
             reduced = C.grouped_allreduce(
                 leaves, name="DistributedOptimizer.grouped_allreduce",
-                compression=Compression.fp16)
+                op=rop, compression=Compression.fp16)
         params, opt_state = apply_fn(params, opt_state, reduced)
         return params, opt_state, batch_stats, loss
 
@@ -322,9 +367,14 @@ def eager_main(model_name: str = "resnet50"):
     final_loss = float(loss)
     dt = time.perf_counter() - t0
 
-    img_sec_chip = batch_per_chip * steps / dt
+    if transformer:
+        rate = batch_per_chip * seq * steps / dt
+        unit = "tokens/sec/chip"
+    else:
+        rate = batch_per_chip * steps / dt
+        unit = "img/sec/chip"
     log(f"bench[eager]: {steps} steps in {dt:.2f}s -> "
-        f"{img_sec_chip:.1f} img/sec/chip loss={final_loss:.3f}")
+        f"{rate:.1f} {unit} loss={final_loss:.3f}")
     if ctl is not None:
         cyc = ctl.core.cycles() - cycles0
         cb = ctl.core.control_bytes() - ctrl0
@@ -332,17 +382,25 @@ def eager_main(model_name: str = "resnet50"):
         log(f"bench[eager]: negotiation cycles={cyc} "
             f"({cyc / max(steps, 1):.1f}/step) control_bytes={cb} "
             f"({cb / max(steps, 1):.0f}/step) exec_counts={counts}")
-    mname = "vgg16" if vgg else "resnet50"
-    jit_ref = _resolve_baseline(
-        f"{mname}_synthetic_train_img_sec_per_chip")
+    if transformer:
+        jit_metric = "flagship_transformer_tok_sec_per_chip"
+        mname = "flagship_transformer"
+    else:
+        mname = "vgg16" if vgg else "resnet50"
+        jit_metric = f"{mname}_synthetic_train_img_sec_per_chip"
+    jit_ref = _resolve_baseline(jit_metric)
     if jit_ref:
-        log(f"bench[eager]: eager/jit gap: {img_sec_chip:.1f} vs "
-            f"{jit_ref:.1f} jit-path = {img_sec_chip / jit_ref:.3f}x")
-    vs = img_sec_chip / jit_ref if jit_ref else 1.0
+        log(f"bench[eager]: eager/jit gap: {rate:.1f} vs "
+            f"{jit_ref:.1f} jit-path = {rate / jit_ref:.3f}x")
+    vs = rate / jit_ref if jit_ref else 1.0
+    suffix = "_adasum" if adasum else ""
+    metric = (f"flagship_transformer_eager{suffix}_tok_sec_per_chip"
+              if transformer else
+              f"{mname}_synthetic_eager{suffix}_img_sec_per_chip")
     print(json.dumps({
-        "metric": f"{mname}_synthetic_eager_img_sec_per_chip",
-        "value": round(img_sec_chip, 2),
-        "unit": "img/sec/chip",
+        "metric": metric,
+        "value": round(rate, 2),
+        "unit": unit,
         "vs_baseline": round(vs, 4),
     }), flush=True)
 
@@ -471,7 +529,11 @@ def main(model_name: str = "resnet50"):
         # (docs/benchmarks.rst: Inception V3 ~90% scaling).
         from horovod_tpu.models.inception import (create_inception_v3,
                                                   init_inception)
-        model = create_inception_v3(dtype=jnp.bfloat16)
+        s2d = os.environ.get("BENCH_INCEPTION_S2D", "") == "1"
+        if s2d:
+            log("bench: inception stem_s2d=1 (space-to-depth stem "
+                "experiment — see models/inception.py)")
+        model = create_inception_v3(dtype=jnp.bfloat16, stem_s2d=s2d)
         variables = init_inception(model, jax.random.PRNGKey(0), image)
         params, batch_stats = (variables["params"],
                                variables["batch_stats"])
@@ -615,10 +677,15 @@ if __name__ == "__main__":
         model = chosen[0]
     else:
         model = "resnet50"
+    if "--eager" not in sys.argv and (
+            "--eager-hooks" in sys.argv or "--eager-adasum" in sys.argv):
+        sys.exit("bench: --eager-hooks/--eager-adasum require --eager "
+                 "(without it the jit benchmark would run and the flag "
+                 "would be silently ignored)")
     if "--eager" in sys.argv:
-        if model not in ("resnet50", "vgg16"):
-            sys.exit(f"bench: --eager supports resnet50/vgg16, "
-                     f"got {model!r}")
+        if model not in ("resnet50", "vgg16", "transformer"):
+            sys.exit(f"bench: --eager supports resnet50/vgg16/"
+                     f"transformer, got {model!r}")
         eager_main(model)
     elif model == "transformer":
         transformer_main()

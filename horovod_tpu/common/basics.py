@@ -99,9 +99,20 @@ def init(config_overrides: Optional[Dict[str, Any]] = None,
         from ..ops import dispatch as _dispatch
         _dispatch.set_alltoall_mode(cfg.alltoall_mode)
         _dispatch.set_span_devices(cfg.eager_span_devices)
+        # The alltoall auto heuristic's inputs must be IDENTICAL on
+        # every rank (divergent ragged-vs-padded choices for the same
+        # collective deadlock the gang), so the per-process launch
+        # measurement only runs single-process; multi-process worlds
+        # use the pinned knob (the launcher forwards env uniformly) or
+        # a deterministic default.
+        if cfg.launch_overhead_us >= 0:
+            overhead = cfg.launch_overhead_us / 1e6
+        elif cfg.size > 1:
+            overhead = 100e-6
+        else:
+            overhead = None  # lazy single-process measurement
         _dispatch.set_launch_profile(
-            overhead_s=(cfg.launch_overhead_us / 1e6
-                        if cfg.launch_overhead_us >= 0 else None),
+            overhead_s=overhead,
             bytes_per_s=cfg.wire_bytes_per_sec,
             max_rounds=cfg.alltoall_max_rounds)
         from ..ops import adasum as _adasum

@@ -31,16 +31,20 @@ def _reinitialize() -> None:
     before workers reach this point (reference: the updated-rendezvous
     re-poll in horovod/runner/elastic/rendezvous.py).
 
-    Re-init runs under a BOUNDED timeout and retries with a fresh
-    assignment poll: under membership churn (resize B published while
-    workers are still re-initializing for resize A) different workers
-    can transiently hold assignments from DIFFERENT epochs and wait at
-    different coordinators — unbounded waits would deadlock the gang
-    until the coordination service's own (minutes-long, fatal) barrier
-    timeout. A short timeout + re-poll converges every worker onto the
-    newest epoch instead (HOROVOD_ELASTIC_INIT_TIMEOUT, default 120s
-    per attempt; overall bound HOROVOD_ELASTIC_TIMEOUT, default 600s).
-    """
+    Re-init runs under a BOUNDED, GROWING timeout and retries with a
+    fresh assignment poll: under membership churn (resize B published
+    while workers are still re-initializing for resize A) different
+    workers can transiently hold assignments from DIFFERENT epochs and
+    wait at different coordinators — unbounded waits would deadlock
+    the gang until the coordination service's own (minutes-long,
+    fatal) barrier timeout. The first attempt is SHORT
+    (HOROVOD_ELASTIC_INIT_BASE_TIMEOUT, default 15 s) and doubles per
+    retry up to HOROVOD_ELASTIC_INIT_TIMEOUT (default 120 s): a
+    churn-stale worker abandons the wrong coordinator within seconds
+    and re-polls the newest epoch, bounding graceful-resize latency,
+    while a legitimately slow gang formation still gets the long
+    window on later attempts. Overall bound HOROVOD_ELASTIC_TIMEOUT
+    (default 600 s)."""
     basics.shutdown()
     from .worker import refresh_env_from_rendezvous
     # The override below is scoped to the re-init loop and restored
@@ -50,15 +54,21 @@ def _reinitialize() -> None:
     # single stuck attempt eat the whole retry deadline — the short
     # per-attempt bound is what makes churn re-polling converge.
     user_start_timeout = os.environ.get("HOROVOD_START_TIMEOUT")
-    attempt_timeout = os.environ.get("HOROVOD_ELASTIC_INIT_TIMEOUT",
-                                     "120")
+    base_timeout = float(os.environ.get(
+        "HOROVOD_ELASTIC_INIT_BASE_TIMEOUT", "15"))
+    max_timeout = float(os.environ.get(
+        "HOROVOD_ELASTIC_INIT_TIMEOUT", "120"))
     deadline = time.time() + float(
         os.environ.get("HOROVOD_ELASTIC_TIMEOUT", "600"))
+    attempt = 0
     try:
         while True:
             try:
                 refresh_env_from_rendezvous()
-                os.environ["HOROVOD_START_TIMEOUT"] = attempt_timeout
+                os.environ["HOROVOD_START_TIMEOUT"] = str(
+                    min(base_timeout * (2 ** min(attempt, 10)),
+                        max_timeout))
+                attempt += 1
                 basics.init()
                 return
             except SystemExit:

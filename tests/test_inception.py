@@ -61,3 +61,54 @@ def test_inception_v3_train_step_reduces_loss():
     # one step on the fixed batch reduces its loss (tiny-batch SGD
     # oscillates over longer horizons — not what this asserts)
     assert losses[1] < losses[0], losses
+
+
+def test_stem_space_to_depth_equivalence():
+    """The s2d stem transform (models/inception.py stem_s2d): a
+    stride-2 3x3 VALID conv on (H,W,3) equals a stride-1 2x2 VALID
+    conv on the 2x2 space-to-depth input when the canonical kernel is
+    embedded in the packed one (extra taps zero) — the MLPerf-style
+    conv0 transform, verified tap-for-tap."""
+    from jax import lax
+    rng = np.random.RandomState(0)
+    H = W = 11  # odd, like 299
+    x = jnp.asarray(rng.randn(2, H, W, 3).astype(np.float32))
+    k3 = jnp.asarray(rng.randn(3, 3, 3, 8).astype(np.float32))
+
+    want = lax.conv_general_dilated(
+        x, k3, window_strides=(2, 2), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    xp = jnp.pad(x, ((0, 0), (0, H % 2), (0, W % 2), (0, 0)))
+    b, h2, w2, c = xp.shape
+    z = xp.reshape(b, h2 // 2, 2, w2 // 2, 2, c)
+    z = z.transpose(0, 1, 3, 2, 4, 5).reshape(b, h2 // 2, w2 // 2,
+                                              4 * c)
+    k2 = np.zeros((2, 2, 4 * 3, 8), np.float32)
+    for di in range(3):
+        for dj in range(3):
+            u, r = di // 2, di % 2
+            v, s = dj // 2, dj % 2
+            for ch in range(3):
+                k2[u, v, (2 * r + s) * 3 + ch] = k3[di, dj, ch]
+    got = lax.conv_general_dilated(
+        z, jnp.asarray(k2), window_strides=(1, 1), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_stem_s2d_model_forward():
+    """stem_s2d=True keeps every downstream shape: logits and the
+    non-stem parameter tree match the canonical model."""
+    model = create_inception_v3(dtype=jnp.float32, stem_s2d=True)
+    variables = init_inception(model, jax.random.PRNGKey(0), 299)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 299, 299, 3))
+    logits, _ = model.apply(variables, x, train=True,
+                            mutable=["batch_stats"])
+    assert logits.shape == (2, 1000)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+    # stem conv is (2,2,12,32) instead of (3,3,3,32); everything else
+    # is unchanged
+    stem = variables["params"]["ConvBN_0"]["Conv_0"]["kernel"]
+    assert stem.shape == (2, 2, 12, 32), stem.shape
