@@ -105,9 +105,17 @@ def init(config_overrides: Optional[Dict[str, Any]] = None,
         # measurement only runs single-process; multi-process worlds
         # use the pinned knob (the launcher forwards env uniformly) or
         # a deterministic default.
+        from ..ops import adasum as _adasum
+        _adasum.set_adasum_mode(cfg.adasum_mode)
+        _state._owns_distributed = _ensure_distributed(cfg)
+        _state.topology = detect(cfg)
+        hlog.set_rank(_state.topology.rank)
+        # Launch profile AFTER topology detection: the multi-process
+        # guard must see the TRUE world size (launcher-less worlds
+        # have cfg.size == -1 but jax.process_count() > 1).
         if cfg.launch_overhead_us >= 0:
             overhead = cfg.launch_overhead_us / 1e6
-        elif cfg.size > 1:
+        elif _state.topology.size > 1:
             overhead = 100e-6
         else:
             overhead = None  # lazy single-process measurement
@@ -115,11 +123,6 @@ def init(config_overrides: Optional[Dict[str, Any]] = None,
             overhead_s=overhead,
             bytes_per_s=cfg.wire_bytes_per_sec,
             max_rounds=cfg.alltoall_max_rounds)
-        from ..ops import adasum as _adasum
-        _adasum.set_adasum_mode(cfg.adasum_mode)
-        _state._owns_distributed = _ensure_distributed(cfg)
-        _state.topology = detect(cfg)
-        hlog.set_rank(_state.topology.rank)
 
         # Process-set table (global set at slot 0), built lazily here to
         # avoid import cycles.

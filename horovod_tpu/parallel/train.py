@@ -140,9 +140,16 @@ def build_train_step(
     grad_reducer: Optional[Callable[[Any], Any]] = None,
     loss_has_aux: bool = False,
     donate: bool = True,
+    check_vma: bool = True,
 ) -> Callable:
     """Build `step(params, opt_state, batch) -> (params, opt_state,
     metrics)` as a single jitted shard_map over `mesh`.
+
+    check_vma=False disables shard_map's static replication checker —
+    required when the loss contains Pallas kernels whose pallas_call
+    cannot declare varying-mesh-axes types (e.g. the TPU flash-
+    attention kernel); out_specs correctness then rests on the
+    explicit pmeans/psums, which this builder already emits.
 
     loss_fn(params, batch) -> loss (or (loss, aux) with
     loss_has_aux=True) computes the LOCAL loss on this device's batch
@@ -217,6 +224,7 @@ def build_train_step(
         local_step, mesh=mesh,
         in_specs=(param_specs, opt_state_specs, batch_spec),
         out_specs=(param_specs, opt_state_specs, P()),
+        check_vma=check_vma,
     )
     donate_argnums = (0, 1) if donate else ()
     return jax.jit(step, donate_argnums=donate_argnums)
