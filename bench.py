@@ -438,8 +438,7 @@ def transformer_main():
     opt = optax.adamw(1e-4)
     opt_state = opt.init(params)
     from horovod_tpu.parallel.ring_attention import flash_possible_cfg
-    flash_possible = flash_possible_cfg(
-        cfg.head_dim, seq, cfg.n_kv_heads == cfg.n_heads)
+    flash_possible = flash_possible_cfg(cfg.head_dim, seq)
     step = build_train_step(
         lambda p, b: tfm.loss_fn(cfg, p, b), opt, mesh,
         batch_spec={"tokens": P("data"), "targets": P("data")},
@@ -525,7 +524,8 @@ def main(model_name: str = "resnet50"):
         f"{jax.devices()[0].platform} global_batch={global_batch} "
         f"model={model_name}")
 
-    has_bn = model_name in ("resnet50", "inception3")
+    has_bn = model_name in ("resnet50", "resnet101", "resnet152",
+                            "inception3")
     stages = os.environ.get("BENCH_RESNET_STAGES", "")
     if model_name == "inception3":
         # The lead model of the reference's benchmark table
@@ -548,6 +548,16 @@ def main(model_name: str = "resnet50"):
         model = create_vgg16(dtype=jnp.bfloat16)
         variables = init_vgg(model, jax.random.PRNGKey(0), image)
         params, batch_stats = variables["params"], {}
+    elif model_name in ("resnet101", "resnet152"):
+        # ResNet-101 is the reference benchmark table's second CNN
+        # (docs/benchmarks.rst: ~90% scaling at 128 GPUs). Checked
+        # BEFORE the BENCH_RESNET_STAGES override so a leftover
+        # reduced-stage env cannot pollute a resnet101/152 metric.
+        from horovod_tpu.models.resnet import ResNet101, ResNet152
+        cls = ResNet101 if model_name == "resnet101" else ResNet152
+        model = cls(dtype=jnp.bfloat16)
+        variables = init_resnet(model, jax.random.PRNGKey(0), image)
+        params, batch_stats = variables["params"], variables["batch_stats"]
     elif stages:
         model = _make_reduced_resnet(stages)
         variables = init_resnet(model, jax.random.PRNGKey(0), image)
@@ -692,8 +702,10 @@ if __name__ == "__main__":
         eager_main(model)
     elif model == "transformer":
         transformer_main()
-    elif model in ("resnet50", "vgg16", "inception3"):
+    elif model in ("resnet50", "resnet101", "resnet152", "vgg16",
+                   "inception3"):
         main(model)
     else:
         sys.exit(f"bench: unknown --model {model!r} (choose "
-                 "resnet50, vgg16, inception3, transformer)")
+                 "resnet50, resnet101, resnet152, vgg16, inception3, "
+                 "transformer)")

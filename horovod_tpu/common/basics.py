@@ -99,20 +99,20 @@ def init(config_overrides: Optional[Dict[str, Any]] = None,
         from ..ops import dispatch as _dispatch
         _dispatch.set_alltoall_mode(cfg.alltoall_mode)
         _dispatch.set_span_devices(cfg.eager_span_devices)
-        # The alltoall auto heuristic's inputs must be IDENTICAL on
-        # every rank (divergent ragged-vs-padded choices for the same
-        # collective deadlock the gang), so the per-process launch
-        # measurement only runs single-process; multi-process worlds
-        # use the pinned knob (the launcher forwards env uniformly) or
-        # a deterministic default.
         from ..ops import adasum as _adasum
         _adasum.set_adasum_mode(cfg.adasum_mode)
         _state._owns_distributed = _ensure_distributed(cfg)
         _state.topology = detect(cfg)
         hlog.set_rank(_state.topology.rank)
-        # Launch profile AFTER topology detection: the multi-process
-        # guard must see the TRUE world size (launcher-less worlds
-        # have cfg.size == -1 but jax.process_count() > 1).
+        # Launch profile AFTER topology detection: the alltoall auto
+        # heuristic's inputs must be IDENTICAL on every rank
+        # (divergent ragged-vs-padded choices for the same collective
+        # deadlock the gang), so the per-process launch measurement
+        # only runs single-process — and the guard must see the TRUE
+        # world size (launcher-less worlds have cfg.size == -1 but
+        # jax.process_count() > 1). Multi-process worlds use the
+        # pinned knob (the launcher forwards env uniformly) or a
+        # deterministic default.
         if cfg.launch_overhead_us >= 0:
             overhead = cfg.launch_overhead_us / 1e6
         elif _state.topology.size > 1:

@@ -1,6 +1,7 @@
 #include "controller.h"
 
 #include <algorithm>
+#include <cerrno>
 #include <random>
 #include <sstream>
 
@@ -85,7 +86,10 @@ Controller::Controller(const ControllerOptions& opts) : opts_(opts) {
       }
       worker_fds_.assign(opts_.size, -1);
       worker_claimed_.assign(opts_.size, 0);
+      pump_buf_.assign(opts_.size, std::string());
+      pump_inflight_.assign(opts_.size, 0);
       threads_.emplace_back(&Controller::ServerAcceptLoop, this);
+      threads_.emplace_back(&Controller::PumpLoop, this);
     } else {
       coord_fd_ = ConnectTo(opts_.coord_host, opts_.coord_port,
                             opts_.connect_timeout_s);
@@ -157,22 +161,20 @@ void Controller::SetError(const std::string& msg) {
 
 void Controller::Abort() {
   bool expected = false;
-  if (!shutdown_.compare_exchange_strong(expected, true)) return;
+  if (!aborting_.compare_exchange_strong(expected, true)) return;
   // Coordinator: tell workers this is a clean teardown before the
   // sockets drop, so their reader loops don't report a lost
-  // connection.
-  if (opts_.rank == 0 && !worker_fds_.empty()) {
-    std::lock_guard<std::mutex> lk(send_mu_);
-    // Snapshot under coord_mu_ (same send->coord order as
-    // BroadcastEntries): handshake threads may be publishing fds
-    // concurrently with an abort.
-    std::vector<int> fds;
-    {
-      std::lock_guard<std::mutex> clk(coord_mu_);
-      fds = worker_fds_;
-    }
-    for (int fd : fds)
-      if (fd >= 0) SendMsg(fd, MsgType::kShutdown, "");
+  // connection. The frame rides the pump like every post-handshake
+  // worker write (a direct send here could interleave with a pump
+  // write mid-frame); it is enqueued BEFORE shutdown_ is raised so
+  // the pump cannot observe empty outboxes + shutdown and exit
+  // early — it flushes these frames and THEN severs the worker fds.
+  if (opts_.rank == 0 && !worker_fds_.empty())
+    EnqueueToWorkers(BuildFrame(MsgType::kShutdown, ""));
+  shutdown_.store(true);
+  {
+    std::lock_guard<std::mutex> lk(pump_mu_);
+    pump_cv_.notify_all();
   }
   {
     std::lock_guard<std::mutex> lk(ready_mu_);
@@ -180,11 +182,6 @@ void Controller::Abort() {
   }
   if (coord_fd_ >= 0) ::shutdown(coord_fd_, SHUT_RDWR);
   if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
-  if (!worker_fds_.empty()) {
-    std::lock_guard<std::mutex> clk(coord_mu_);
-    for (int fd : worker_fds_)
-      if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
-  }
 }
 
 void Controller::Shutdown() {
@@ -209,6 +206,8 @@ void Controller::Shutdown() {
   if (listen_fd_ >= 0) ::close(listen_fd_);
   for (int fd : worker_fds_)
     if (fd >= 0) ::close(fd);
+  for (int fd : retired_fds_) ::close(fd);
+  retired_fds_.clear();
   worker_fds_.clear();
   coord_fd_ = listen_fd_ = -1;
 }
@@ -547,20 +546,180 @@ void Controller::CheckStalls(double now) {
 }
 
 void Controller::BroadcastEntries(const std::vector<Entry>& entries) {
-  std::string payload = SerializeEntries(entries);
+  // Serialize + frame ONCE; the cycle thread's cost is N memcpys
+  // into the outboxes, the pump owns the syscalls (round-3 weak #5:
+  // the serial blocking fan-out under one lock was the first wall a
+  // large-world coordinator hits).
+  EnqueueToWorkers(BuildFrame(MsgType::kResponses,
+                              SerializeEntries(entries)));
+  DeliverEntries(entries);  // rank 0's own copy
+}
+
+void Controller::EnqueueToWorkers(const std::string& frame) {
+  // Only CONNECTED workers receive this broadcast (same semantics as
+  // the old direct loop): a rank that connects later re-announces and
+  // renegotiates, it must not replay batches it never took part in.
+  //
+  // Fast path: the calling thread tries ONE non-blocking send per
+  // idle rank inline (loopback/healthy sockets complete in µs, and
+  // on a single-core coordinator this avoids a pump context switch
+  // per cut). Only backpressured tails — and ranks that already have
+  // queued bytes, to preserve per-fd frame order — go to the pump.
+  // Inline sends run under pump_mu_ with pump_inflight_[r]==0, so
+  // they can never interleave with a pump write to the same fd (the
+  // pump marks inflight under pump_mu_ before it writes).
+  std::vector<int> fds;
   {
-    std::lock_guard<std::mutex> lk(send_mu_);
-    for (int r = 1; r < opts_.size; ++r) {
-      int fd;
-      {
-        std::lock_guard<std::mutex> clk(coord_mu_);
-        fd = r < static_cast<int>(worker_fds_.size()) ? worker_fds_[r]
-                                                      : -1;
+    std::lock_guard<std::mutex> clk(coord_mu_);
+    fds = worker_fds_;
+  }
+  bool queued = false;
+  std::vector<int> severed;
+  {
+    std::lock_guard<std::mutex> lk(pump_mu_);
+    for (int r = 1; r < static_cast<int>(fds.size()); ++r) {
+      if (fds[r] < 0) continue;
+      if (pump_buf_[r].size() + pump_inflight_[r] + frame.size() >
+          kPumpCap) {
+        // Outbox cap breached: this worker has not drained ~64 MB of
+        // control traffic — it is wedged. Sever, drop its queue, and
+        // mark it dead below so later broadcasts stop paying for it;
+        // its reader path reports the loss.
+        HVD_LOG(kError,
+                "worker %d outbox exceeded %zu bytes; severing", r,
+                kPumpCap);
+        ::shutdown(fds[r], SHUT_RDWR);
+        pump_buf_[r].clear();
+        severed.push_back(r);
+        continue;
       }
-      if (fd >= 0) SendMsg(fd, MsgType::kResponses, payload);
+      size_t off = 0;
+      if (pump_buf_[r].empty() && pump_inflight_[r] == 0) {
+        while (off < frame.size()) {
+          ssize_t w = ::send(fds[r], frame.data() + off,
+                             frame.size() - off,
+                             MSG_DONTWAIT | MSG_NOSIGNAL);
+          if (w > 0) {
+            off += static_cast<size_t>(w);
+            continue;
+          }
+          if (w < 0 && errno == EINTR) continue;
+          break;  // backpressure or error: tail goes to the pump
+        }
+      }
+      if (off < frame.size()) {
+        pump_buf_[r].append(frame, off, std::string::npos);
+        queued = true;
+      }
     }
   }
-  DeliverEntries(entries);  // rank 0's own copy
+  if (!severed.empty()) {
+    std::lock_guard<std::mutex> clk(coord_mu_);
+    for (int r : severed)
+      if (r < static_cast<int>(worker_fds_.size()) &&
+          worker_fds_[r] == fds[r]) {
+        retired_fds_.push_back(worker_fds_[r]);
+        worker_fds_[r] = -1;
+      }
+  }
+  if (queued) pump_cv_.notify_one();
+}
+
+void Controller::PumpLoop() {
+  // Drains per-rank outboxes with non-blocking sends, scanning
+  // ROUND-ROBIN so a backpressured low rank cannot monopolize the
+  // pump (every other rank gets its turn each pass); on shutdown,
+  // flushes what it can within a bounded window, then severs the
+  // worker fds (which unblocks their reader threads).
+  constexpr double kFlushWindowS = 2.0;
+  const int n = static_cast<int>(pump_buf_.size());
+  double shutdown_seen_at = 0.0;
+  std::string local;
+  int rr = 1;                      // next rank to consider
+  int stall_anchor = -1;           // first rank of a no-progress run
+  while (true) {
+    int r_next = -1;
+    {
+      std::unique_lock<std::mutex> lk(pump_mu_);
+      for (int k = 0; k < n - 1; ++k) {
+        int r = 1 + (rr - 1 + k) % (n - 1);
+        if (!pump_buf_[r].empty()) { r_next = r; break; }
+      }
+      if (r_next < 0) {
+        if (shutdown_.load()) break;  // fully drained
+        stall_anchor = -1;
+        pump_cv_.wait_for(lk, std::chrono::milliseconds(50));
+        continue;
+      }
+      local.clear();
+      local.swap(pump_buf_[r_next]);
+      pump_inflight_[r_next] = local.size();
+    }
+    rr = (r_next % (n - 1)) + 1;   // resume AFTER this rank
+    if (shutdown_.load()) {
+      if (shutdown_seen_at == 0.0) shutdown_seen_at = NowSeconds();
+      if (NowSeconds() - shutdown_seen_at > kFlushWindowS) {
+        std::lock_guard<std::mutex> lk(pump_mu_);
+        pump_inflight_[r_next] = 0;
+        break;
+      }
+    }
+    int fd;
+    {
+      std::lock_guard<std::mutex> clk(coord_mu_);
+      fd = r_next < static_cast<int>(worker_fds_.size())
+               ? worker_fds_[r_next] : -1;
+    }
+    size_t off = 0;
+    if (fd >= 0) {
+      while (off < local.size()) {
+        ssize_t w = ::send(fd, local.data() + off, local.size() - off,
+                           MSG_DONTWAIT | MSG_NOSIGNAL);
+        if (w > 0) {
+          off += static_cast<size_t>(w);
+          continue;
+        }
+        if (w < 0 && errno == EINTR) continue;      // transient: retry
+        if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK ||
+                      errno == ENOBUFS))
+          break;  // backpressure: requeue the tail, move on
+        off = local.size();  // dead peer: drop; reader reports it
+        break;
+      }
+    } else {
+      off = local.size();  // disconnected: drop
+    }
+    bool progressed = off > 0;
+    {
+      std::unique_lock<std::mutex> lk(pump_mu_);
+      pump_inflight_[r_next] = 0;
+      if (off < local.size()) {
+        // Prepend the unsent tail so per-rank frame order is
+        // preserved (only this thread writes worker fds
+        // post-handshake); frames Enqueue added meanwhile follow it.
+        pump_buf_[r_next].insert(0, local, off, std::string::npos);
+      }
+      if (progressed) {
+        stall_anchor = -1;
+      } else if (stall_anchor == r_next) {
+        // The round-robin came back to the rank that started this
+        // no-progress run without anything advancing in between:
+        // every pending rank is backpressured — wait instead of
+        // spinning on EAGAIN (with ONE stuck rank this sleeps after
+        // a single futile revisit, not after n-1 of them).
+        stall_anchor = -1;
+        pump_cv_.wait_for(lk, std::chrono::milliseconds(1));
+      } else if (stall_anchor < 0) {
+        stall_anchor = r_next;
+      }
+    }
+  }
+  // Shutdown: sever worker fds so reader threads unblock (the old
+  // Abort() did this inline; it now belongs to the pump, after the
+  // final kShutdown frames had their flush window).
+  std::lock_guard<std::mutex> clk(coord_mu_);
+  for (int fd : worker_fds_)
+    if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
 }
 
 void Controller::DeliverEntries(const std::vector<Entry>& entries) {

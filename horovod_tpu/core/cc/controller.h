@@ -127,6 +127,8 @@ class Controller {
 
  private:
   void CycleLoop();
+  void PumpLoop();
+  void EnqueueToWorkers(const std::string& frame);
   // Set shutdown + wake everything WITHOUT joining threads — safe to
   // call from the controller's own threads (Shutdown() joins and must
   // only run on an external thread).
@@ -214,9 +216,34 @@ class Controller {
   int listen_fd_ = -1;
   int coord_fd_ = -1;                 // worker->coordinator connection
   std::vector<int> worker_fds_;       // coordinator: fd per rank (idx)
+  // Severed-for-cap-breach fds: unlinked from worker_fds_ (so
+  // broadcasts stop paying for the dead rank) but kept open until
+  // Shutdown() — the pump may still hold the raw fd mid-write, and
+  // close() under it would race fd reuse. Guarded by coord_mu_.
+  std::vector<int> retired_fds_;
   std::vector<char> worker_claimed_;  // rank slot claimed (pre-fd)
   std::atomic<int> handshaking_{0};   // in-flight handshake threads
-  std::mutex send_mu_;                // serialize writes to workers
+  std::mutex send_mu_;                // worker side: serialize
+                                      // coord_fd_ writes
+
+  // --- broadcast pump (coordinator): the round-3 serial O(N)
+  // fan-out under one lock replaced by per-rank outboxes drained by
+  // ONE sender thread using MSG_DONTWAIT writes. The cycle thread
+  // only memcpys the pre-built frame into N buffers; the pump
+  // overlaps the actual sends with the next cycle, and a
+  // backpressured (slow/wedged) worker can no longer head-of-line-
+  // block the other N-1 — its bytes just sit in ITS outbox. A worker
+  // whose outbox exceeds kPumpCap is severed (its reader path then
+  // reports the loss), bounding coordinator memory.
+  std::mutex pump_mu_;
+  std::condition_variable pump_cv_;
+  std::vector<std::string> pump_buf_;   // per-rank pending frames
+  // Bytes the pump has swapped out of a rank's outbox but not yet
+  // written — counted by the kPumpCap check so a wedged rank's
+  // pending memory is bounded by ONE cap, not two.
+  std::vector<size_t> pump_inflight_;
+  std::atomic<bool> aborting_{false};
+  static constexpr size_t kPumpCap = 64u << 20;
 
   std::vector<std::thread> threads_;
   // Per-connection reader threads, spawned by the accept loop while

@@ -157,6 +157,19 @@ inline bool ReadAll(int fd, char* p, size_t n) {
   return true;
 }
 
+// Pre-framed message bytes (header + payload) for paths that
+// serialize once and hand the same frame to many receivers (the
+// coordinator's broadcast pump).
+inline std::string BuildFrame(MsgType t, const std::string& payload) {
+  std::string out;
+  out.resize(5 + payload.size());
+  out[0] = static_cast<char>(t);
+  uint32_t len = htonl(static_cast<uint32_t>(payload.size()));
+  memcpy(&out[1], &len, 4);
+  if (!payload.empty()) memcpy(&out[5], payload.data(), payload.size());
+  return out;
+}
+
 inline bool SendMsg(int fd, MsgType t, const std::string& payload) {
   char hdr[5];
   hdr[0] = static_cast<char>(t);

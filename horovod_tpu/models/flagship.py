@@ -113,7 +113,8 @@ def make_flagship(mesh: Mesh,
     # the path can actually engage for THIS config's shapes.
     from ..parallel.ring_attention import flash_possible_cfg
     flash_possible = flash_possible_cfg(
-        cfg.head_dim, cfg.max_seq, cfg.n_kv_heads == cfg.n_heads)
+        cfg.head_dim, cfg.max_seq,
+        sp_live=cfg.sp_axis is not None)
     step = build_train_step(
         local_loss, optimizer, mesh,
         batch_spec=batch_spec(mesh),

@@ -139,13 +139,18 @@ def flash_wanted() -> bool:
         jax.default_backend() == "tpu"
 
 
-def flash_possible_cfg(head_dim: int, seq: int, kv_equal: bool) -> bool:
+def flash_possible_cfg(head_dim: int, seq: int,
+                       sp_live: bool = False) -> bool:
     """Static-config half of the predicate, for builders that know
     the model config but not the runtime tensors: same shape rules as
-    _flash_supported. Builders keep check_vma ON when this is False —
-    flash can never engage, so the checker loses nothing."""
+    _flash_supported. GQA needs no condition — callers repeat KV
+    heads to full width before attention(), so the kernel always
+    sees k.shape == q.shape. With a live sequence-parallel axis the
+    ring path runs instead and flash never traces. Builders keep
+    check_vma ON when this is False — flash can never engage, so the
+    checker loses nothing."""
     return (flash_wanted() and head_dim in (64, 128, 256)
-            and seq % 128 == 0 and kv_equal)
+            and seq % 128 == 0 and not sp_live)
 
 
 def _flash_supported(q, k) -> bool:
