@@ -4,8 +4,8 @@ TPU-native analog of the reference's ParameterManager
 (reference: horovod/common/parameter_manager.cc — ParameterManager /
 BayesianParameter; utils/bayesian_optimization.cc +
 utils/gaussian_process.cc). Two search modes over the same
-(fusion_threshold, cycle_time) space and the same score (bytes
-reduced per second):
+(fusion_threshold, cycle_time, batch_quiescence) space and the same
+score (bytes reduced per second):
 
   * "hillclimb" (default): coordinate descent over the discrete
     grids — robust, no hyperparameters, fine for the tiny space.
@@ -32,6 +32,12 @@ _MB = 1024 * 1024
 FUSION_GRID = [0, 1 * _MB, 2 * _MB, 4 * _MB, 8 * _MB, 16 * _MB,
                32 * _MB, 64 * _MB, 128 * _MB, 256 * _MB]
 CYCLE_GRID = [0.5, 1.0, 2.5, 5.0, 10.0, 20.0, 50.0]
+# Quiescence hold (HOROVOD_BATCH_QUIESCENCE): the knob that turns a
+# ragged per-tensor storm into one stable-composition batch — THE
+# lever that took the eager path to jit parity (docs/benchmarks.md).
+# Searched like the reference ParameterManager searches its
+# cache/hierarchical flags alongside the continuous knobs.
+QUIESCE_GRID = [0, 2, 5, 10]
 
 
 class GaussianProcessSearch:
@@ -91,19 +97,23 @@ def _erf(x: np.ndarray) -> np.ndarray:
     return sign * (1.0 - poly * np.exp(-x * x))
 
 
-def _normalize_point(fusion: int, cycle: float) -> Tuple[float, float]:
-    """Map a (fusion_threshold, cycle_time) pair into [0,1]^2 — log
-    scales, matching how the knobs actually behave."""
+def _normalize_point(fusion: int, cycle: float,
+                     quiesce: int) -> Tuple[float, float, float]:
+    """Map a (fusion_threshold, cycle_time, quiescence) triple into
+    [0,1]^3 — log scales for the first two, linear for the small
+    quiescence range."""
     fmax = np.log2(FUSION_GRID[-1] + 1.0)
     f = np.log2(fusion + 1.0) / fmax
     cmin, cmax = np.log(CYCLE_GRID[0]), np.log(CYCLE_GRID[-1])
     c = (np.log(cycle) - cmin) / (cmax - cmin)
-    return float(f), float(c)
+    q = quiesce / float(QUIESCE_GRID[-1])
+    return float(f), float(c), float(q)
 
 
-def _gp_candidates() -> Tuple[np.ndarray, List[Tuple[int, float]]]:
-    pairs = [(f, c) for f in FUSION_GRID for c in CYCLE_GRID]
-    pts = np.array([_normalize_point(f, c) for f, c in pairs])
+def _gp_candidates() -> Tuple[np.ndarray, List[Tuple[int, float, int]]]:
+    pairs = [(f, c, q) for f in FUSION_GRID for c in CYCLE_GRID
+             for q in QUIESCE_GRID]
+    pts = np.array([_normalize_point(f, c, q) for f, c, q in pairs])
     return pts, pairs
 
 
@@ -121,22 +131,25 @@ class Autotuner:
         self.log_path = cfg.autotune_log
         self.fusion_threshold = cfg.fusion_threshold
         self.cycle_time_ms = cfg.cycle_time_ms
+        self.quiescence = int(cfg.batch_quiescence)
         self._bytes = 0
         self._seconds = 0.0
         self._events = 0
         self._best_score = -1.0
-        self._best = (self.fusion_threshold, self.cycle_time_ms)
-        self._knob = 0              # 0: fusion, 1: cycle
+        self._best = (self.fusion_threshold, self.cycle_time_ms,
+                      self.quiescence)
+        self._knob = 0              # 0: fusion, 1: cycle, 2: quiesce
         self._direction = 1
         self._frozen = False
         self._num_samples = 0
-        self._samples: List[Tuple[int, float, float]] = []
+        self._samples: List[Tuple[int, float, int, float]] = []
         if self.mode == "gp":
             self._gp_pts, self._gp_pairs = _gp_candidates()
             self._gp = GaussianProcessSearch(self._gp_pts)
         if self.log_path:
             with open(self.log_path, "w") as f:
-                f.write("fusion_threshold,cycle_time_ms,score_bytes_per_sec\n")
+                f.write("fusion_threshold,cycle_time_ms,quiescence,"
+                        "score_bytes_per_sec\n")
 
     # -- hot-path accounting -------------------------------------------------
     def record(self, nbytes: int, seconds: float) -> None:
@@ -161,21 +174,24 @@ class Autotuner:
             return
         self._num_samples += 1
         self._samples.append(
-            (self.fusion_threshold, self.cycle_time_ms, score))
+            (self.fusion_threshold, self.cycle_time_ms,
+             self.quiescence, score))
         if len(self._samples) > 512:   # bound hot-path memory
             self._samples = self._samples[-256:]
         if self.log_path:
             with open(self.log_path, "a") as f:
                 f.write(f"{self.fusion_threshold},{self.cycle_time_ms},"
-                        f"{score:.1f}\n")
+                        f"{self.quiescence},{score:.1f}\n")
         if score > self._best_score:
             self._best_score = score
-            self._best = (self.fusion_threshold, self.cycle_time_ms)
+            self._best = (self.fusion_threshold, self.cycle_time_ms,
+                          self.quiescence)
         elif self.mode == "hillclimb":
             # revert and turn around
-            self.fusion_threshold, self.cycle_time_ms = self._best
+            (self.fusion_threshold, self.cycle_time_ms,
+             self.quiescence) = self._best
             self._direction = -self._direction
-            self._knob = 1 - self._knob
+            self._knob = (self._knob + 1) % 3
         if self.mode == "gp":
             self._step_gp()
         else:
@@ -184,8 +200,10 @@ class Autotuner:
     def _step_knob(self) -> None:
         if self._knob == 0:
             grid, cur = FUSION_GRID, self.fusion_threshold
-        else:
+        elif self._knob == 1:
             grid, cur = CYCLE_GRID, self.cycle_time_ms
+        else:
+            grid, cur = QUIESCE_GRID, self.quiescence
         try:
             i = grid.index(cur)
         except ValueError:
@@ -193,28 +211,36 @@ class Autotuner:
         j = max(0, min(len(grid) - 1, i + self._direction))
         if self._knob == 0:
             self.fusion_threshold = grid[j]
-        else:
+        elif self._knob == 1:
             self.cycle_time_ms = grid[j]
+        else:
+            self.quiescence = grid[j]
 
     # GP fit window and total exploration budget: the fit is O(N^3)
     # (Cholesky) and runs on the training hot path, so it must not
     # grow with run length; after the budget the tuner freezes at the
     # best point (reference: ParameterManager stops tuning once
     # converged rather than searching forever).
-    GP_FIT_WINDOW = 64
-    GP_SAMPLE_BUDGET = 128
+    # Scaled with the 3-D candidate space (10 x 7 x 4 = 280 points;
+    # the 2-D space was 70): a 96-point Cholesky is still trivial,
+    # and 224 samples cover 80% of the grid before freezing.
+    GP_FIT_WINDOW = 96
+    GP_SAMPLE_BUDGET = 224
 
     def _step_gp(self) -> None:
         if self._num_samples >= self.GP_SAMPLE_BUDGET:
             if not self._frozen:
                 self._frozen = True
-                self.fusion_threshold, self.cycle_time_ms = self._best
+                (self.fusion_threshold, self.cycle_time_ms,
+                 self.quiescence) = self._best
             return
         recent = self._samples[-self.GP_FIT_WINDOW:]
-        X = np.array([_normalize_point(f, c) for f, c, _ in recent])
-        y = np.array([s for _, _, s in recent])
+        X = np.array([_normalize_point(f, c, q)
+                      for f, c, q, _ in recent])
+        y = np.array([s for _, _, _, s in recent])
         idx = self._gp.suggest(X, y)
-        self.fusion_threshold, self.cycle_time_ms = self._gp_pairs[idx]
+        (self.fusion_threshold, self.cycle_time_ms,
+         self.quiescence) = self._gp_pairs[idx]
 
-    def best(self) -> Tuple[int, float]:
+    def best(self) -> Tuple[int, float, int]:
         return self._best

@@ -296,6 +296,7 @@ class NegotiatedController:
         self._terminated: Optional[BaseException] = None
         self._pushed_fusion = cfg.fusion_threshold
         self._pushed_cycle = cfg.cycle_time_ms
+        self._pushed_quiesce = cfg.batch_quiescence
         self._last_cycle_mark = -1
         # Introspection: per-kind [batches, entries] executed — a
         # fused batch increments batches by 1 and entries by N
@@ -873,7 +874,17 @@ class NegotiatedController:
             nbytes = int(sum(
                 np.prod(t.shape) * jnp.dtype(t.dtype).itemsize
                 for t in tensors))
-            tuner.record(nbytes, time.perf_counter() - t0)
+            # The denominator must include the NEGOTIATION latency
+            # (submit -> agreement, measured by the coordinator and
+            # carried on each entry) or the quiescence/cycle knobs'
+            # hold cost would be invisible to the objective and the
+            # tuner would drift to maximum hold: bigger batches score
+            # higher bytes/sec-per-dispatch while the wait that buys
+            # them goes unmeasured.
+            hold_s = max((getattr(e, "negotiate_us", 0) or 0)
+                         for e, _, _ in slots) / 1e6
+            tuner.record(nbytes,
+                         (time.perf_counter() - t0) + hold_s)
             if tuner.fusion_threshold != self._pushed_fusion:
                 self._pushed_fusion = tuner.fusion_threshold
                 self.core.set_fusion_threshold(self._pushed_fusion)
@@ -884,6 +895,13 @@ class NegotiatedController:
                 # agreement, but every rank's drain loop follows it.
                 self._pushed_cycle = tuner.cycle_time_ms
                 self.core.set_cycle_time(self._pushed_cycle)
+            if tuner.quiescence != self._pushed_quiesce:
+                # Third dimension: the quiescence hold that stabilizes
+                # eager batch composition (no reference analog — the
+                # XLA-specific knob this build added; autotuned so
+                # hook-storm users don't hand-set it).
+                self._pushed_quiesce = tuner.quiescence
+                self.core.set_quiescence(self._pushed_quiesce)
 
         i = 0
         for e, p, cnt in slots:

@@ -117,8 +117,9 @@ class TestAutotuner:
             # tuner's current point into the native core
             assert ctrl._pushed_fusion == st.autotuner.fusion_threshold
             assert ctrl._pushed_cycle == st.autotuner.cycle_time_ms
+            assert ctrl._pushed_quiesce == st.autotuner.quiescence
             # the hill-climb must have exercised the cycle knob too
-            visited_cycles = {c for _, c, _ in st.autotuner._samples}
+            visited_cycles = {c for _, c, _, _ in st.autotuner._samples}
             assert len(visited_cycles) > 1, (
                 "cycle knob never moved", st.autotuner._samples)
         finally:
@@ -164,18 +165,39 @@ class TestGPAutotuner:
 
         t.record(1, 1.0)
         t.record(1, 1.0)   # warmup sample, discarded
-        for _ in range(25):
+        for _ in range(40):
             score = surface(t.fusion_threshold, t.cycle_time_ms)
             # two events -> one sample at the current knob point;
             # record() scores bytes/seconds, so feed score as bytes
             # over 1 second split across the two events.
             t.record(int(score / 2), 0.5)
             t.record(int(score / 2), 0.5)
-        bf, bc = t.best()
+        bf, bc, _ = t.best()
         fi = FUSION_GRID.index(bf)
         ci = CYCLE_GRID.index(bc)
         assert abs(fi - FUSION_GRID.index(8 * _MB)) <= 1, (bf, bc)
         assert abs(ci - CYCLE_GRID.index(2.5)) <= 1, (bf, bc)
+
+    def test_gp_mode_finds_quiescence_optimum(self):
+        """The third search dimension (round-4 addition): a surface
+        that rewards quiescence=5 must pull the tuner there — the
+        hook-storm scenario where composition stability dominates."""
+        import numpy as np
+        from horovod_tpu.autotune import QUIESCE_GRID
+        t = make_tuner(HOROVOD_AUTOTUNE_MODE="gp")
+
+        def surface(q):
+            return 1e9 * np.exp(-0.5 * (q - 5.0) ** 2 / 4.0)
+
+        t.record(1, 1.0)
+        t.record(1, 1.0)   # warmup
+        for _ in range(50):
+            score = surface(t.quiescence)
+            t.record(int(score / 2), 0.5)
+            t.record(int(score / 2), 0.5)
+        _, _, bq = t.best()
+        qi = QUIESCE_GRID.index(bq)
+        assert abs(qi - QUIESCE_GRID.index(5)) <= 1, t.best()
 
     def test_bad_mode_rejected(self):
         import pytest as _pytest
