@@ -344,12 +344,11 @@ def _wide_wire_dtype(tensors, compressors) -> Tuple[bool, Optional[str]]:
     return True, (None if w == raw.pop() else w)
 
 
-def _allreduce_wide(tensors, pset: ProcessSet, mesh, op: int,
-                    prescale: float, postscale: float,
-                    wire_dt: Optional[str]):
-    """Run the device-spanning allreduce: pack locally, scatter the
-    bucket rows across this process's chips (one sharded device_put),
-    assemble the global (n, ndev, k) array, launch."""
+def _scatter_packed(tensors, pset: ProcessSet, mesh):
+    """Pack a group into one flat bucket and scatter its rows across
+    this process's chips (one local pack launch + one sharded
+    device_put), assembling the global (n, ndev, k) array for a wide
+    kernel. Returns (global_array, sig)."""
     n = mesh.shape["proc"]
     ndev = mesh.shape["dev"]
     sig = _sig(tensors)
@@ -362,9 +361,50 @@ def _allreduce_wide(tensors, pset: ProcessSet, mesh, op: int,
     gshape = (n, ndev, packed.shape[1])
     g = jax.make_array_from_single_device_arrays(
         gshape, NamedSharding(mesh, P("proc", "dev")), pieces)
-    kern = _allreduce_kernel_wide(mesh, n, ndev, op, float(prescale),
-                                  float(postscale), sig, wire_dt)
+    return g, sig
+
+
+def _allreduce_wide(tensors, pset: ProcessSet, mesh, op: int,
+                    prescale: float, postscale: float,
+                    wire_dt: Optional[str]):
+    """Run the device-spanning allreduce over the scattered bucket."""
+    g, sig = _scatter_packed(tensors, pset, mesh)
+    kern = _allreduce_kernel_wide(mesh, mesh.shape["proc"],
+                                  mesh.shape["dev"], op,
+                                  float(prescale), float(postscale),
+                                  sig, wire_dt)
     return [local_shard(o) for o in kern(g)]
+
+
+@functools.lru_cache(maxsize=None)
+def _broadcast_kernel_wide(mesh, n: int, ndev: int, root: int,
+                           sig: Tuple):
+    """Device-spanning fused broadcast: every chip moves 1/ndev of
+    the bucket over its own ICI links (psum of the root's masked
+    shard over 'proc'), then the intra-host 'dev' all_gather
+    reassembles — the broadcast analog of _allreduce_kernel_wide.
+    broadcast_parameters at job start moves the whole model from
+    rank 0, so this is the second-most-trafficked eager path."""
+    shapes = [s for s, _ in sig]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+
+    def body(block):                      # (1, 1, k)
+        x = block.reshape(-1)
+        idx = lax.axis_index("proc")
+        masked = jnp.where(idx == root, x, jnp.zeros_like(x))
+        red = lax.psum(masked, "proc")
+        full = lax.all_gather(red, "dev", tiled=True)
+        outs = []
+        off = 0
+        for s, sz in zip(shapes, sizes):
+            outs.append(full[off:off + sz].reshape((1,) + s))
+            off += sz
+        return tuple(outs)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc", "dev"),
+                       out_specs=tuple(P("proc") for _ in sig),
+                       check_vma=False)
+    return jax.jit(fn)
 
 
 # --- hierarchical allreduce (reference: NCCLHierarchicalAllreduce,
@@ -594,18 +634,6 @@ def _allgather_group_kernel_hier(mesh, n: int,
                                       for _ in sig),
                        out_specs=tuple(P(("cross", "local"))
                                        for _ in sig))
-    return jax.jit(fn)
-
-
-@functools.lru_cache(maxsize=None)
-def _broadcast_kernel(mesh, n: int, root: int, sig: Tuple):
-    def body(block):
-        idx = lax.axis_index("proc")
-        masked = jnp.where(idx == root, block, jnp.zeros_like(block))
-        return lax.psum(masked, "proc")
-
-    fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc"),
-                       out_specs=P("proc"))
     return jax.jit(fn)
 
 
@@ -936,6 +964,20 @@ def broadcast_group(tensors: List[jax.Array], root: int,
     bools = [t.dtype == jnp.bool_ for t in tensors]
     wire = [t.astype(jnp.uint8) if b else t
             for t, b in zip(tensors, bools)]
+    total = sum(int(np.prod(t.shape)) if t.shape else 1 for t in wire)
+    wmesh = (_wide_mesh(pset, total)
+             if len({str(t.dtype) for t in wire}) == 1 else None)
+    if wmesh is not None:
+        # Device-spanning path (see _broadcast_kernel_wide): the pack
+        # concat requires one dtype, guaranteed for controller batches
+        # by the bc fuse key; mixed direct calls keep the flat kernel.
+        g, sig = _scatter_packed(wire, pset, wmesh)
+        kern = _broadcast_kernel_wide(wmesh, wmesh.shape["proc"],
+                                      wmesh.shape["dev"], int(root),
+                                      sig)
+        outs = [local_shard(o) for o in kern(g)]
+        return [o.astype(jnp.bool_) if b else o
+                for o, b in zip(outs, bools)]
     sig = _sig(wire)
     kern = _broadcast_group_kernel(pset.mesh, pset.size, int(root), sig)
     gouts = kern(*[to_global(t, pset) for t in wire])
@@ -1017,16 +1059,10 @@ def allgather_group(tensors: List[jax.Array], pset: ProcessSet,
 
 
 def broadcast(tensor: jax.Array, root: int, pset: ProcessSet) -> jax.Array:
-    x = _as_local(tensor)
-    n = pset.size
-    if n == 1:
-        return tensor
-    was_bool = _is_bool(x)
-    if was_bool:
-        x = x.astype(jnp.uint8)
-    kern = _broadcast_kernel(pset.mesh, n, int(root), _sig([x]))
-    out = local_shard(kern(to_global(x, pset)))
-    return out.astype(jnp.bool_) if was_bool else out
+    """Single-tensor broadcast = a group of one, so the direct
+    (no-controller) path gets the device-spanning kernel exactly like
+    the negotiated path does."""
+    return broadcast_group([tensor], root, pset)[0]
 
 
 def alltoall(tensor: jax.Array, splits: Sequence[int],

@@ -82,6 +82,18 @@ def main():
     np.testing.assert_allclose(np.asarray(out), np.full(8, float(n)))
     print(f"rank {r}: small-payload flat fallback OK")
 
+    # 4.5) broadcast through the wide kernel: rank 0's bucket reaches
+    # every rank with each chip moving 1/D of it (broadcast_parameters
+    # is the startup whole-model move — it must span chips too).
+    # non-root ranks hold GARBAGE, not zeros: a dropped root mask in
+    # the kernel (degenerating to a plain sum) must fail this assert.
+    big = (jnp.arange(4096, dtype=jnp.float32) if r == 0
+           else jnp.full((4096,), -7.0 * (r + 1), jnp.float32))
+    out = hvd.broadcast(big, root_rank=0, name="span_bcast")
+    np.testing.assert_allclose(
+        np.asarray(out), np.arange(4096, dtype=np.float32))
+    print(f"rank {r}: wide broadcast OK")
+
     # 5) min/max through the wide kernel too.
     out = hvd.allreduce(jnp.full((4096,), float(r + 1)), name="span_max",
                         op=hvd.Max)

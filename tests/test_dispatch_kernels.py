@@ -84,12 +84,14 @@ def test_fused_group_allreduce(eight_device_mesh):
 
 
 def test_broadcast_kernel(eight_device_mesh):
+    # Single-tensor broadcast is a group of one (dispatch.broadcast
+    # routes through the group kernel so it shares the wide path).
     mesh = eight_device_mesh
     xs = np.stack([np.full((3,), i, np.float32) for i in range(N)])
     for root in (0, 3, 7):
-        kern = dispatch._broadcast_kernel(
+        kern = dispatch._broadcast_group_kernel(
             mesh, N, root, dispatch._sig([jnp.asarray(xs[0])]))
-        out = kern(make_global(mesh, xs))
+        (out,) = kern(make_global(mesh, xs))
         for got in rows_of(out):
             np.testing.assert_array_equal(got, xs[root])
 
