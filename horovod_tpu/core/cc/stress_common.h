@@ -1,0 +1,64 @@
+// Shared helpers for the stress binaries (stress_scale,
+// stress_slow_worker): loopback port probing, wall clock, and the
+// agreed-batch drain loop. One home so the binaries cannot drift.
+#pragma once
+
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+#include "controller.h"
+
+namespace hvdtpu_stress {
+
+inline int free_port() {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = 0;
+  bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+  socklen_t len = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  int port = ntohs(addr.sin_port);
+  close(fd);
+  return port;
+}
+
+inline double now_s() {
+  return std::chrono::duration<double>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// Drain NextBatch until `want` non-sentinel entries arrive; append
+// names to *order (single-threaded per rank). Returns false on
+// shutdown/error, printing the entry error so a root cause never
+// hides behind a generic round-failure message.
+inline bool drain(hvdtpu::Controller* c, int want,
+                  std::vector<std::string>* order) {
+  int got = 0;
+  std::vector<hvdtpu::Entry> entries;
+  while (got < want) {
+    entries.clear();
+    if (!c->NextBatch(5.0, &entries)) return false;
+    for (const auto& e : entries) {
+      if (e.name == hvdtpu::kAllJoined) continue;
+      if (!e.error.empty()) {
+        fprintf(stderr, "entry error: %s: %s\n", e.name.c_str(),
+                e.error.c_str());
+        return false;
+      }
+      order->push_back(e.name);
+      ++got;
+    }
+  }
+  return true;
+}
+
+}  // namespace hvdtpu_stress

@@ -37,6 +37,7 @@
 #include <vector>
 
 #include "controller.h"
+#include "stress_common.h"
 
 using hvdtpu::Controller;
 using hvdtpu::ControllerOptions;
@@ -44,48 +45,9 @@ using hvdtpu::Entry;
 
 namespace {
 
-int free_port() {
-  int fd = socket(AF_INET, SOCK_STREAM, 0);
-  sockaddr_in addr{};
-  addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
-  addr.sin_port = 0;
-  bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
-  socklen_t len = sizeof(addr);
-  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
-  int port = ntohs(addr.sin_port);
-  close(fd);
-  return port;
-}
-
-double now_s() {
-  return std::chrono::duration<double>(
-             std::chrono::steady_clock::now().time_since_epoch())
-      .count();
-}
-
-// Drain NextBatch until `want` non-sentinel entries arrive; append
-// names to *order (single-threaded per rank). Returns false on
-// shutdown/error.
-bool drain(Controller* c, int want, std::vector<std::string>* order) {
-  int got = 0;
-  std::vector<Entry> entries;
-  while (got < want) {
-    entries.clear();
-    if (!c->NextBatch(5.0, &entries)) return false;
-    for (const auto& e : entries) {
-      if (e.name == hvdtpu::kAllJoined) continue;
-      if (!e.error.empty()) {
-        fprintf(stderr, "entry error: %s: %s\n", e.name.c_str(),
-                e.error.c_str());
-        return false;
-      }
-      order->push_back(e.name);
-      ++got;
-    }
-  }
-  return true;
-}
+using hvdtpu_stress::drain;
+using hvdtpu_stress::free_port;
+using hvdtpu_stress::now_s;
 
 }  // namespace
 

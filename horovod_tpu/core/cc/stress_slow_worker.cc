@@ -1,0 +1,216 @@
+// Slow-worker isolation stress: the round-4 broadcast pump's core
+// claim, tested end-to-end — a worker that SUBMITS but never READS
+// its socket (a stalled TCP window, the pod failure mode a flaky
+// host produces) must not delay agreement delivery to the healthy
+// ranks. Under the pre-pump serial fan-out the coordinator's cycle
+// thread blocked in send() to the stalled rank and the whole gang
+// froze; with the pump the stalled rank's frames queue in ITS outbox
+// (severed past the 64 MB cap) while everyone else proceeds.
+//
+// Topology: rank 0 coordinator + (n-2) healthy Controller workers +
+// ONE raw-socket "lazy" rank that handshakes (unauthenticated mode),
+// shrinks its receive buffer, then loops sending kReady requests
+// carrying a large meta — inflating every agreed entry so the lazy
+// rank's unread socket backs up within a few rounds — without ever
+// calling recv again.
+//
+// Usage: stress_slow_worker [workers] [rounds] [meta_kb]
+// Prints ONE JSON line:
+//   {"workers":N,"rounds":R,"meta_kb":K,"healthy_ok":true,
+//    "elapsed_s":...,"worst_round_ms":...}
+// Exits non-zero if any healthy rank misses a delivery (5 s drain
+// deadline per round) or orders diverge.
+
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <memory>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "controller.h"
+#include "stress_common.h"
+
+using hvdtpu::BuildFrame;
+using hvdtpu::Controller;
+using hvdtpu::ControllerOptions;
+using hvdtpu::Entry;
+using hvdtpu::MsgType;
+using hvdtpu::RecvMsg;
+using hvdtpu::Request;
+using hvdtpu::SendMsg;
+using hvdtpu::SerializeRequests;
+
+namespace {
+
+using hvdtpu_stress::drain;
+using hvdtpu_stress::free_port;
+using hvdtpu_stress::now_s;
+
+// The lazy rank: unauthenticated handshake on a raw socket with a
+// tiny receive buffer, then send-only kReady traffic forever.
+void lazy_worker(int port, int rank, int rounds, int tensors,
+                 int meta_kb, std::atomic<bool>* stop) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  int rcv = 8 * 1024;  // tiny advertised window: backpressure fast
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &rcv, sizeof(rcv));
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  for (int i = 0; i < 100; ++i) {
+    if (connect(fd, reinterpret_cast<sockaddr*>(&addr),
+                sizeof(addr)) == 0)
+      break;
+    usleep(100000);
+  }
+  MsgType t;
+  std::string payload;
+  if (!RecvMsg(fd, &t, &payload) || t != MsgType::kChallenge) {
+    fprintf(stderr, "lazy: no challenge\n");
+    close(fd);
+    return;
+  }
+  hvdtpu::Buf hello;
+  hello.PutU32(static_cast<uint32_t>(rank));
+  hello.PutStr("lazy-nonce");
+  hello.PutStr("");  // unauthenticated mode: empty mac accepted
+  SendMsg(fd, MsgType::kHello, hello.data());
+  if (!RecvMsg(fd, &t, &payload) || t != MsgType::kWelcome) {
+    fprintf(stderr, "lazy: no welcome\n");
+    close(fd);
+    return;
+  }
+  // From here on: NEVER recv again. Submit the same names the
+  // healthy ranks submit, each carrying a big meta so every agreed
+  // entry is large and this rank's unread socket fills quickly.
+  const std::string meta(static_cast<size_t>(meta_kb) * 1024, 'm');
+  for (int round = 0; round < rounds && !stop->load(); ++round) {
+    std::vector<Request> reqs;
+    for (int i = 0; i < tensors; ++i) {
+      Request r;
+      r.name = "s" + std::to_string(round) + "_" + std::to_string(i);
+      r.sig = "g|slow#";
+      r.nbytes = 64;
+      r.meta = meta;
+      reqs.push_back(std::move(r));
+    }
+    SendMsg(fd, MsgType::kReady, SerializeRequests(reqs));
+    usleep(2000);
+  }
+  // Keep the socket open (still unread) until told to stop, then
+  // vanish without ceremony — the abrupt-peer case.
+  while (!stop->load()) usleep(10000);
+  close(fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const int n = argc > 1 ? atoi(argv[1]) : 4;
+  const int rounds = argc > 2 ? atoi(argv[2]) : 60;
+  const int meta_kb = argc > 3 ? atoi(argv[3]) : 64;
+  const int tensors = 4;
+  const int port = free_port();
+  const int lazy_rank = n - 1;
+
+  auto mkopts = [&](int rank) {
+    ControllerOptions o;
+    o.rank = rank;
+    o.size = n;
+    o.coord_host = "127.0.0.1";
+    o.coord_port = port;
+    o.cycle_time_ms = 1.0;
+    o.stall_warn_s = 60.0;
+    o.connect_timeout_s = 30.0;
+    o.auth_secret = "";  // unauthenticated: trivial raw-socket client
+    return o;
+  };
+
+  std::vector<std::unique_ptr<Controller>> ctl(n);  // [lazy] unused
+  ctl[0] = std::make_unique<Controller>(mkopts(0));
+  std::atomic<bool> stop{false};
+  std::thread lazy(lazy_worker, port, lazy_rank, rounds, tensors,
+                   meta_kb, &stop);
+  {
+    std::vector<std::thread> ctors;
+    for (int r = 1; r < lazy_rank; ++r)
+      ctors.emplace_back(
+          [&, r] { ctl[r] = std::make_unique<Controller>(mkopts(r)); });
+    for (auto& t : ctors) t.join();
+  }
+  for (int r = 0; r < lazy_rank; ++r) {
+    if (!ctl[r]->ok()) {
+      fprintf(stderr, "rank %d failed: %s\n", r,
+              ctl[r]->last_error().c_str());
+      stop = true;
+      lazy.join();
+      return 1;
+    }
+  }
+
+  // Healthy ranks submit the same names the lazy rank announces;
+  // agreement needs every rank, so each round's batch carries the
+  // lazy rank's fat meta to EVERY member — the lazy one never reads
+  // its copy. Healthy ranks must still receive every round within
+  // the drain deadline.
+  const double t0 = now_s();
+  std::atomic<bool> fail{false};
+  std::vector<std::vector<std::string>> orders(lazy_rank);
+  // per-thread round latencies, merged after join (no shared writes)
+  std::vector<std::vector<double>> lat(lazy_rank,
+                                       std::vector<double>(rounds, 0));
+  {
+    std::vector<std::thread> th;
+    for (int r = 0; r < lazy_rank; ++r)
+      th.emplace_back([&, r] {
+        for (int round = 0; round < rounds; ++round) {
+          if (fail.load()) return;
+          const double t = now_s();
+          for (int i = 0; i < tensors; ++i)
+            ctl[r]->Submit(
+                "s" + std::to_string(round) + "_" + std::to_string(i),
+                "g|slow#", 64, "x");
+          if (!drain(ctl[r].get(), tensors, &orders[r])) {
+            fprintf(stderr, "rank %d missed round %d\n", r, round);
+            fail = true;
+            return;
+          }
+          lat[r][round] = (now_s() - t) * 1e3;
+        }
+      });
+    for (auto& t : th) t.join();
+  }
+  std::vector<double> worst(rounds, 0.0);
+  for (int r = 0; r < lazy_rank; ++r)
+    for (int round = 0; round < rounds; ++round)
+      worst[round] = std::max(worst[round], lat[r][round]);
+  const double elapsed = now_s() - t0;
+  stop = true;
+  lazy.join();
+  bool ok = !fail.load();
+  for (int r = 1; r < lazy_rank && ok; ++r)
+    if (orders[r] != orders[0]) {
+      fprintf(stderr, "ORDER DIVERGED at rank %d\n", r);
+      ok = false;
+    }
+  for (int r = 0; r < lazy_rank; ++r) ctl[r]->Shutdown();
+  if (!ok) return 1;
+  double w = *std::max_element(worst.begin(), worst.end());
+  printf(
+      "{\"workers\":%d,\"rounds\":%d,\"meta_kb\":%d,"
+      "\"healthy_ok\":true,\"elapsed_s\":%.2f,"
+      "\"worst_round_ms\":%.1f}\n",
+      n, rounds, meta_kb, elapsed, w);
+  return 0;
+}
