@@ -230,6 +230,24 @@ def last_allreduce_info() -> dict:
     return dict(_last_allreduce_info)
 
 
+# Per-op-kind introspection for the device-spanning plane: which
+# layout the last eager allgather/reducescatter/alltoall/adasum took
+# (the allreduce one predates this and keeps its own dict).
+_last_op_info: dict = {}
+
+
+def _note_op(kind: str, path: str, mesh=None) -> None:
+    _last_op_info[kind] = {
+        "path": path,
+        "devices": int(mesh.devices.size) if mesh is not None else None,
+        "mesh_shape": dict(mesh.shape) if mesh is not None else None,
+    }
+
+
+def last_op_info(kind: str) -> dict:
+    return dict(_last_op_info.get(kind, {}))
+
+
 def _wide_mesh(pset: ProcessSet, total_elems: int):
     """The ('proc','dev') mesh when the wide path should run, else
     None (knob off, single device per process, ragged device counts,
@@ -344,24 +362,30 @@ def _wide_wire_dtype(tensors, compressors) -> Tuple[bool, Optional[str]]:
     return True, (None if w == raw.pop() else w)
 
 
-def _scatter_packed(tensors, pset: ProcessSet, mesh):
-    """Pack a group into one flat bucket and scatter its rows across
-    this process's chips (one local pack launch + one sharded
-    device_put), assembling the global (n, ndev, k) array for a wide
-    kernel. Returns (global_array, sig)."""
+def _scatter_rows(packed, pset: ProcessSet, mesh):
+    """Scatter a locally-packed (ndev, k) array one row per local chip
+    (one sharded device_put) and assemble the global (n, ndev, k)
+    array sharded P('proc','dev') for a wide kernel."""
     n = mesh.shape["proc"]
     ndev = mesh.shape["dev"]
-    sig = _sig(tensors)
-    packed = _pack_kernel(sig, ndev)(*tensors)        # (ndev, k) local
     row = pset.local_device_row
     y = jax.device_put(packed,
                        NamedSharding(pset.local_device_mesh, P("dev")))
     by_dev = {s.device: s.data for s in y.addressable_shards}
     pieces = [by_dev[d][None] for d in row]           # (1, 1, k) each
     gshape = (n, ndev, packed.shape[1])
-    g = jax.make_array_from_single_device_arrays(
+    return jax.make_array_from_single_device_arrays(
         gshape, NamedSharding(mesh, P("proc", "dev")), pieces)
-    return g, sig
+
+
+def _scatter_packed(tensors, pset: ProcessSet, mesh):
+    """Pack a group into one flat bucket and scatter its rows across
+    this process's chips (one local pack launch + one sharded
+    device_put), assembling the global (n, ndev, k) array for a wide
+    kernel. Returns (global_array, sig)."""
+    sig = _sig(tensors)
+    packed = _pack_kernel(sig, mesh.shape["dev"])(*tensors)
+    return _scatter_rows(packed, pset, mesh), sig
 
 
 def _allreduce_wide(tensors, pset: ProcessSet, mesh, op: int,
@@ -638,6 +662,145 @@ def _allgather_group_kernel_hier(mesh, n: int,
 
 
 @functools.lru_cache(maxsize=None)
+def _allgather_group_kernel_wide(mesh, n: int, ndev: int,
+                                 rows_per_tensor: Tuple[Tuple[int, ...],
+                                                        ...],
+                                 sig: Tuple):
+    """Device-spanning fused allgather: the packed (pre-padded) bucket
+    is scattered across this process's chips, each chip all_gathers
+    its 1/ndev column slice over 'proc' in parallel, and the
+    intra-host 'dev' all_gather reassembles every rank's full
+    contribution on every chip — the allgather analog of
+    _allreduce_kernel_wide (reference contract: NCCLAllgather is
+    GPU-resident on every rank, SURVEY.md §2.1 NCCL ops). `sig`
+    carries the PADDED per-tensor shapes; rows_per_tensor the true
+    first-dim sizes."""
+    shapes = [s for s, _ in sig]
+    flat_sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+
+    def body(block):                      # (1, 1, k)
+        x = block.reshape(-1)
+        g = lax.all_gather(x, "proc")                        # (n, k)
+        full = lax.all_gather(g, "dev", axis=1, tiled=True)  # (n, B)
+        outs = []
+        off = 0
+        for shape, fsz, rows in zip(shapes, flat_sizes,
+                                    rows_per_tensor):
+            blk = full[:, off:off + fsz].reshape((n,) + shape)
+            pieces = [blk[i, : rows[i]] for i in range(n)]
+            outs.append(jnp.concatenate(pieces, axis=0)[None])
+            off += fsz
+        return tuple(outs)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc", "dev"),
+                       out_specs=tuple(P("proc") for _ in sig),
+                       check_vma=False)
+    return jax.jit(fn)
+
+
+def _rs_dest_major_segs(xs, n: int, rows_per_tensor, maxrs, offsets):
+    """Destination-major packing shared by the flat and wide
+    reduce-scatter kernels (the layout both unpacks depend on): for
+    each destination rank, every tensor's rows for that rank padded to
+    the tensor's per-rank row max, flattened in tensor order."""
+    segs = []
+    for dest in range(n):
+        for t, x in enumerate(xs):
+            rv = rows_per_tensor[t]
+            c = x[offsets[t][dest]:offsets[t][dest] + rv[dest]]
+            if rv[dest] < maxrs[t]:
+                pad_cfg = [(0, maxrs[t] - rv[dest])] + \
+                    [(0, 0)] * (x.ndim - 1)
+                c = jnp.pad(c, pad_cfg)
+            segs.append(c.reshape(-1))
+    return segs
+
+
+@functools.lru_cache(maxsize=None)
+def _rs_pack_kernel(sig: Tuple, n: int,
+                    rows_per_tensor: Tuple[Tuple[int, ...], ...],
+                    ndev: int):
+    """Destination-major pack for the wide reduce-scatter (one cached
+    local launch): per-dest blocks of identical size S
+    (_rs_dest_major_segs), then the S columns are split across local
+    chips: output row j holds every dest's j-th column chunk, ready
+    for a per-chip psum_scatter over 'proc'."""
+    maxrs = [max(rv) for rv in rows_per_tensor]
+    offsets = [np.concatenate([[0], np.cumsum(rv)]).tolist()
+               for rv in rows_per_tensor]
+
+    def fn(*xs):
+        segs = _rs_dest_major_segs(xs, n, rows_per_tensor, maxrs,
+                                   offsets)
+        buf = jnp.concatenate(segs).reshape(n, -1)     # (n, S)
+        S = buf.shape[1]
+        pad = (-S) % ndev
+        if pad:
+            buf = jnp.pad(buf, ((0, 0), (0, pad)))
+        return buf.reshape(n, ndev, -1).transpose(1, 0, 2).reshape(
+            ndev, -1)
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _reducescatter_group_kernel_wide(mesh, n: int, ndev: int, op: int,
+                                     prescale: float, postscale: float,
+                                     sp: int):
+    """Device-spanning fused reduce-scatter over the dest-major packed
+    bucket: each chip psum_scatters its 1/ndev column chunk of every
+    destination block over 'proc' (parallel ICI), then the intra-host
+    'dev' all_gather reassembles this rank's full block on every chip.
+    `sp` is the padded per-dest block size (reference: NCCLReducescatter
+    is GPU-resident on every rank, SURVEY.md §2.1 NCCL ops)."""
+
+    def body(block):                      # (1, 1, n*sp/ndev)
+        x = block.reshape(n, sp // ndev)
+        if prescale != 1.0:
+            x = x * jnp.asarray(prescale, x.dtype)
+        red = lax.psum_scatter(x, "proc", scatter_dimension=0,
+                               tiled=True)             # (1, sp/ndev)
+        if op == AVERAGE:
+            red = red / jnp.asarray(n, red.dtype)
+        if postscale != 1.0:
+            red = red * jnp.asarray(postscale, red.dtype)
+        full = lax.all_gather(red.reshape(-1), "dev", tiled=True)
+        return full[None]                              # (1, sp)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc", "dev"),
+                       out_specs=P("proc"), check_vma=False)
+    return jax.jit(fn)
+
+
+def _reducescatter_group_wide(xs, pset: ProcessSet, mesh, op: int,
+                              prescale: float, postscale: float,
+                              rows: Tuple[Tuple[int, ...], ...]):
+    """Run the device-spanning reduce-scatter; returns this rank's
+    trimmed row blocks (same contract as reducescatter_group)."""
+    n = mesh.shape["proc"]
+    ndev = mesh.shape["dev"]
+    sig = _sig(xs)
+    packed = _rs_pack_kernel(sig, n, rows, ndev)(*xs)  # (ndev, n*spd)
+    g = _scatter_rows(packed, pset, mesh)
+    sp = packed.shape[1] // n * ndev
+    kern = _reducescatter_group_kernel_wide(mesh, n, ndev, op,
+                                            float(prescale),
+                                            float(postscale), sp)
+    out = local_shard(kern(g))                         # (sp,)
+    me = pset.rank()
+    shapes = [s for s, _ in sig]
+    maxrs = [max(rv) for rv in rows]
+    rests = [int(np.prod(s[1:])) if len(s) > 1 else 1 for s in shapes]
+    outs = []
+    off = 0
+    for t, s in enumerate(shapes):
+        sz = maxrs[t] * rests[t]
+        seg = out[off:off + sz].reshape((maxrs[t],) + tuple(s[1:]))
+        outs.append(seg[: rows[t][me]])
+        off += sz
+    return outs
+
+
+@functools.lru_cache(maxsize=None)
 def _alltoall_kernel(mesh, n: int, maxsplit: int, sig: Tuple):
     """All-to-all of padded per-destination chunks. Input block is
     (1, n, maxsplit, *rest); output block is (1, n, maxsplit, *rest)
@@ -653,6 +816,48 @@ def _alltoall_kernel(mesh, n: int, maxsplit: int, sig: Tuple):
 
     fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc"),
                        out_specs=P("proc"))
+    return jax.jit(fn)
+
+
+def _a2a_pack_wide(x, n: int, splits, ms2: int, ndev: int):
+    """Pack for the wide alltoall (inline jnp ops, like the flat
+    path's pack — NOT a cached kernel: splits change per step, and a
+    per-splits compile cache would grow without bound): chunk per
+    destination padded to ms2 (the global maxsplit rounded up to a
+    multiple of ndev), then the padded-row axis is split across local
+    chips — output row j carries every destination's j-th row slab."""
+    chunks = []
+    off = 0
+    for s in splits:
+        c = x[off:off + s]
+        if s < ms2:
+            pad = [(0, ms2 - s)] + [(0, 0)] * (x.ndim - 1)
+            c = jnp.pad(c, pad)
+        chunks.append(c)
+        off += s
+    packed = jnp.stack(chunks)          # (n, ms2, *rest)
+    p2 = packed.reshape((n, ndev, ms2 // ndev) + packed.shape[2:])
+    return jnp.moveaxis(p2, 1, 0).reshape(ndev, -1)
+
+
+@functools.lru_cache(maxsize=None)
+def _alltoall_kernel_wide(mesh, n: int, ndev: int, ms2: int,
+                          rest: Tuple[int, ...], dtype: str):
+    """Device-spanning alltoall: each chip exchanges its 1/ndev row
+    slab of every destination chunk over 'proc' in parallel, then the
+    intra-host 'dev' all_gather (on the row axis) reassembles the
+    received chunks on every chip (reference: NCCLAlltoall is
+    GPU-resident on every rank, SURVEY.md §2.1 NCCL ops)."""
+    msd = ms2 // ndev
+
+    def body(block):                      # (1, 1, n*msd*prod(rest))
+        x = block.reshape((n, msd) + rest)
+        out = lax.all_to_all(x, "proc", split_axis=0, concat_axis=0)
+        full = lax.all_gather(out, "dev", axis=1, tiled=True)
+        return full[None]                 # (1, n, ms2, *rest)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc", "dev"),
+                       out_specs=P("proc"), check_vma=False)
     return jax.jit(fn)
 
 
@@ -991,12 +1196,19 @@ def allgather(tensor: jax.Array, pset: ProcessSet,
     first-dim size (exchanged by the caller via the control plane)."""
     x = _as_local(tensor)
     n = pset.size
-    was_bool = _is_bool(x)
-    if was_bool:
-        x = x.astype(jnp.uint8)
     if n == 1:
         return tensor
     maxr = max(all_rows)
+    rest = int(np.prod(x.shape[1:])) if x.ndim > 1 else 1
+    if (_hier_mesh(pset) is None
+            and _wide_mesh(pset, maxr * rest) is not None):
+        # Single tensor = group of one through the device-spanning
+        # kernel, exactly like broadcast() does (routing decided
+        # BEFORE padding — the group path pads itself).
+        return allgather_group([tensor], pset, [all_rows])[0]
+    was_bool = _is_bool(x)
+    if was_bool:
+        x = x.astype(jnp.uint8)
     if x.shape[0] < maxr:
         pad = [(0, maxr - x.shape[0])] + [(0, 0)] * (x.ndim - 1)
         x = jnp.pad(x, pad)
@@ -1049,10 +1261,26 @@ def allgather_group(tensors: List[jax.Array], pset: ProcessSet,
         spec = P(("cross", "local"))
         gouts = kern(*[to_global(x, pset, mesh=mesh2, spec=spec)
                        for x in padded])
+        _note_op("allgather", "hier", mesh2)
     else:
+        total = sum(int(np.prod(x.shape)) for x in padded)
+        wmesh = (_wide_mesh(pset, total)
+                 if len({str(x.dtype) for x in padded}) == 1 else None)
+        if wmesh is not None:
+            # Device-spanning path: the bucket's columns split across
+            # local chips; single wire dtype guaranteed by the ag fuse
+            # key for controller batches (mixed direct calls fall back).
+            g, psig = _scatter_packed(padded, pset, wmesh)
+            kern = _allgather_group_kernel_wide(
+                wmesh, n, wmesh.shape["dev"], tuple(rows), psig)
+            outs = [local_shard(o) for o in kern(g)]
+            _note_op("allgather", "wide", wmesh)
+            return [o.astype(jnp.bool_) if b else o
+                    for o, b in zip(outs, bools)]
         kern = _allgather_group_kernel(pset.mesh, n, tuple(rows),
                                        _sig(padded))
         gouts = kern(*[to_global(x, pset) for x in padded])
+        _note_op("allgather", "flat", pset.mesh)
     outs = [local_shard(g) for g in gouts]
     return [o.astype(jnp.bool_) if b else o
             for o, b in zip(outs, bools)]
@@ -1115,11 +1343,34 @@ def alltoall(tensor: jax.Array, splits: Sequence[int],
         if use_ragged:
             out = _alltoall_ragged(x, splits, recv_splits, pset,
                                    matrix, buckets)
+            _note_op("alltoall", "ragged", pset.mesh)
             return out.astype(jnp.bool_) if was_bool else out
     else:
         _last_alltoall_stats.update(
             path="padded", wire_rows=n * int(maxsplit),
             ragged_rows=None, padded_rows=n * int(maxsplit))
+    rest_elems = int(np.prod(rest)) if rest else 1
+    wmesh = _wide_mesh(pset, n * int(maxsplit) * rest_elems)
+    if wmesh is not None:
+        # Device-spanning padded exchange: each chip moves its 1/ndev
+        # row slab of every destination chunk over 'proc' in parallel.
+        ndev = wmesh.shape["dev"]
+        ms2 = int(maxsplit) + ((-int(maxsplit)) % ndev)
+        packed = _a2a_pack_wide(x, n, splits, ms2, ndev)
+        g = _scatter_rows(packed, pset, wmesh)
+        kern = _alltoall_kernel_wide(wmesh, n, ndev, ms2, rest,
+                                     str(x.dtype))
+        received = local_shard(kern(g))       # (n, ms2, *rest)
+        _note_op("alltoall", "wide", wmesh)
+        # Keep the two introspection surfaces consistent: the wide
+        # kernel moved n*ms2 rows per rank, not the flat decision's.
+        _last_alltoall_stats.update(
+            path="wide", wire_rows=n * ms2,
+            padded_rows=n * int(maxsplit))
+        pieces = [received[i, : recv_splits[i]] for i in range(n)]
+        out = jnp.concatenate(pieces, axis=0) if pieces else jnp.zeros(
+            (0,) + rest, x.dtype)
+        return out.astype(jnp.bool_) if was_bool else out
     # Pack into (n, maxsplit, *rest) with chunk for dest i at [i].
     chunks = []
     off = 0
@@ -1136,6 +1387,7 @@ def alltoall(tensor: jax.Array, splits: Sequence[int],
     pieces = [received[i, : recv_splits[i]] for i in range(n)]
     out = jnp.concatenate(pieces, axis=0) if pieces else jnp.zeros(
         (0,) + rest, x.dtype)
+    _note_op("alltoall", "flat", pset.mesh)
     return out.astype(jnp.bool_) if was_bool else out
 
 
@@ -1152,9 +1404,16 @@ def reducescatter(tensor: jax.Array, pset: ProcessSet, op: int,
         raise ValueError(
             f"reducescatter needs first dim >= set size ({d0} < {n})")
     rows = reducescatter_rows(d0, n)
+    if (op in (SUM, AVERAGE)
+            and _wide_mesh(pset, int(np.prod(x.shape))) is not None):
+        # Single tensor = group of one through the device-spanning
+        # kernel (same routing as broadcast/allgather).
+        return reducescatter_group([x], pset, op, prescale,
+                                   postscale)[0]
     kern = _reducescatter_kernel(pset.mesh, n, op, float(prescale),
                                  float(postscale), rows, _sig([x]))
     out = local_shard(kern(to_global(x, pset)))
+    _note_op("reducescatter", "flat", pset.mesh)
     my_rows = rows[pset.rank()]
     return out[:my_rows]
 
@@ -1182,16 +1441,8 @@ def _reducescatter_group_kernel(mesh, n: int, op: int, prescale: float,
 
     def body(*blocks):
         xs = [b[0] for b in blocks]
-        segs = []
-        for dest in range(n):
-            for t, x in enumerate(xs):
-                rv = rows_per_tensor[t]
-                c = x[offsets[t][dest]:offsets[t][dest] + rv[dest]]
-                if rv[dest] < maxrs[t]:
-                    pad_cfg = [(0, maxrs[t] - rv[dest])] + \
-                        [(0, 0)] * (x.ndim - 1)
-                    c = jnp.pad(c, pad_cfg)
-                segs.append(c.reshape(-1))
+        segs = _rs_dest_major_segs(xs, n, rows_per_tensor, maxrs,
+                                   offsets)
         buf = jnp.concatenate(segs)
         if prescale != 1.0:
             buf = buf * jnp.asarray(prescale, buf.dtype)
@@ -1240,12 +1491,25 @@ def reducescatter_group(tensors: List[jax.Array], pset: ProcessSet,
                 f"reducescatter needs first dim >= set size "
                 f"({x.shape[0]} < {n})")
     rows = tuple(reducescatter_rows(x.shape[0], n) for x in xs)
+    total = sum(int(np.prod(x.shape)) if x.shape else 1 for x in xs)
+    wmesh = (_wide_mesh(pset, total)
+             if (len({str(x.dtype) for x in xs}) == 1
+                 and op in (SUM, AVERAGE)) else None)
+    if wmesh is not None:
+        # Device-spanning path: per-chip psum_scatter of the bucket's
+        # column chunks (single dtype guaranteed by the rs fuse key
+        # for controller batches; min/max/product have no psum_scatter
+        # decomposition and keep the flat kernel).
+        _note_op("reducescatter", "wide", wmesh)
+        return _reducescatter_group_wide(xs, pset, wmesh, op,
+                                         prescale, postscale, rows)
     kern = _reducescatter_group_kernel(pset.mesh, n, op,
                                        float(prescale),
                                        float(postscale), rows,
                                        _sig(xs))
     gouts = kern(*[to_global(x, pset) for x in xs])
     me = pset.rank()
+    _note_op("reducescatter", "flat", pset.mesh)
     return [local_shard(g)[:rows[t][me]]
             for t, g in enumerate(gouts)]
 
