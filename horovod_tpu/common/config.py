@@ -100,11 +100,12 @@ KNOBS: List[Knob] = [
          "Adasum exchange schedule: 'vhdd' = recursive vector-halving/"
          "distance-doubling (log2(n) ppermute rounds, O(bucket) wire "
          "and HBM per rank — the reference's adasum.h schedule; "
-         "power-of-two sets only); 'gather' = one all_gather + local "
-         "binary-tree fold (O(n*bucket) per rank, any size); 'auto' "
-         "(default) = vhdd when the set size is a power of two "
-         "(complex dtypes and a forced HOROVOD_ADASUM_PALLAS=1 fall "
-         "back to gather; an explicit vhdd outranks the pallas "
+         "non-power-of-two sets run it per pow2 block of the binary "
+         "decomposition plus masked-psum merges, still gather-free); "
+         "'gather' = one all_gather + local binary-tree fold "
+         "(O(n*bucket) per rank); 'auto' (default) = vhdd for any "
+         "size (complex dtypes and a forced HOROVOD_ADASUM_PALLAS=1 "
+         "fall back to gather; an explicit vhdd outranks the pallas "
          "force)."),
     Knob("HOROVOD_ADASUM_PALLAS", str, "auto",
          "Adasum pair-combine implementation: 'auto' = fused Pallas "

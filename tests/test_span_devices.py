@@ -14,7 +14,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 @pytest.mark.integration
-@pytest.mark.parametrize("np_,devs", [(2, 2), (8, 2)])
+@pytest.mark.parametrize("np_,devs", [(2, 2), (3, 2), (8, 2)])
 def test_eager_span_devices(np_, devs):
     """`hvd.allreduce` reduces over (processes x local devices): the
     wide mesh covers every device and the summed payload is exact."""
