@@ -324,10 +324,12 @@ def _merge_pass(seg, me, n: int, ra: int, rb: int, dot_reduce=None):
     union = tuple(range(ra, n))
     groups = (union,) + tuple((i,) for i in range(ra))
     zeros = jnp.zeros_like(seg)
-    x = lax.psum(jnp.where(me == ra, seg, zeros), "proc",
-                 axis_index_groups=groups)
-    y = lax.psum(jnp.where(me == rb, seg, zeros), "proc",
-                 axis_index_groups=groups)
+    # one stacked psum instead of two: same bytes, half the
+    # collective round trips per merge.
+    masked = jnp.stack([jnp.where(me == ra, seg, zeros),
+                        jnp.where(me == rb, seg, zeros)])
+    xy = lax.psum(masked, "proc", axis_index_groups=groups)
+    x, y = xy[0], xy[1]
     dots = _partial_dots(x, y, dot_reduce)
     ca, cb = _adasum_coeffs(dots[0], dots[1], dots[2])
     out = ca.astype(x.dtype) * x + cb.astype(y.dtype) * y
