@@ -42,6 +42,7 @@ above tunnel noise), BENCH_WARMUP (5), BENCH_IMAGE (224),
 BENCH_PROFILE (trace dir), BENCH_PEAK_TFLOPS.
 """
 
+import functools
 import json
 import os
 import sys
@@ -290,11 +291,40 @@ def eager_main(model_name: str = "resnet50"):
              for path, _ in flat0]
     n_leaves = len(names)
 
-    @jax.jit
+    # donate params/opt_state: the adamw moments (3.5 GB f32 for the
+    # flagship) update in place instead of into fresh buffers — the
+    # same donation the jit train step's compiled program gets.
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def apply_fn(params, opt_state, reduced_leaves):
         grads = jax.tree_util.tree_unflatten(treedef, reduced_leaves)
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state
+
+    # BENCH_EAGER_PIPELINED=1: fuse step i's optimizer apply with step
+    # i+1's grad into ONE program (apply-then-grad), keeping the
+    # eager collective between grad output and the next call. On TPU,
+    # programs serialize on the device, so a separate apply program's
+    # HBM traffic (~8.7 GB for the flagship's adamw moments) cannot
+    # hide under compute; fused with the next step's backward it can —
+    # the same latency hiding the jit path gets. The warmup performs
+    # one zero-grad apply (skipped via an is-first flag so adamw's
+    # weight decay is not spuriously applied).
+    pipelined = (os.environ.get("BENCH_EAGER_PIPELINED") == "1"
+                 and not hooks_mode)
+
+    @functools.partial(jax.jit, donate_argnums=(1, 2),
+                       static_argnames=("first",))
+    def apply_grad_fn(reduced_leaves, opt_state, params, batch_stats,
+                      first=False):
+        if not first:
+            grads_in = jax.tree_util.tree_unflatten(
+                treedef, reduced_leaves)
+            updates, opt_state = opt.update(grads_in, opt_state,
+                                            params)
+            params = optax.apply_updates(params, updates)
+        (loss, batch_stats), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params, batch_stats, images, labels)
+        return params, opt_state, batch_stats, loss, grads
 
     rng = np.random.default_rng(0)
     if transformer:
@@ -310,8 +340,18 @@ def eager_main(model_name: str = "resnet50"):
             rng.integers(0, 1000, batch_per_chip), jnp.int32)
 
     rop = hvd.Adasum if adasum else None
+    # BENCH_EAGER_COMPRESSION: fp16 (default; the reference's GPU wire
+    # dtype, BASELINE config 3), bf16 (the TPU-native wire dtype — for
+    # a bf16 model wire == raw, so the compress roundtrip vanishes and
+    # multi-rank wire bytes still halve vs f32), none (isolates the
+    # roundtrip's cost).
+    comp = {"none": Compression.none,
+            "bf16": Compression.bf16}.get(
+        os.environ.get("BENCH_EAGER_COMPRESSION", "fp16"),
+        Compression.fp16)
     log(f"bench[eager]: mode={'hooks' if hooks_mode else 'grouped'}"
-        f" op={'Adasum' if adasum else 'Average'}")
+        f" op={'Adasum' if adasum else 'Average'}"
+        f" compression={comp.__name__}")
 
     phase_times = os.environ.get("BENCH_PHASE_TIMES")
 
@@ -327,7 +367,7 @@ def eager_main(model_name: str = "resnet50"):
             for i in range(n_leaves - 1, -1, -1):
                 handles[i] = C.allreduce_async(
                     leaves[i], name=names[i], op=rop,
-                    compression=Compression.fp16)
+                    compression=comp)
             t2 = time.perf_counter()
             reduced = [C.synchronize(h) for h in handles]
             if phase_times:
@@ -340,14 +380,28 @@ def eager_main(model_name: str = "resnet50"):
             # composition, response-cache-friendly stable name).
             reduced = C.grouped_allreduce(
                 leaves, name="DistributedOptimizer.grouped_allreduce",
-                op=rop, compression=Compression.fp16)
+                op=rop, compression=comp)
         params, opt_state = apply_fn(params, opt_state, reduced)
         return params, opt_state, batch_stats, loss
 
+    def step_pipe(params, opt_state, batch_stats, grads):
+        leaves = jax.tree_util.tree_flatten(grads)[0]
+        reduced = C.grouped_allreduce(
+            leaves, name="DistributedOptimizer.grouped_allreduce",
+            op=rop, compression=comp)
+        return apply_grad_fn(reduced, opt_state, params, batch_stats)
+
     t_c0 = time.perf_counter()
-    for _ in range(warmup):
-        params, opt_state, batch_stats, loss = run_step(
-            params, opt_state, batch_stats)
+    if pipelined:
+        params, opt_state, batch_stats, loss, grads = apply_grad_fn(
+            None, opt_state, params, batch_stats, first=True)
+        for _ in range(warmup):
+            params, opt_state, batch_stats, loss, grads = step_pipe(
+                params, opt_state, batch_stats, grads)
+    else:
+        for _ in range(warmup):
+            params, opt_state, batch_stats, loss = run_step(
+                params, opt_state, batch_stats)
     log(f"bench[eager]: warmup ({warmup} steps, compiles) "
         f"{time.perf_counter() - t_c0:.1f}s loss={float(loss):.3f} "
         f"leaves={n_leaves}")
@@ -357,8 +411,12 @@ def eager_main(model_name: str = "resnet50"):
     t0 = time.perf_counter()
     tprev = t0
     for i in range(steps):
-        params, opt_state, batch_stats, loss = run_step(
-            params, opt_state, batch_stats)
+        if pipelined:
+            params, opt_state, batch_stats, loss, grads = step_pipe(
+                params, opt_state, batch_stats, grads)
+        else:
+            params, opt_state, batch_stats, loss = run_step(
+                params, opt_state, batch_stats)
         if os.environ.get("BENCH_STEP_TIMES"):
             jax.block_until_ready(loss)
             tnow = time.perf_counter()

@@ -39,6 +39,9 @@ from .metadata import (  # noqa: F401
 from .optim.distributed_optimizer import (  # noqa: F401
     DistributedOptimizer, DistributedGradientTransformation,
 )
+from .optim.pipelined import (  # noqa: F401
+    PipelinedState, make_pipelined_step,
+)
 from .optim.functions import (  # noqa: F401
     broadcast_parameters, broadcast_optimizer_state, broadcast_object,
     allgather_object, allreduce_parameters,

@@ -114,14 +114,20 @@ def grouped_allreduce_async(tensors: List[jax.Array], average=None,
 
     ctl = _controller_for(st, pset)
     if ctl is not None:
-        # Same-dtype negotiation units (mixed-dtype groups split, as
-        # the reference controller only fuses same-dtype responses).
+        # Same-WIRE-dtype negotiation units: raw dtypes that compress
+        # to one wire dtype (e.g. bf16 weights + f32 norms under fp16
+        # compression) submit as ONE entry and fuse into one program —
+        # the casts fold into the fused kernel (improves on the
+        # reference's same-raw-dtype FuseResponses rule). Groups
+        # mixing wire dtypes split per wire bucket.
+        from .compression import wire_dtype_of
         wires = [jnp.asarray(t) for t in tensors]
-        if len({str(w.dtype) for w in wires}) == 1:
+        if len({str(wire_dtype_of(compression, w.dtype))
+                for w in wires}) == 1:
             return ctl.submit_allreduce(
                 name, wires, pset, rop, prescale_factor,
                 postscale_factor, compression, grouped=True).id
-        # mixed dtypes: one grouped submission per dtype bucket,
+        # mixed wire dtypes: one grouped submission per wire bucket,
         # synchronized under one umbrella handle.
         return _controller_mixed_group(
             st, name, wires, pset, rop, prescale_factor,
@@ -137,9 +143,11 @@ def grouped_allreduce_async(tensors: List[jax.Array], average=None,
 
 def _controller_mixed_group(st, name, wires, pset, rop, prescale,
                             postscale, compression) -> int:
-    by_dtype: dict = {}
+    from .compression import wire_dtype_of
+    by_dtype: dict = {}  # wire dtype -> tensor indices
     for i, w in enumerate(wires):
-        by_dtype.setdefault(str(w.dtype), []).append(i)
+        by_dtype.setdefault(
+            str(wire_dtype_of(compression, w.dtype)), []).append(i)
     subs = []
     for dt, idxs in by_dtype.items():
         h = st.engine.controller.submit_allreduce(

@@ -12,7 +12,10 @@ order — the core does — which is what relaxes JAX's same-program-order
 requirement to Horovod's "submit whenever ready" contract.
 
 Signature format (the Request metadata; reference: message.fbs):
-  allreduce:  "ar|<wiredtype>|<rawdtype>|<op>|<pset>|<pre>|<post>#s0xs1,...;..."
+  allreduce:  "ar|<wiredtype>|<op>|<pset>|<pre>|<post>#<raw0>:s0xs1;<raw1>:...
+              (fusion keys on the WIRE dtype; per-tensor raw dtypes
+              ride the metadata so different raws sharing a wire
+              dtype fuse — see allreduce_sig)"
   broadcast:  "bc|<dtype>|<root>|<pset>#s0xs1..."
   allgather:  "ag|<dtype>|<pset>#r0xr1..."  (trailing dims only; the
               per-rank first-dim size rides the Request meta)
@@ -59,29 +62,39 @@ class JoinError(RuntimeError):
     pass
 
 
-def allreduce_sig(wire_dtype, raw_dtype, shapes_list, rop: int,
+def allreduce_sig(wire_dtype, raw_dtypes, shapes_list, rop: int,
                   pset_id: int, prescale: float, postscale: float) -> str:
-    """Fuse key + shape metadata. `wire_dtype` is the ON-WIRE dtype
-    (after compression) — computed WITHOUT casting; the cast itself
-    runs inside the fused dispatch kernel. `raw_dtype` (the submitted
-    tensors' dtype) rides the key too so a joined rank can zero-fill
-    raw-dtype tensors and lower the IDENTICAL fused program the live
-    ranks do (the compress cast included) — wire-dtype-only zero-fill
-    made ranks jit different programs around one collective."""
+    """Fuse key + per-tensor metadata. The key holds only the ON-WIRE
+    dtype (after compression, computed WITHOUT casting — the cast runs
+    inside the fused dispatch kernel), so entries whose DIFFERENT raw
+    dtypes compress to one wire dtype fuse into ONE negotiated
+    batch/XLA program. This deliberately improves on the reference's
+    same-dtype FuseResponses rule (controller.cc): under XLA the
+    per-tensor casts fold into the fused kernel for free, and a
+    bf16-model + f32-norm gradient pytree with fp16 compression costs
+    ONE launch per step instead of two. Per-tensor raw dtypes ride the
+    metadata past the '#' so a joined rank can still zero-fill each
+    tensor in its true raw dtype and lower the IDENTICAL fused program
+    the live ranks do (raw-blind zero-fill made ranks jit different
+    programs around one collective)."""
     shapes = ";".join(
-        "x".join(str(d) for d in s) for s in shapes_list)
-    return (f"ar|{jnp.dtype(wire_dtype)}|{jnp.dtype(raw_dtype)}|{rop}|"
+        f"{jnp.dtype(rd)}:" + "x".join(str(d) for d in s)
+        for rd, s in zip(raw_dtypes, shapes_list))
+    return (f"ar|{jnp.dtype(wire_dtype)}|{rop}|"
             f"{pset_id}|{prescale}|{postscale}#{shapes}")
 
 
 def parse_allreduce_sig(sig: str):
+    """-> (wire_dt, rop, pset_id, pre, post, metas) with metas a list
+    of per-tensor (raw_dtype_str, shape_tuple)."""
     head, shapes = sig.split("#", 1)
-    _, wire_dt, raw_dt, rop, pset_id, pre, post = head.split("|")
-    shape_list = []
+    _, wire_dt, rop, pset_id, pre, post = head.split("|")
+    metas = []
     for s in shapes.split(";"):
-        shape_list.append(tuple(int(d) for d in s.split("x") if d))
-    return (wire_dt, raw_dt, int(rop), int(pset_id), float(pre),
-            float(post), shape_list)
+        raw, _, dims = s.partition(":")
+        metas.append((raw, tuple(int(d) for d in dims.split("x") if d)))
+    return (wire_dt, int(rop), int(pset_id), float(pre),
+            float(post), metas)
 
 
 class _PendingAllreduce:
@@ -378,8 +391,18 @@ class NegotiatedController:
         h = self.engine.new_handle(name)
         from .compression import wire_dtype_of
         tensors = [jnp.asarray(t) for t in tensors]
-        wire_dt = wire_dtype_of(compression, tensors[0].dtype)
-        sig = allreduce_sig(wire_dt, tensors[0].dtype,
+        wires = [wire_dtype_of(compression, t.dtype) for t in tensors]
+        if len({str(w) for w in wires}) != 1:
+            # the grouped front-end splits by wire dtype before
+            # submitting; a direct caller mixing wires gets a clean
+            # error on the handle, not a corrupt fuse key.
+            h.set_error(ValueError(
+                f"grouped allreduce submission mixes wire dtypes "
+                f"{sorted({str(w) for w in wires})}; split by wire "
+                "dtype first (grouped_allreduce does this)"))
+            return h
+        wire_dt = wires[0]
+        sig = allreduce_sig(wire_dt, [t.dtype for t in tensors],
                             [t.shape for t in tensors], rop,
                             pset.process_set_id, prescale, postscale)
         nbytes = int(sum(np.prod(t.shape) for t in tensors)
@@ -751,22 +774,26 @@ class NegotiatedController:
     def _execute_allreduce_batch(self, entries):
         """One fused launch for the whole agreed batch (the fusion
         buffer analog: same fuse key == same dtype/op/pset/scales)."""
-        wire_dt, raw_dt, rop, pset_id, pre, post, _ = \
+        wire_dt, rop, pset_id, pre, post, _ = \
             parse_allreduce_sig(entries[0].sig)
         pset = self.engine.pset_table.get(pset_id)
         active = entries[0].active_ranks
 
         from .compression import compressor_for
-        # Zero-fill compressor reconstructed ONCE, outside the pop
-        # loop: if it cannot be reconstructed (a custom compressor's
-        # wire dtype no built-in maps to), every handle in the batch
-        # must error cleanly — raising mid-loop would strand
-        # already-popped handles in synchronize() forever.
-        zcomp, zcomp_err = None, None
-        try:
-            zcomp = compressor_for(raw_dt, wire_dt)
-        except ValueError as ex:
-            zcomp_err = ex
+
+        def fail_batch(err, slots):
+            # Error every handle in the batch cleanly — raising
+            # mid-loop would strand already-popped handles in
+            # synchronize() forever.
+            for _, pp, _ in slots:
+                if pp is not None:
+                    pp.handle.set_error(err)
+            for e2 in entries:
+                with self._mu:
+                    p2 = self._pending.pop(e2.name, None)
+                if p2 is not None:
+                    p2.handle.set_error(err)
+
         tensors = []
         compressors = []
         slots = []   # (entry, pending|None, count)
@@ -775,25 +802,23 @@ class NegotiatedController:
                 p = self._pending.pop(e.name, None)
             if p is None:
                 # joined rank: participate with zeros of the agreed
-                # shapes in the RAW dtype, compressed by the same
-                # compressor class the live ranks use, so every rank
-                # lowers the identical fused kernel (reference: JoinOp
-                # zero contribution; multi-controller JAX requires the
-                # same program on every rank).
-                if zcomp is None:
-                    for _, pp, _ in slots:
-                        if pp is not None:
-                            pp.handle.set_error(zcomp_err)
-                    for e2 in entries:
-                        with self._mu:
-                            p2 = self._pending.pop(e2.name, None)
-                        if p2 is not None:
-                            p2.handle.set_error(zcomp_err)
+                # shapes in each tensor's RAW dtype, compressed by the
+                # same compressor class the live ranks use, so every
+                # rank lowers the identical fused kernel (reference:
+                # JoinOp zero contribution; multi-controller JAX
+                # requires the same program on every rank).
+                metas = parse_allreduce_sig(e.sig)[5]
+                try:
+                    zcomps = [compressor_for(raw, wire_dt)
+                              for raw, _ in metas]
+                except ValueError as ex:
+                    # a custom compressor's wire dtype no built-in
+                    # maps to: fail the whole batch cleanly.
+                    fail_batch(ex, slots)
                     return
-                _, _, _, _, _, _, shapes = parse_allreduce_sig(e.sig)
-                zeros = [jnp.zeros(s, raw_dt) for s in shapes]
+                zeros = [jnp.zeros(s, raw) for raw, s in metas]
                 tensors.extend(zeros)
-                compressors.extend([zcomp] * len(zeros))
+                compressors.extend(zcomps)
                 slots.append((e, None, len(zeros)))
             else:
                 tensors.extend(p.tensors)

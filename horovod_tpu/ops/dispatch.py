@@ -265,13 +265,18 @@ def _wide_mesh(pset: ProcessSet, total_elems: int):
 
 
 @functools.lru_cache(maxsize=None)
-def _pack_kernel(sig: Tuple, ndev: int):
+def _pack_kernel(sig: Tuple, ndev: int, wire_dt: Optional[str] = None):
     """Flatten+concat a group and fold to (ndev, k) rows for the wide
     allreduce (pads to a multiple of ndev). One cached local launch —
-    the host-side half of MemcpyInFusionBuffer."""
+    the host-side half of MemcpyInFusionBuffer. `wire_dt` casts each
+    tensor to the shared wire dtype BEFORE the concat, which is what
+    lets different raw dtypes (bf16 weights + f32 norms under fp16
+    compression) ride one packed bucket."""
 
     def fn(*xs):
         flats = [x.reshape(-1) for x in xs]
+        if wire_dt is not None:
+            flats = [f.astype(wire_dt) for f in flats]
         concat = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
         pad = (-concat.shape[0]) % ndev
         if pad:
@@ -284,21 +289,21 @@ def _pack_kernel(sig: Tuple, ndev: int):
 @functools.lru_cache(maxsize=None)
 def _allreduce_kernel_wide(mesh, n: int, ndev: int, op: int,
                            prescale: float, postscale: float,
-                           sig: Tuple, wire_dt: Optional[str]):
+                           sig: Tuple, wire_dt: Optional[str],
+                           raws: Optional[Tuple[str, ...]] = None):
     """Fused allreduce over the ('proc','dev') mesh. Input is the
-    packed (n, ndev, k) bucket sharded over both axes; each (proc,dev)
-    cell reduces its k-element shard across processes, then the 'dev'
-    all_gather reassembles the bucket on every local chip. `wire_dt`
-    (batch-uniform by fuse key) folds the compression cast in."""
+    packed (n, ndev, k) bucket sharded over both axes — ALREADY cast
+    to `wire_dt` by the pack when compression is active; each
+    (proc,dev) cell reduces its k-element shard across processes,
+    then the 'dev' all_gather reassembles the bucket on every local
+    chip and each output segment casts back to its tensor's raw dtype
+    (`raws`; raw dtypes may differ — the wire-keyed fuse rule)."""
     shapes = [s for s, _ in sig]
     sizes = [int(np.prod(s)) if s else 1 for s in shapes]
     total = sum(sizes)
 
     def body(block):                      # (1, 1, k)
         x = block.reshape(-1)
-        raw_dt = x.dtype
-        if wire_dt is not None:
-            x = x.astype(wire_dt)
         if prescale != 1.0:
             x = x * jnp.asarray(prescale, x.dtype)
         if op in (SUM, AVERAGE, ADASUM):
@@ -317,12 +322,13 @@ def _allreduce_kernel_wide(mesh, n: int, ndev: int, op: int,
         if postscale != 1.0:
             red = red * jnp.asarray(postscale, red.dtype)
         full = lax.all_gather(red, "dev", tiled=True)   # (ndev*k,)
-        if wire_dt is not None:
-            full = full.astype(raw_dt)
         outs = []
         off = 0
-        for s, sz in zip(shapes, sizes):
-            outs.append(full[off:off + sz].reshape((1,) + s))
+        for i, (s, sz) in enumerate(zip(shapes, sizes)):
+            o = full[off:off + sz]
+            if wire_dt is not None:
+                o = o.astype(raws[i])
+            outs.append(o.reshape((1,) + s))
             off += sz
         return tuple(outs)
 
@@ -334,16 +340,20 @@ def _allreduce_kernel_wide(mesh, n: int, ndev: int, op: int,
     return jax.jit(fn)
 
 
-def _wide_wire_dtype(tensors, compressors) -> Tuple[bool, Optional[str]]:
-    """(usable, wire_dtype_name): the wide kernel casts the whole
-    bucket at once, which is only valid when the group shares one raw
-    and one wire dtype (guaranteed for controller batches by the fuse
-    key; direct callers may mix — fall back to the flat kernel)."""
-    raw = {str(t.dtype) for t in tensors}
-    if len(raw) != 1:
-        return False, None
+def _wide_wire_dtype(tensors, compressors
+                     ) -> Tuple[bool, Optional[str],
+                                Optional[Tuple[str, ...]]]:
+    """(usable, wire_dtype_name, raw_dtype_names): the wide kernels
+    cast each tensor to the shared wire dtype inside the pack and
+    cast each output segment back to its raw dtype — valid when the
+    group shares ONE wire dtype and only cast-type compressors are
+    involved. Raw dtypes MAY differ (bf16 weights + f32 norms under
+    fp16 compression fuse into one wide program — the wire-keyed
+    fuse rule). Direct callers mixing wire dtypes fall back to the
+    flat kernel."""
+    raws = tuple(str(t.dtype) for t in tensors)
     if compressors is None:
-        return True, None
+        return (len(set(raws)) == 1, None, None)
     from .compression import (BF16Compressor, FP16Compressor,
                               NoneCompressor, wire_dtype_of)
     # Only the built-in cast compressors reduce to a bare dtype cast;
@@ -353,13 +363,15 @@ def _wide_wire_dtype(tensors, compressors) -> Tuple[bool, Optional[str]]:
     # compress/decompress per tensor.
     if any(c not in (NoneCompressor, FP16Compressor, BF16Compressor)
            for c in compressors):
-        return False, None
+        return False, None, None
     wires = {str(wire_dtype_of(c, t.dtype))
              for c, t in zip(compressors, tensors)}
     if len(wires) != 1:
-        return False, None
+        return False, None, None
     w = wires.pop()
-    return True, (None if w == raw.pop() else w)
+    if all(r == w for r in raws):
+        return True, None, None
+    return True, w, raws
 
 
 def _scatter_rows(packed, pset: ProcessSet, mesh, spec=None):
@@ -381,37 +393,43 @@ def _scatter_rows(packed, pset: ProcessSet, mesh, spec=None):
         pieces)
 
 
-def _scatter_packed(tensors, pset: ProcessSet, mesh, spec=None):
-    """Pack a group into one flat bucket and scatter its rows across
-    this process's chips (one local pack launch + one sharded
-    device_put), assembling the global (n, ndev, k) array for a wide
-    kernel. Returns (global_array, sig)."""
+def _scatter_packed(tensors, pset: ProcessSet, mesh, spec=None,
+                    wire_dt: Optional[str] = None):
+    """Pack a group into one flat bucket (cast to `wire_dt` when
+    given) and scatter its rows across this process's chips (one
+    local pack launch + one sharded device_put), assembling the
+    global (n, ndev, k) array for a wide kernel.
+    Returns (global_array, sig) — sig is of the RAW tensors."""
     sig = _sig(tensors)
-    packed = _pack_kernel(sig, mesh.shape["dev"])(*tensors)
+    packed = _pack_kernel(sig, mesh.shape["dev"], wire_dt)(*tensors)
     return _scatter_rows(packed, pset, mesh, spec), sig
 
 
 def _allreduce_wide(tensors, pset: ProcessSet, mesh, op: int,
                     prescale: float, postscale: float,
-                    wire_dt: Optional[str]):
+                    wire_dt: Optional[str],
+                    raws: Optional[Tuple[str, ...]] = None):
     """Run the device-spanning allreduce over the scattered bucket."""
-    g, sig = _scatter_packed(tensors, pset, mesh)
+    g, sig = _scatter_packed(tensors, pset, mesh, wire_dt=wire_dt)
     kern = _allreduce_kernel_wide(mesh, mesh.shape["proc"],
                                   mesh.shape["dev"], op,
                                   float(prescale), float(postscale),
-                                  sig, wire_dt)
+                                  sig, wire_dt, raws)
     return [local_shard(o) for o in kern(g)]
 
 
 def _allreduce_hier_wide(tensors, pset: ProcessSet, mesh, n: int,
                          op: int, prescale: float, postscale: float,
-                         wire_dt: Optional[str]):
+                         wire_dt: Optional[str],
+                         raws: Optional[Tuple[str, ...]] = None):
     """Run the hierarchical device-spanning allreduce (the hier
     counterpart of _allreduce_wide; mesh is ('cross','local','dev'))."""
     g, sig = _scatter_packed(tensors, pset, mesh,
-                             spec=P(("cross", "local"), "dev"))
+                             spec=P(("cross", "local"), "dev"),
+                             wire_dt=wire_dt)
     kern = _allreduce_kernel_hier_wide(mesh, n, op, float(prescale),
-                                       float(postscale), sig, wire_dt)
+                                       float(postscale), sig, wire_dt,
+                                       raws)
     return [local_shard(o) for o in kern(g)]
 
 
@@ -530,7 +548,8 @@ def _hier_mesh_wide(pset: ProcessSet):
 @functools.lru_cache(maxsize=None)
 def _allreduce_kernel_hier_wide(mesh, n: int, op: int, prescale: float,
                                 postscale: float, sig: Tuple,
-                                wire_dt: Optional[str]):
+                                wire_dt: Optional[str],
+                                raws: Optional[Tuple[str, ...]] = None):
     """Hierarchical staging composed with device spanning over a
     ('cross','local','dev') mesh. Each chip holds 1/ndev of the packed
     bucket; the reduce-scatter over 'local' (ICI) leaves 1/(local*dev)
@@ -547,10 +566,7 @@ def _allreduce_kernel_hier_wide(mesh, n: int, op: int, prescale: float,
     L = mesh.shape["local"]
 
     def body(block):                      # (1, 1, 1, k)
-        x = block.reshape(-1)
-        raw_dt = x.dtype
-        if wire_dt is not None:
-            x = x.astype(wire_dt)
+        x = block.reshape(-1)             # already wire dtype (pack)
         if prescale != 1.0:
             x = x * jnp.asarray(prescale, x.dtype)
         k0 = x.shape[0]
@@ -573,12 +589,13 @@ def _allreduce_kernel_hier_wide(mesh, n: int, op: int, prescale: float,
         if postscale != 1.0:
             red = red * jnp.asarray(postscale, red.dtype)
         full = lax.all_gather(red, "dev", tiled=True)
-        if wire_dt is not None:
-            full = full.astype(raw_dt)
         outs = []
         off = 0
-        for s, sz in zip(shapes, sizes):
-            outs.append(full[off:off + sz].reshape((1,) + s))
+        for i, (s, sz) in enumerate(zip(shapes, sizes)):
+            o = full[off:off + sz]
+            if wire_dt is not None:
+                o = o.astype(raws[i])
+            outs.append(o.reshape((1,) + s))
             off += sz
         return tuple(outs)
 
@@ -1188,9 +1205,24 @@ def allreduce_group(tensors: List[jax.Array], pset: ProcessSet, op: int,
         if compressors is None:
             return [t * jnp.asarray(scale, t.dtype) if scale != 1.0
                     else t for t in tensors]
-        kern = _compress_roundtrip_kernel(_sig(tensors), compressors,
-                                          float(scale))
-        return list(kern(*tensors))
+        # Identity wires (bf16 model + bf16 compression: wire == raw)
+        # need no roundtrip at all — running the kernel anyway would
+        # copy the whole bucket through HBM for nothing. Only tensors
+        # with a REAL wire cast (or a scale) launch.
+        from .compression import wire_dtype_of
+        work = [i for i, (c, t) in enumerate(zip(compressors, tensors))
+                if scale != 1.0
+                or wire_dtype_of(c, t.dtype) != t.dtype]
+        if not work:
+            return list(tensors)
+        sub = [tensors[i] for i in work]
+        kern = _compress_roundtrip_kernel(
+            _sig(sub), tuple(compressors[i] for i in work),
+            float(scale))
+        outs = list(tensors)
+        for i, o in zip(work, kern(*sub)):
+            outs[i] = o
+        return outs
     sig = _sig(tensors)
     total = sum(int(np.prod(t.shape)) if t.shape else 1
                 for t in tensors)
@@ -1201,19 +1233,20 @@ def allreduce_group(tensors: List[jax.Array], pset: ProcessSet, op: int,
         # precedence — its 'local' axis already spans the slice.
         wmesh = _wide_mesh(pset, total)
         if wmesh is not None:
-            ok, wire_dt = _wide_wire_dtype(tensors, compressors)
+            ok, wire_dt, raws = _wide_wire_dtype(tensors, compressors)
             if ok:
                 _last_allreduce_info.update(
                     path="wide",
                     devices=int(wmesh.devices.size),
                     mesh_shape=dict(wmesh.shape))
                 return _allreduce_wide(tensors, pset, wmesh, op,
-                                       prescale, postscale, wire_dt)
+                                       prescale, postscale, wire_dt,
+                                       raws)
     if mesh2 is not None:
         hw = _hier_mesh_wide(pset)
         if (hw is not None and (_span_devices != "auto" or total >=
                                 hw.shape["dev"] * _WIDE_MIN_ELEMS_PER_DEV)):
-            ok, wire_dt = _wide_wire_dtype(tensors, compressors)
+            ok, wire_dt, raws = _wide_wire_dtype(tensors, compressors)
             if ok:
                 # Hierarchical AND device-spanning: every local chip
                 # carries 1/ndev of the bucket through the three-phase
@@ -1223,7 +1256,7 @@ def allreduce_group(tensors: List[jax.Array], pset: ProcessSet, op: int,
                     mesh_shape=dict(hw.shape))
                 return _allreduce_hier_wide(tensors, pset, hw, n, op,
                                             prescale, postscale,
-                                            wire_dt)
+                                            wire_dt, raws)
         kern = _allreduce_kernel_hier(mesh2, n, op, float(prescale),
                                       float(postscale), sig,
                                       compressors)

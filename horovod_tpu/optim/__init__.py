@@ -5,3 +5,6 @@ from .functions import (  # noqa: F401
     broadcast_parameters, broadcast_optimizer_state, broadcast_object,
     allreduce_parameters,
 )
+from .pipelined import (  # noqa: F401
+    PipelinedState, make_pipelined_step,
+)

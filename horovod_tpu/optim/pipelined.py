@@ -1,0 +1,142 @@
+"""Pipelined eager training step: optimizer apply fused into the
+NEXT step's grad program.
+
+Why this exists (measured on TPU v5e, round 5 — docs/benchmarks.md):
+TPU executes XLA programs serially, so an eager loop split as
+[grad] -> collective -> [apply] cannot hide the optimizer update's
+HBM traffic (~8.7 GB for a 436M-param adamw step) under compute —
+that costs ~1.5-2% vs the jit path, which fuses the update into
+backward and gets the overlap for free. Reordering the fusion as
+[apply_prev + grad] -> collective restores the overlap while keeping
+the collective OUTSIDE the program, exactly where the eager contract
+needs it: step i still computes grads on parameters that have
+absorbed grads i-1, so the math is IDENTICAL to the classic
+grad/reduce/apply loop — only the program boundaries move. With this
+helper the eager path benches at parity (1.00x) with the jit
+transformer step on one chip.
+
+The reference has no analog (CUDA streams overlap kernels from
+separate launches, so torch eager never pays this tax); this is the
+TPU-native counterpart of that overlap.
+
+Usage::
+
+    step = hvd.make_pipelined_step(loss_fn, optimizer,
+                                   compression=hvd.Compression.bf16)
+    state = step.init(params, opt_state, batches[0])  # consumes batch 0
+    for batch in batches[1:]:      # one fused program per iteration
+        state, loss = step(state, batch)
+    params, opt_state = step.finalize(state)   # apply pending grads
+
+init() already computes batch 0's gradients — the loop must continue
+from batches[1], or batch 0 trains twice and the trajectory diverges
+from the classic loop.
+
+`loss_fn(params, batch) -> loss` (or `(loss, aux)` with
+`has_aux=True`; aux is carried through and returned next to loss).
+
+**Buffer donation:** init/step/finalize donate the incoming
+params/opt_state/gradient buffers into the fused program (that is
+half the point — in-place adamw moments). On TPU the caller's
+previous references become invalid: treat `state` as linear (always
+rebind it, never reuse an old one), and `jax.tree_util.tree_map(
+jnp.copy, params)` first if the originals must survive.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..ops import collective_ops as C
+from ..ops.compression import NoneCompressor
+from ..ops.process_set import ProcessSet
+
+
+class PipelinedState(NamedTuple):
+    """Carry between pipelined steps: current params/opt_state plus
+    the UNAPPLIED grads of the last computed step (applied inside the
+    next step's fused program, or by finalize())."""
+    params: Any
+    opt_state: Any
+    grads: Any
+
+
+class _PipelinedStep:
+    def __init__(self, loss_fn, optimizer, op, compression,
+                 process_set: Optional[ProcessSet], has_aux: bool,
+                 name: str):
+        self._loss_fn = loss_fn
+        self._opt = optimizer
+        self._op = op
+        self._compression = compression
+        self._pset = process_set
+        self._has_aux = has_aux
+        self._name = name
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2),
+                           static_argnames=("first",))
+        def _apply_grad(reduced, opt_state, params, batch,
+                        first=False):
+            if not first:
+                updates, opt_state = optimizer.update(
+                    reduced, opt_state, params)
+                params = optax.apply_updates(params, updates)
+            out, grads = jax.value_and_grad(
+                loss_fn, has_aux=has_aux)(params, batch)
+            return params, opt_state, out, grads
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def _apply_only(reduced, opt_state, params):
+            updates, opt_state = optimizer.update(
+                reduced, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._apply_grad = _apply_grad
+        self._apply_only = _apply_only
+
+    def init(self, params, opt_state, first_batch):
+        """Run the first grad (no pending apply); returns the carry
+        for the first step() call."""
+        params, opt_state, _, grads = self._apply_grad(
+            None, opt_state, params, first_batch, first=True)
+        return PipelinedState(params, opt_state, grads)
+
+    def _reduce(self, grads):
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        reduced = C.grouped_allreduce(
+            leaves, name=self._name, op=self._op,
+            compression=self._compression, process_set=self._pset)
+        return jax.tree_util.tree_unflatten(treedef, reduced)
+
+    def __call__(self, state: PipelinedState, batch):
+        """One fused program: apply the carried grads, then compute
+        this batch's loss/grads. Returns (state', loss_or_(loss,aux))."""
+        reduced = self._reduce(state.grads)
+        params, opt_state, out, grads = self._apply_grad(
+            reduced, state.opt_state, state.params, batch)
+        return PipelinedState(params, opt_state, grads), out
+
+    def finalize(self, state: PipelinedState):
+        """Reduce+apply the pending grads; returns (params, opt_state)."""
+        reduced = self._reduce(state.grads)
+        return self._apply_only(reduced, state.opt_state, state.params)
+
+
+def make_pipelined_step(loss_fn, optimizer, op=None,
+                        compression=NoneCompressor,
+                        process_set: Optional[ProcessSet] = None,
+                        has_aux: bool = False,
+                        name: str = "PipelinedStep.grouped_allreduce"
+                        ) -> _PipelinedStep:
+    """Build a pipelined eager train step (see module docstring).
+    `op`/`compression`/`process_set` mirror hvd.grouped_allreduce;
+    the collective runs between the fused programs, negotiated and
+    fused by the controller exactly like DistributedOptimizer's
+    grouped path."""
+    return _PipelinedStep(loss_fn, optimizer, op, compression,
+                          process_set, has_aux, name)

@@ -109,6 +109,60 @@ class TestControllerSingleProcess:
                                    rtol=1e-3)
 
 
+class TestWireDtypeFusion:
+    """Fusion keys on the WIRE dtype: raw dtypes that compress to one
+    wire dtype (bf16 weights + f32 norms under fp16 compression)
+    submit as ONE entry and execute as ONE fused batch — a deliberate
+    improvement on the reference's same-raw-dtype FuseResponses rule
+    (the casts fold into the fused XLA kernel for free). Without
+    compression the wires differ and the split is preserved."""
+
+    @pytest.fixture
+    def hvd_native(self):
+        import horovod_tpu as hvd
+        from horovod_tpu.core import native
+        if not native.available():
+            pytest.skip("native core not built")
+        hvd.init(config_overrides={"HOROVOD_CONTROLLER": "native"})
+        yield hvd
+        hvd.shutdown()
+
+    def counts(self, kind="ar"):
+        from horovod_tpu.common.basics import state
+        return list(state().engine.controller.exec_counts.get(
+            kind, [0, 0]))
+
+    def test_mixed_raw_same_wire_is_one_batch(self, hvd_native):
+        import jax.numpy as jnp
+        before = self.counts()
+        outs = hvd_native.grouped_allreduce(
+            [jnp.full((1024,), 2.0, jnp.bfloat16),
+             jnp.full((64,), 3.0, jnp.float32)],
+            op=hvd_native.Sum,
+            compression=hvd_native.Compression.fp16, name="wirefuse")
+        after = self.counts()
+        assert after[0] - before[0] == 1, (before, after)  # 1 batch
+        assert after[1] - before[1] == 1, (before, after)  # 1 entry
+        assert outs[0].dtype == jnp.bfloat16
+        assert outs[1].dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(outs[0], np.float32),
+                                   np.full(1024, 2.0), rtol=1e-2)
+        np.testing.assert_allclose(np.asarray(outs[1]),
+                                   np.full(64, 3.0), rtol=1e-3)
+
+    def test_mixed_wire_still_splits(self, hvd_native):
+        import jax.numpy as jnp
+        before = self.counts()
+        outs = hvd_native.grouped_allreduce(
+            [jnp.full((128,), 2.0, jnp.bfloat16),
+             jnp.full((64,), 3.0, jnp.float32)],
+            op=hvd_native.Sum, name="wiresplit")
+        after = self.counts()
+        assert after[0] - before[0] == 2, (before, after)  # 2 batches
+        assert outs[0].dtype == jnp.bfloat16
+        assert outs[1].dtype == jnp.float32
+
+
 class TestPythonCoreDivergence:
     """The PythonCore's documented divergences from the C++ core
     (PythonCore docstring: no cross-rank mismatch checks, so no error

@@ -523,17 +523,18 @@ class TestHierWide:
 
     def test_wire_dtype_folds(self, eight_device_mesh):
         """fp16-wire compression folds into the composed program: the
-        result equals the cast round-trip of the flat sum."""
+        pack casts to the wire dtype, the kernel reduces on-wire and
+        casts the output segment back to the raw dtype."""
         mesh3 = self.make_mesh()
         n, ndev, k = 4, 2, 2048
         rng = np.random.RandomState(41)
         xs = rng.uniform(-1, 1, size=(n, ndev * k)).astype(np.float32)
         sig = dispatch._sig([jnp.asarray(xs[0])])
         g = jax.device_put(
-            jnp.asarray(xs.reshape(n, ndev, k)),
+            jnp.asarray(xs.reshape(n, ndev, k).astype(np.float16)),
             NamedSharding(mesh3, P(("cross", "local"), "dev")))
         kern = dispatch._allreduce_kernel_hier_wide(
-            mesh3, n, SUM, 1.0, 1.0, sig, "float16")
+            mesh3, n, SUM, 1.0, 1.0, sig, "float16", ("float32",))
         (out,) = kern(g)
         got = np.asarray(out.addressable_shards[0].data[0])
         assert got.dtype == np.float32
