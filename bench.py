@@ -345,10 +345,13 @@ def eager_main(model_name: str = "resnet50"):
     # a bf16 model wire == raw, so the compress roundtrip vanishes and
     # multi-rank wire bytes still halve vs f32), none (isolates the
     # roundtrip's cost).
-    comp = {"none": Compression.none,
-            "bf16": Compression.bf16}.get(
-        os.environ.get("BENCH_EAGER_COMPRESSION", "fp16"),
-        Compression.fp16)
+    comp_name = os.environ.get("BENCH_EAGER_COMPRESSION", "fp16")
+    try:
+        comp = {"none": Compression.none, "bf16": Compression.bf16,
+                "fp16": Compression.fp16}[comp_name]
+    except KeyError:
+        sys.exit(f"bench: BENCH_EAGER_COMPRESSION must be "
+                 f"none/bf16/fp16, got {comp_name!r}")
     log(f"bench[eager]: mode={'hooks' if hooks_mode else 'grouped'}"
         f" op={'Adasum' if adasum else 'Average'}"
         f" compression={comp.__name__}")
@@ -487,6 +490,7 @@ def transformer_main():
         vocab=32768, d_model=1024, n_layers=24, n_heads=16,
         n_kv_heads=16, head_dim=64, d_ff=4096, max_seq=seq,
         moe=False, dtype=jnp.bfloat16, remat=True,
+        remat_mode=os.environ.get("BENCH_REMAT_MODE", "full"),
         tp_axis=None, sp_axis=None, ep_axis=None)
     params = tfm.init_params(cfg, jax.random.PRNGKey(0))
     n_params = sum(int(np.prod(p.shape))

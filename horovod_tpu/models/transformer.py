@@ -60,6 +60,15 @@ class TransformerConfig:
     # ~33% more FLOPs — the standard TPU HBM/FLOPs trade for training
     # large configs on a 16GB chip.
     remat: bool = False
+    # remat_mode="full": the whole layer recomputes in backward.
+    # "mlp_only": only the FFN sub-block remats (its d_ff temporaries
+    # are the memory hog; its recompute is cheap dots) while the
+    # attention sub-block SAVES its residuals — with
+    # HOROVOD_FLASH_ATTENTION this is what keeps the Pallas kernel's
+    # forward from re-running inside backward (the custom VJP's saved
+    # lse/outputs survive), the round-4 flash measured-reject's
+    # diagnosed cause. Costs ~4x B*L*D extra bytes per layer.
+    remat_mode: str = "full"
     # Live mesh axis names (None → that strategy is off). The model is
     # written once; trivial axes cost nothing.
     tp_axis: Optional[str] = TENSOR_AXIS
@@ -263,18 +272,22 @@ def _dense_ffn(cfg: TransformerConfig, p, x):
     return x + out.astype(x.dtype)
 
 
-def _layer(cfg: TransformerConfig, p: Dict[str, jax.Array],
-           x: jax.Array) -> Tuple[jax.Array, jax.Array]:
-    x = _attention_block(cfg, p, x)
+def _ffn_block(cfg: TransformerConfig, p: Dict[str, jax.Array],
+               x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     if cfg.moe:
         # fold gate/up into one in-projection for the shared moe_ffn
         # (SwiGLU needs two; combine by concat on F).
         pm = dict(p)
         pm["w_gate_combined"] = jnp.concatenate(
             [p["w_gate"], p["w_up"]], axis=-1)
-        x2, aux = _moe_swiglu(cfg, pm, x)
-        return x2, aux
+        return _moe_swiglu(cfg, pm, x)
     return _dense_ffn(cfg, p, x), jnp.zeros((), jnp.float32)
+
+
+def _layer(cfg: TransformerConfig, p: Dict[str, jax.Array],
+           x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    x = _attention_block(cfg, p, x)
+    return _ffn_block(cfg, p, x)
 
 
 def _moe_swiglu(cfg: TransformerConfig, p, x):
@@ -383,7 +396,21 @@ def forward(cfg: TransformerConfig, params: Dict[str, Any],
         return _layer(cfg, layer_p, x)
 
     if cfg.remat:
-        one_layer = jax.checkpoint(one_layer)
+        if cfg.remat_mode not in ("full", "mlp_only"):
+            raise ValueError(
+                f"remat_mode must be 'full' or 'mlp_only', got "
+                f"{cfg.remat_mode!r}")
+        if cfg.remat_mode == "mlp_only":
+            # Attention residuals saved (flash's custom-VJP forward
+            # never re-runs); only the FFN recomputes.
+            ffn = jax.checkpoint(
+                lambda layer_p, x: _ffn_block(cfg, layer_p, x))
+
+            def one_layer(layer_p, x):  # noqa: F811
+                x = _attention_block(cfg, layer_p, x)
+                return ffn(layer_p, x)
+        else:
+            one_layer = jax.checkpoint(one_layer)
 
     def body(carry, layer_p):
         x, aux = carry

@@ -91,6 +91,39 @@ class TestShardedLossMatchesOracle:
                                    rtol=2e-3, atol=2e-3)
 
 
+class TestRematModes:
+    """remat_mode='mlp_only' (attention residuals saved, FFN
+    recomputed) must be numerically identical to full remat — only
+    the backward's save/recompute split changes."""
+
+    def test_mlp_only_matches_full(self):
+        import dataclasses
+        from horovod_tpu.models import transformer as tfm
+        base = tfm.TransformerConfig(
+            vocab=128, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+            head_dim=8, d_ff=64, max_seq=16, moe=False,
+            dtype=jnp.float32, remat=True,
+            tp_axis=None, sp_axis=None, ep_axis=None)
+        params = tfm.init_params(base, jax.random.PRNGKey(0))
+        tok = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                 base.vocab, jnp.int32)
+        batch = {"tokens": tok, "targets": jnp.roll(tok, -1, axis=1)}
+
+        def lg(cfg):
+            return jax.value_and_grad(
+                lambda p: tfm.loss_fn(cfg, p, batch))(params)
+
+        l_full, g_full = lg(base)
+        l_mlp, g_mlp = lg(dataclasses.replace(base,
+                                              remat_mode="mlp_only"))
+        np.testing.assert_allclose(float(l_full), float(l_mlp),
+                                   rtol=1e-6)
+        for a, b in zip(jax.tree_util.tree_leaves(g_full),
+                        jax.tree_util.tree_leaves(g_mlp)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+
 class TestFSDP:
     """ZeRO-3 on TPU (parallel/fsdp.py + make_flagship_fsdp):
     parameters AND optimizer state sharded over the fsdp mesh axis;
