@@ -918,6 +918,45 @@ def _reducescatter_group_wide(xs, pset: ProcessSet, mesh, op: int,
 
 
 @functools.lru_cache(maxsize=None)
+def _allgather_group_kernel_hier_wide(mesh, n: int, ndev: int,
+                                      rows_per_tensor: Tuple[
+                                          Tuple[int, ...], ...],
+                                      sig: Tuple):
+    """Hierarchical AND device-spanning fused allgather over a
+    ('cross','local','dev') mesh: each chip gathers its 1/ndev bucket
+    slice within the slice over ICI first ('local'), exchanges slice
+    blocks over DCN ('cross'), then the intra-host 'dev' gather
+    reassembles — the staging of _allgather_group_kernel_hier with
+    every local chip carrying 1/ndev of the bytes (the allgather
+    counterpart of _allreduce_kernel_hier_wide)."""
+    shapes = [s for s, _ in sig]
+    flat_sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+
+    def body(block):                      # (1, 1, k)
+        x = block.reshape(-1)
+        g_local = lax.all_gather(x, "local")            # (L, k)
+        g = lax.all_gather(g_local, "cross")            # (n/L, L, k)
+        g = g.reshape(n, -1)
+        full = lax.all_gather(g, "dev", axis=1, tiled=True)  # (n, B)
+        outs = []
+        off = 0
+        for shape, fsz, rows in zip(shapes, flat_sizes,
+                                    rows_per_tensor):
+            blk = full[:, off:off + fsz].reshape((n,) + shape)
+            pieces = [blk[i, : rows[i]] for i in range(n)]
+            outs.append(jnp.concatenate(pieces, axis=0)[None])
+            off += fsz
+        return tuple(outs)
+
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=P(("cross", "local"), "dev"),
+                       out_specs=tuple(P(("cross", "local"))
+                                       for _ in sig),
+                       check_vma=False)
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
 def _alltoall_kernel(mesh, n: int, maxsplit: int, sig: Tuple):
     """All-to-all of padded per-destination chunks. Input block is
     (1, n, maxsplit, *rest); output block is (1, n, maxsplit, *rest)
@@ -972,6 +1011,31 @@ def _alltoall_kernel_wide(mesh, n: int, ndev: int, ms2: int,
         out = lax.all_to_all(x, "proc", split_axis=0, concat_axis=0)
         full = lax.all_gather(out, "dev", axis=1, tiled=True)
         return full[None]                 # (1, n, ms2, *rest)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc", "dev"),
+                       out_specs=P("proc"), check_vma=False)
+    return jax.jit(fn)
+
+
+@functools.lru_cache(maxsize=None)
+def _ppermute_shift_kernel_wide(mesh, n: int, ndev: int, shift: int,
+                                rows2: int, rest: Tuple[int, ...],
+                                dtype: str):
+    """Device-spanning ragged-alltoall round: each chip ppermutes its
+    1/ndev row slab of this round's (bucket-padded) chunk over 'proc'
+    in parallel, then the intra-host 'dev' all_gather (row axis)
+    reassembles the received chunk on every chip — the wide analog of
+    _ppermute_shift_kernel (reference: NCCLAlltoall device-resident;
+    the ragged schedule's rounds deserve the same chip spanning as
+    the padded one)."""
+    pairs = tuple((i, (i + shift) % n) for i in range(n))
+    rpd = rows2 // ndev
+
+    def body(block):                      # (1, 1, rpd*prod(rest))
+        x = block.reshape((rpd,) + rest)
+        got = lax.ppermute(x, "proc", perm=pairs)
+        full = lax.all_gather(got, "dev", axis=0, tiled=True)
+        return full[None]                 # (1, rows2, *rest)
 
     fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc", "dev"),
                        out_specs=P("proc"), check_vma=False)
@@ -1115,9 +1179,11 @@ def _alltoall_ragged(x: jax.Array, splits: Sequence[int],
     n = pset.size
     me = pset.rank()
     rest = x.shape[1:]
+    rest_elems = int(np.prod(rest)) if rest else 1
     offs = np.concatenate([[0], np.cumsum(splits)]).astype(int)
     out_chunks: List[Any] = [None] * n
     out_chunks[me] = x[offs[me]:offs[me] + splits[me]]
+    wide_rounds = 0
     for r in range(1, n):
         dst = (me + r) % n
         src = (me - r) % n
@@ -1127,12 +1193,38 @@ def _alltoall_ragged(x: jax.Array, splits: Sequence[int],
             out_chunks[src] = jnp.zeros((0,) + rest, x.dtype)
             continue
         c = x[offs[dst]:offs[dst] + splits[dst]]
+        wmesh = _wide_mesh(pset, bucket * rest_elems)
+        if wmesh is not None:
+            # Device-spanning round: the chunk's row slabs split
+            # across local chips (pad the bucket to a multiple of
+            # ndev; the bucketing already pads to a power of two, so
+            # for ndev a power of two this adds nothing).
+            ndev = wmesh.shape["dev"]
+            b2 = bucket + ((-bucket) % ndev)
+            if c.shape[0] < b2:
+                pad = [(0, b2 - c.shape[0])] + \
+                    [(0, 0)] * (x.ndim - 1)
+                c = jnp.pad(c, pad)
+            # row-major: chip j's slab (rows [j*b2/ndev, ...)) is
+            # contiguous, so a plain reshape scatters correctly.
+            packed = c.reshape(ndev, -1)
+            g = _scatter_rows(packed, pset, wmesh)
+            kern = _ppermute_shift_kernel_wide(
+                wmesh, n, ndev, r, b2, rest, str(x.dtype))
+            got = local_shard(kern(g))
+            out_chunks[src] = got[:rows_from_src]
+            wide_rounds += 1
+            continue
         if c.shape[0] < bucket:
             pad = [(0, bucket - c.shape[0])] + [(0, 0)] * (x.ndim - 1)
             c = jnp.pad(c, pad)
         kern = _ppermute_shift_kernel(pset.mesh, n, r, _sig([c]))
         got = local_shard(kern(to_global(c, pset)))
         out_chunks[src] = got[:rows_from_src]
+    # Introspection: how many rounds took the device-spanning kernel
+    # (tests assert this — a silent fallback to flat rounds would
+    # produce identical outputs).
+    _last_alltoall_stats["wide_rounds"] = wide_rounds
     return (jnp.concatenate(out_chunks, axis=0) if n
             else jnp.zeros((0,) + rest, x.dtype))
 
@@ -1347,11 +1439,14 @@ def allgather(tensor: jax.Array, pset: ProcessSet,
         return tensor
     maxr = max(all_rows)
     rest = int(np.prod(x.shape[1:])) if x.ndim > 1 else 1
-    if (_hier_mesh(pset) is None
-            and _wide_mesh(pset, maxr * rest) is not None):
+    spanable = (_wide_mesh(pset, maxr * rest) is not None
+                if _hier_mesh(pset) is None
+                else _hier_mesh_wide(pset) is not None)
+    if spanable:
         # Single tensor = group of one through the device-spanning
-        # kernel, exactly like broadcast() does (routing decided
-        # BEFORE padding — the group path pads itself).
+        # (possibly hierarchical) kernel, exactly like broadcast()
+        # does (routing decided BEFORE padding — the group path pads
+        # itself and re-checks the size gates).
         return allgather_group([tensor], pset, [all_rows])[0]
     was_bool = _is_bool(x)
     if was_bool:
@@ -1402,7 +1497,23 @@ def allgather_group(tensors: List[jax.Array], pset: ProcessSet,
     mesh2 = _hier_mesh(pset)
     if mesh2 is not None:
         # Keep the ICI-then-DCN staging under HOROVOD_HIERARCHICAL_*
-        # for fused gathers too.
+        # for fused gathers too — composed with device spanning when
+        # the processes own several chips (same rules as allreduce:
+        # single dtype guaranteed by the ag fuse key).
+        total = sum(int(np.prod(x.shape)) for x in padded)
+        hw = _hier_mesh_wide(pset)
+        if (hw is not None
+                and len({str(x.dtype) for x in padded}) == 1
+                and (_span_devices != "auto" or total >=
+                     hw.shape["dev"] * _WIDE_MIN_ELEMS_PER_DEV)):
+            g, psig = _scatter_packed(
+                padded, pset, hw, spec=P(("cross", "local"), "dev"))
+            kern = _allgather_group_kernel_hier_wide(
+                hw, n, hw.shape["dev"], tuple(rows), psig)
+            outs = [local_shard(o) for o in kern(g)]
+            _note_op("allgather", "hier_wide", hw)
+            return [o.astype(jnp.bool_) if b else o
+                    for o, b in zip(outs, bools)]
         kern = _allgather_group_kernel_hier(mesh2, n, tuple(rows),
                                             _sig(padded))
         spec = P(("cross", "local"))

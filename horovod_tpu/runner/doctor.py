@@ -41,11 +41,17 @@ def check_build(verbose: bool = False) -> str:
         devs = jax.devices()
         plat = devs[0].platform
         kinds = sorted({d.device_kind for d in devs})
+        nlocal = len(jax.local_devices())
         lines += [
             "",
             "Devices:",
             f"    platform={plat} count={len(devs)} kinds={kinds}",
             f"    processes={jax.process_count()}",
+            f"    {_mark(nlocal > 1)} device-spanning eager plane "
+            f"({nlocal} local chip{'s' if nlocal != 1 else ''}"
+            + (": every eager op kind shards its bucket across them"
+               if nlocal > 1 else
+               ": single chip per process, flat mesh") + ")",
         ]
     except Exception as e:  # pragma: no cover - device-env dependent
         lines += ["", f"Devices: unavailable ({e})"]

@@ -39,6 +39,13 @@ def main():
         params={"w": jnp.zeros((2,))}, step=0,
         snapshot_path=f"{LOG}_snapshot.bin")
 
+    # ELASTIC_TEST_WIDE=1: every step ALSO runs a bucket big enough
+    # for the device-spanning ('proc','dev') path and asserts it
+    # engaged with the CURRENT world size — resizes must rebuild the
+    # wide mesh, not reuse a stale pre-resize one (the caches live on
+    # ProcessSet instances, which re-init replaces).
+    wide = os.environ.get("ELASTIC_TEST_WIDE") == "1"
+
     @hvd.elastic.run
     def train(state):
         while state.step < TOTAL_STEPS:
@@ -46,6 +53,22 @@ def main():
             # surface as collective errors
             g = hvd.allreduce(jnp.ones((2,)) * (state.step + 1),
                               name="grad")
+            if wide:
+                import jax
+                from horovod_tpu.ops import dispatch
+                big = hvd.allreduce(jnp.full((4096,), 1.0), name="big",
+                                    op=hvd.Sum)
+                np.testing.assert_allclose(
+                    np.asarray(big), np.full(4096, float(hvd.size())))
+                info = dispatch.last_allreduce_info()
+                ndev = len(jax.local_devices())
+                if hvd.size() > 1 and ndev > 1:
+                    assert info.get("path") == "wide", info
+                    assert info.get("mesh_shape") == {
+                        "proc": hvd.size(), "dev": ndev}, (
+                        info, hvd.size())
+                    log_line(f"wide ok world {hvd.size()} "
+                             f"devs {info['devices']}")
             state.params["w"] = state.params["w"] + np.asarray(g)
             state.step += 1
             log_line(f"step {state.step} world {hvd.size()} "

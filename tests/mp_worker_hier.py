@@ -66,6 +66,22 @@ def main():
                                    np.full(2048, want), rtol=1e-2)
     print(f"rank {r}: hier_wide grouped+fp16 OK")
 
+    # 2b) allgather composes too: ragged rows through the
+    # ('cross','local','dev') staged gather.
+    rows_mine = 512 + 16 * r
+    out = hvd.allgather(jnp.full((rows_mine, 4), float(r), jnp.float32),
+                        name="hier_ag")
+    info = dispatch.last_op_info("allgather")
+    assert info.get("path") == "hier_wide", info
+    assert info.get("mesh_shape") == {"cross": 2, "local": 2,
+                                      "dev": 2}, info
+    off = 0
+    for rr in range(n):
+        seg = np.asarray(out[off:off + 512 + 16 * rr])
+        np.testing.assert_allclose(seg, np.full(seg.shape, float(rr)))
+        off += 512 + 16 * rr
+    print(f"rank {r}: hier_wide allgather OK ({info})")
+
     # 3) span knob off -> the 2-axis hier path (representative chips).
     dispatch.set_span_devices("0")
     out = hvd.allreduce(jnp.full((8192,), 1.0, jnp.float32),

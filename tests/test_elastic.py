@@ -129,6 +129,44 @@ class TestElastic:
               if ln.startswith("step") and "world 3" in ln]
         assert w2 and w3 and min(w3) >= max(w2) - 1, (max(w2), min(w3))
 
+    def test_resize_rebuilds_wide_mesh(self, tmp_path):
+        """Elastic resize x multi-chip processes: after a scale-down,
+        the device-spanning ('proc','dev') eager path must rebuild
+        for the NEW world size (the wide-mesh caches live on
+        ProcessSet instances that re-init replaces) — every step
+        asserts path == wide with the current world in the mesh."""
+        hosts_file = tmp_path / "hosts.txt"
+        hosts_file.write_text("localhost:3\n")
+        script = write_discovery(tmp_path, f"cat {hosts_file}")
+        env = make_env(tmp_path, steps=30, sleep=0.25)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        env["ELASTIC_TEST_WIDE"] = "1"
+        p = launch(script, env)
+        try:
+            deadline = time.time() + 240
+            while time.time() < deadline:
+                if any("wide ok world 3" in ln
+                       for ln in read_logs(tmp_path)):
+                    break
+                if p.poll() is not None:
+                    break
+                time.sleep(0.5)
+            hosts_file.write_text("localhost:2\n")
+            out, _ = p.communicate(timeout=300)
+        finally:
+            if p.poll() is None:
+                p.kill()
+                out = p.communicate()[0]  # reap + keep the output
+        assert p.returncode == 0, out
+        lines = read_logs(tmp_path)
+        # wide engaged at BOTH world sizes, 2 devices per process
+        # (the worker asserts mesh_shape == {proc: size, dev: 2} on
+        # every step, so one line per size proves the rebuild).
+        assert any("wide ok world 3 devs 6" in ln for ln in lines), \
+            lines[-10:]
+        assert any("wide ok world 2 devs 4" in ln for ln in lines), \
+            lines[-10:]
+
     def test_graceful_scale_down(self, tmp_path):
         """Start at 3 procs; mid-run the discovery file shrinks to 2.
         The removed rank drains voluntarily (clean exit at its commit
