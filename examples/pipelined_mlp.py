@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Pipelined eager training: the TPU-native max-throughput recipe.
+
+Same 5-line shape as mnist_mlp.py, but the optimizer apply is FUSED
+into the next step's grad program via `hvd.make_pipelined_step` —
+on TPU, XLA programs execute serially, so a stand-alone apply program
+cannot overlap its HBM traffic with compute; pipelined, it can. The
+grouped allreduce still runs eagerly between the programs through the
+negotiated controller (fusion, response cache, compression). This
+pattern benches the 436M-param flagship transformer at 1.00x the jit
+train step on a v5e chip (docs/benchmarks.md).
+
+Run:  python -m horovod_tpu.runner -np 2 python examples/pipelined_mlp.py
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import init_mlp, mlp_forward, mlp_loss_fn
+
+
+def load_data(n=4096):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, 784), dtype=np.float32)
+    w = rng.standard_normal((784, 10)).astype(np.float32)
+    return x, np.argmax(x @ w, axis=1)  # learnable synthetic labels
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    args = ap.parse_args()
+
+    hvd.init()
+    x, y = load_data()
+    n_local = len(x) // hvd.size()
+    lo = hvd.rank() * n_local
+    x, y = x[lo:lo + n_local], y[lo:lo + n_local]
+
+    params = init_mlp(jax.random.PRNGKey(0))
+    params = hvd.broadcast_parameters(params, root_rank=0)
+    opt = optax.adam(args.lr * hvd.size())
+
+    def loss_fn(p, batch):
+        return mlp_loss_fn(p, batch)
+
+    # bf16 wire: the TPU-native compression (free cast for bf16
+    # models; halves multi-rank wire bytes for this f32 one).
+    step = hvd.make_pipelined_step(loss_fn, opt, op=hvd.Average,
+                                   compression=hvd.Compression.bf16)
+
+    steps = n_local // args.batch_size
+    if steps < 2:
+        sys.exit(f"pipelined_mlp: need >= 2 batches per epoch to "
+                 f"pipeline (got {steps} at batch size "
+                 f"{args.batch_size} with {n_local} local rows); "
+                 "lower --batch-size")
+    batches = [{"images": jnp.asarray(x[i * args.batch_size:
+                                        (i + 1) * args.batch_size]),
+                "labels": jnp.asarray(y[i * args.batch_size:
+                                        (i + 1) * args.batch_size])}
+               for i in range(steps)]
+
+    # init() consumes the first batch; loop from the second.
+    state = step.init(params, opt.init(params), batches[0])
+    for epoch in range(args.epochs):
+        start = 1 if epoch == 0 else 0
+        for b in batches[start:]:
+            state, loss = step(state, b)
+        avg = hvd.allreduce(jnp.asarray([float(loss)]),
+                            name="epoch_loss")
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: loss {float(avg[0]):.4f}")
+    params, _ = step.finalize(state)
+
+    logits = mlp_forward(params, jnp.asarray(x[:512]))
+    acc = float(jnp.mean(jnp.argmax(logits, -1) == jnp.asarray(y[:512])))
+    acc = float(hvd.allreduce(jnp.asarray([acc]), name="acc")[0])
+    if hvd.rank() == 0:
+        print(f"final train accuracy: {acc:.3f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1594,6 +1594,9 @@ def alltoall(tensor: jax.Array, splits: Sequence[int],
         use_ragged = (_alltoall_mode == "ragged"
                       or _choose_alltoall_path(n, buckets, padded_rows,
                                                row_bytes))
+        # wide_rounds is ragged-path-only; drop any stale value so a
+        # padded call never reports a prior call's spanning rounds.
+        _last_alltoall_stats.pop("wide_rounds", None)
         _last_alltoall_stats.update(
             path="ragged" if use_ragged else "padded",
             wire_rows=ragged_rows if use_ragged else padded_rows,
@@ -1604,6 +1607,7 @@ def alltoall(tensor: jax.Array, splits: Sequence[int],
             _note_op("alltoall", "ragged", pset.mesh)
             return out.astype(jnp.bool_) if was_bool else out
     else:
+        _last_alltoall_stats.pop("wide_rounds", None)
         _last_alltoall_stats.update(
             path="padded", wire_rows=n * int(maxsplit),
             ragged_rows=None, padded_rows=n * int(maxsplit))

@@ -40,6 +40,16 @@ class TestExamples:
         assert r.returncode == 0, r.stdout + r.stderr
         assert "epoch 0" in r.stdout
 
+    def test_pipelined_two_proc(self):
+        """The pipelined apply-then-grad recipe trains to accuracy
+        through the negotiated grouped allreduce at 2 ranks."""
+        r = run_example("pipelined_mlp.py", ["--epochs", "3"], np_=2)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "final train accuracy" in r.stdout
+        acc = float(r.stdout.split("final train accuracy:")[1]
+                    .strip().split()[0])
+        assert acc > 0.9, r.stdout
+
     def test_resnet_synthetic(self):
         r = run_example("resnet50_synthetic.py",
                         ["--batch-size", "2", "--num-iters", "2",
