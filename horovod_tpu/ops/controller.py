@@ -774,17 +774,11 @@ class NegotiatedController:
     def _execute_allreduce_batch(self, entries):
         """One fused launch for the whole agreed batch (the fusion
         buffer analog: same fuse key == same dtype/op/pset/scales)."""
-        wire_dt, rop, pset_id, pre, post, _ = \
-            parse_allreduce_sig(entries[0].sig)
-        pset = self.engine.pset_table.get(pset_id)
-        active = entries[0].active_ranks
-
-        from .compression import compressor_for
-
-        def fail_batch(err, slots):
+        def fail_batch(err, slots=()):
             # Error every handle in the batch cleanly — raising
             # mid-loop would strand already-popped handles in
-            # synchronize() forever.
+            # synchronize() forever (and an escaped exception would
+            # kill the dispatch worker).
             for _, pp, _ in slots:
                 if pp is not None:
                     pp.handle.set_error(err)
@@ -793,6 +787,21 @@ class NegotiatedController:
                     p2 = self._pending.pop(e2.name, None)
                 if p2 is not None:
                     p2.handle.set_error(err)
+
+        try:
+            wire_dt, rop, pset_id, pre, post, _ = \
+                parse_allreduce_sig(entries[0].sig)
+            pset = self.engine.pset_table.get(pset_id)
+        except Exception as ex:
+            # A malformed agreed sig (mixed-version peer) must error
+            # THIS batch's handles, not kill the dispatch worker.
+            fail_batch(RuntimeError(
+                f"malformed negotiated allreduce signature "
+                f"{entries[0].sig!r}: {ex}"))
+            return
+        active = entries[0].active_ranks
+
+        from .compression import compressor_for
 
         tensors = []
         compressors = []
@@ -807,16 +816,18 @@ class NegotiatedController:
                 # rank lowers the identical fused kernel (reference:
                 # JoinOp zero contribution; multi-controller JAX
                 # requires the same program on every rank).
-                metas = parse_allreduce_sig(e.sig)[5]
                 try:
+                    metas = parse_allreduce_sig(e.sig)[5]
                     zcomps = [compressor_for(raw, wire_dt)
                               for raw, _ in metas]
-                except ValueError as ex:
-                    # a custom compressor's wire dtype no built-in
-                    # maps to: fail the whole batch cleanly.
+                    zeros = [jnp.zeros(s, raw) for raw, s in metas]
+                except Exception as ex:
+                    # unreconstructable zero-fill (a custom
+                    # compressor's wire dtype no built-in maps to, or
+                    # a malformed peer sig): fail the whole batch
+                    # cleanly, never the dispatch worker.
                     fail_batch(ex, slots)
                     return
-                zeros = [jnp.zeros(s, raw) for raw, s in metas]
                 tensors.extend(zeros)
                 compressors.extend(zcomps)
                 slots.append((e, None, len(zeros)))

@@ -1579,6 +1579,10 @@ def alltoall(tensor: jax.Array, splits: Sequence[int],
         maxsplit = max(max(splits), max(recv_splits), 1)
     rest = x.shape[1:]
 
+    # wide_rounds is ragged-path-only; drop any stale value so a
+    # padded call never reports a prior call's spanning rounds (the
+    # ragged path re-sets it unconditionally).
+    _last_alltoall_stats.pop("wide_rounds", None)
     if split_matrix is not None and _alltoall_mode != "padded" and n > 1:
         matrix = np.asarray(split_matrix, dtype=np.int64)
         buckets = _ragged_round_buckets(matrix)
@@ -1594,9 +1598,6 @@ def alltoall(tensor: jax.Array, splits: Sequence[int],
         use_ragged = (_alltoall_mode == "ragged"
                       or _choose_alltoall_path(n, buckets, padded_rows,
                                                row_bytes))
-        # wide_rounds is ragged-path-only; drop any stale value so a
-        # padded call never reports a prior call's spanning rounds.
-        _last_alltoall_stats.pop("wide_rounds", None)
         _last_alltoall_stats.update(
             path="ragged" if use_ragged else "padded",
             wire_rows=ragged_rows if use_ragged else padded_rows,
@@ -1607,7 +1608,6 @@ def alltoall(tensor: jax.Array, splits: Sequence[int],
             _note_op("alltoall", "ragged", pset.mesh)
             return out.astype(jnp.bool_) if was_bool else out
     else:
-        _last_alltoall_stats.pop("wide_rounds", None)
         _last_alltoall_stats.update(
             path="padded", wire_rows=n * int(maxsplit),
             ragged_rows=None, padded_rows=n * int(maxsplit))

@@ -162,6 +162,19 @@ class TestWireDtypeFusion:
         assert outs[0].dtype == jnp.bfloat16
         assert outs[1].dtype == jnp.float32
 
+    def test_malformed_sig_errors_batch_not_worker(self, hvd_native):
+        """A malformed agreed signature (mixed-version peer) must
+        degrade to per-batch errors — the dispatch worker survives
+        and subsequent collectives still complete."""
+        import jax.numpy as jnp
+        from horovod_tpu.common.basics import state
+        from horovod_tpu.core import native
+        ctl = state().engine.controller
+        bad = native.BatchEntry("ghost", "ar|not|a|sig", 1, "", 0, "")
+        ctl._execute_allreduce_batch([bad])   # must not raise
+        out = hvd_native.allreduce(jnp.ones(4), name="after_bad")
+        np.testing.assert_allclose(np.asarray(out), np.ones(4))
+
 
 class TestPythonCoreDivergence:
     """The PythonCore's documented divergences from the C++ core
