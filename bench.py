@@ -181,6 +181,17 @@ def eager_main(model_name: str = "resnet50"):
         new batch composition = a new compiled program. The recorded
         gap vs grouped is the measured argument for why the TPU eager
         API defaults to grouped submission (docs/benchmarks.md).
+
+    Round-5 knobs (BENCH_transformer_eager_r05.json):
+      BENCH_EAGER_COMPRESSION=fp16|bf16|none — wire dtype (bf16 is
+        the TPU-native choice: free cast for bf16 models).
+      BENCH_EAGER_PIPELINED=1 — the hvd.make_pipelined_step pattern
+        (optimizer apply fused into the next step's grad program);
+        with bf16 wire this benches the flagship transformer at
+        1.00x the jit step.
+      BENCH_REMAT_MODE=full|mlp_only — transformer remat policy
+        (mlp_only saves attention residuals; see
+        BENCH_flash_remat_r05.json).
     """
     transformer = model_name == "transformer"
     batch_per_chip = int(os.environ.get(
@@ -238,6 +249,7 @@ def eager_main(model_name: str = "resnet50"):
             vocab=32768, d_model=1024, n_layers=24, n_heads=16,
             n_kv_heads=16, head_dim=64, d_ff=4096, max_seq=seq,
             moe=False, dtype=jnp.bfloat16, remat=True,
+            remat_mode=os.environ.get("BENCH_REMAT_MODE", "full"),
             tp_axis=None, sp_axis=None, ep_axis=None)
         params = tfm.init_params(tfm_cfg, jax.random.PRNGKey(0))
         batch_stats = {}

@@ -778,15 +778,21 @@ class NegotiatedController:
             # Error every handle in the batch cleanly — raising
             # mid-loop would strand already-popped handles in
             # synchronize() forever (and an escaped exception would
-            # kill the dispatch worker).
-            for _, pp, _ in slots:
+            # kill the dispatch worker). Close timeline spans like
+            # every other error path does.
+            tl = self.engine.timeline
+            for e2, pp, _ in slots:
                 if pp is not None:
                     pp.handle.set_error(err)
+                    if tl is not None:
+                        tl.done(e2.name, error=True)
             for e2 in entries:
                 with self._mu:
                     p2 = self._pending.pop(e2.name, None)
                 if p2 is not None:
                     p2.handle.set_error(err)
+                    if tl is not None:
+                        tl.done(e2.name, error=True)
 
         try:
             wire_dt, rop, pset_id, pre, post, _ = \
