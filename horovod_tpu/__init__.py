@@ -28,6 +28,9 @@ from .ops.collective_ops import (  # noqa: F401
     barrier, join, synchronize, poll, check_execution_order,
     Average, Sum, Adasum, Min, Max, Product,
 )
+from .ops.sparse import (  # noqa: F401
+    sparse_allreduce, sparse_allreduce_async, SparseAllreduceHandle,
+)
 from .ops.compression import Compression  # noqa: F401
 from .ops.process_set import ProcessSet  # noqa: F401
 from .metadata import (  # noqa: F401

@@ -455,12 +455,19 @@ def join(device: int = -1) -> int:
     return st.engine.controller.join()
 
 
-def synchronize(handle: int):
+def synchronize(handle):
+    # Composite handles (sparse allreduce) synchronize themselves
+    # (reference: mpi_ops.synchronize resolves sparse handles
+    # transparently).
+    if hasattr(handle, "synchronize"):
+        return handle.synchronize()
     st = _require_init()
     return st.engine.synchronize(st.engine.get_handle(handle))
 
 
-def poll(handle: int) -> bool:
+def poll(handle) -> bool:
+    if hasattr(handle, "poll"):
+        return handle.poll()
     st = _require_init()
     return st.engine.get_handle(handle).done()
 

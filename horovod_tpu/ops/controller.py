@@ -792,7 +792,9 @@ class NegotiatedController:
                 if p2 is not None:
                     p2.handle.set_error(err)
                     if tl is not None:
-                        tl.done(e2.name, error=True)
+                        # still in _pending => dispatched() never ran:
+                        # close the open QUEUE span, not DISPATCH.
+                        tl.error(e2.name)
 
         try:
             wire_dt, rop, pset_id, pre, post, _ = \

@@ -35,6 +35,7 @@ from jax import lax
 import optax
 
 from ..ops import collective_ops as C
+from ..ops import sparse as S
 from ..ops.compression import Compression, NoneCompressor
 from ..ops.dispatch import AVERAGE, SUM, ADASUM
 from ..ops.process_set import ProcessSet
@@ -116,6 +117,78 @@ def _eager_reduce(grads, op: int, compression,
     return jax.tree_util.tree_unflatten(treedef, out)
 
 
+def _scale_bcoo(x, factor: float):
+    from jax.experimental import sparse as jsparse
+    if factor == 1.0:
+        return x
+    return jsparse.BCOO(
+        (x.data * jnp.asarray(factor, x.data.dtype), x.indices),
+        shape=x.shape, indices_sorted=x.indices_sorted,
+        unique_indices=x.unique_indices)
+
+
+def _eager_reduce_mixed(leaves, treedef, sp_idx, eff_op, compression,
+                        process_set, num_groups, groups,
+                        prescale: float, postscale: float):
+    """Eager reduction of a gradient tree containing BCOO leaves:
+    sparse leaves ride hvd.sparse_allreduce (allgather-based,
+    reference: torch/optimizer.py routing sparse grads to
+    sparse_allreduce_async_), dense leaves the grouped allreduce.
+    Sparse submissions go first so their negotiation overlaps the
+    dense grouped reduction; pre/postscale fold into the values
+    (linear, so semantics match the dense path exactly).
+
+    The reduced sparse leaves densify on return: the WIRE stays sparse
+    (nnz rows instead of the full embedding table — the distributed
+    cost the reference's sparse path exists to cut), but optax inner
+    transformations are dense-only (torch's SGD applies sparse grads
+    via index_add; optax tree_maps would corrupt BCOO indices), so the
+    local update consumes the dense form. Divergence documented in
+    docs/migrating_from_horovod.md."""
+    if eff_op not in (AVERAGE, SUM):
+        raise NotImplementedError(
+            "sparse gradients support op=Average/Sum; pass "
+            "sparse_as_dense=True to route them through the dense "
+            f"path for op={eff_op}")
+    handles = {}
+    for i in sp_idx:
+        handles[i] = S.sparse_allreduce_async(
+            _scale_bcoo(leaves[i], prescale), op=eff_op,
+            process_set=process_set)
+    dense_idx = [i for i in range(len(leaves)) if i not in handles]
+    if groups is not None:
+        # `groups` holds leaf indices of the FULL gradient tree; the
+        # dense reduction below sees a compacted list, so remap — and
+        # reject sparse members (they ride sparse_allreduce, outside
+        # any fusion group).
+        dense_pos = {leaf: pos for pos, leaf in enumerate(dense_idx)}
+        remapped = []
+        for g in groups:
+            idxs = [int(i) for i in g]
+            bad = [i for i in idxs if i < 0 or i >= len(leaves)]
+            if bad:
+                raise ValueError(f"groups contains leaf indices {bad} "
+                                 f"out of range for {len(leaves)} "
+                                 "gradient leaves")
+            sp_members = [i for i in idxs if i in handles]
+            if sp_members:
+                raise ValueError(
+                    f"groups contains BCOO gradient leaves {sp_members}"
+                    "; sparse leaves reduce via sparse_allreduce and "
+                    "cannot join a dense fusion group")
+            remapped.append([dense_pos[i] for i in idxs])
+        groups = remapped
+    if dense_idx:
+        reduced = _eager_reduce([leaves[i] for i in dense_idx], eff_op,
+                                compression, process_set, num_groups,
+                                groups, prescale, postscale)
+        for i, r in zip(dense_idx, reduced):
+            leaves[i] = r
+    for i, h in handles.items():
+        leaves[i] = _scale_bcoo(h.synchronize(), postscale).todense()
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def _split_round_robin(items, n):
     buckets = [[] for _ in range(min(n, len(items)))]
     for i, it in enumerate(items):
@@ -134,6 +207,7 @@ def DistributedGradientTransformation(
         groups: Optional[Sequence] = None,
         process_set: Optional[ProcessSet] = None,
         gradient_predivide_factor: float = 1.0,
+        sparse_as_dense: bool = False,
         size_hint: Optional[int] = None,
 ) -> optax.GradientTransformation:
     """Wrap an optax transformation with cross-worker gradient reduction."""
@@ -147,7 +221,22 @@ def DistributedGradientTransformation(
         raise ValueError("backward_passes_per_step must be >= 1")
 
     def reduce_grads(grads):
+        leaves, treedef = jax.tree_util.tree_flatten(
+            grads, is_leaf=S.is_sparse)
+        sp_idx = [i for i, l in enumerate(leaves) if S.is_sparse(l)]
+        if sp_idx and sparse_as_dense:
+            # reference: optimizer.py sparse_as_dense — densify before
+            # the ordinary dense reduction.
+            for i in sp_idx:
+                leaves[i] = leaves[i].todense()
+            sp_idx = []
+            grads = jax.tree_util.tree_unflatten(treedef, leaves)
         if axis_name is not None:
+            if sp_idx:
+                raise ValueError(
+                    "BCOO gradients inside an axis_name (in-jit) "
+                    "reduction require sparse_as_dense=True; the "
+                    "allgather-based sparse path is eager-only")
             n = size_hint
             if op == ADASUM and n is None:
                 raise ValueError("op=Adasum with axis_name requires "
@@ -165,6 +254,11 @@ def DistributedGradientTransformation(
             prescale = 1.0 / gradient_predivide_factor
             postscale = gradient_predivide_factor / n
             eff_op = SUM
+        if sp_idx:
+            return _eager_reduce_mixed(leaves, treedef, sp_idx, eff_op,
+                                       compression, process_set,
+                                       num_groups, groups, prescale,
+                                       postscale)
         return _eager_reduce(grads, eff_op, compression, process_set,
                              num_groups, groups, prescale, postscale)
 
@@ -180,6 +274,18 @@ def DistributedGradientTransformation(
             reduced = reduce_grads(grads)
             return inner.update(reduced, state, params, **extra)
         # Local aggregation path (LocalGradientAggregationHelper analog).
+        # The accumulator is dense (zeros_like(params)), so sparse
+        # gradient leaves must densify before accumulating.
+        if any(S.is_sparse(l) for l in jax.tree_util.tree_leaves(
+                grads, is_leaf=S.is_sparse)):
+            if not sparse_as_dense:
+                raise ValueError(
+                    "backward_passes_per_step > 1 with BCOO gradients "
+                    "requires sparse_as_dense=True (the local "
+                    "accumulator is dense)")
+            grads = jax.tree_util.tree_map(
+                lambda l: l.todense() if S.is_sparse(l) else l, grads,
+                is_leaf=S.is_sparse)
         acc = jax.tree_util.tree_map(jnp.add, state.acc, grads)
         counter = state.counter + 1
         if axis_name is not None:

@@ -120,6 +120,8 @@ _SMOKE = {
     # models
     "tests/test_vgg.py::test_vgg16_param_count_and_forward",
     "tests/test_inception.py::test_inception_v3_param_count_and_forward",
+    # sparse allreduce (BCOO)
+    "tests/test_sparse.py::test_sparse_allreduce_coalesces_duplicates",
     # sync batch norm
     "tests/test_sync_batch_norm.py::test_sync_bn_matches_global_batch",
     # timeline + autotune

@@ -113,6 +113,27 @@ def main():
     np.testing.assert_allclose(
         np.asarray(out), np.full((2, 3), sum(range(1, n + 1))))
 
+    # sparse allreduce (BCOO): rank-dependent nnz, rank 0 contributes
+    # ZERO rows (the empty-contribution edge of the uneven allgather),
+    # every other rank touches row 1 (cross-rank duplicate coalescing)
+    # (reference: torch mpi_ops sparse allreduce via allgather).
+    from jax.experimental import sparse as jsparse
+    if r == 0:
+        sp = jsparse.BCOO(
+            (jnp.zeros((0, 2)), jnp.zeros((0, 1), jnp.int32)),
+            shape=(5, 2))
+    else:
+        sp = jsparse.BCOO(
+            (jnp.full((2, 2), float(r)),
+             jnp.array([[1], [min(r + 1, 4)]], jnp.int32)),
+            shape=(5, 2))
+    out = hvd.sparse_allreduce(sp, op=hvd.Sum, name="t7.sparse")
+    want = np.zeros((5, 2))
+    for rr in range(1, n):
+        want[1] += rr
+        want[min(rr + 1, 4)] += rr
+    np.testing.assert_allclose(np.asarray(out.todense()), want)
+
     # dtype x op matrix on the negotiated path (reference analog:
     # test_torch.py's exhaustive dtype/op coverage under -np 2).
     # Rank r contributes full((r+2)); closed forms below.

@@ -2,6 +2,7 @@
 in-proc, plus real multi-process negotiation via the launcher
 (reference: the horovodrun-under-pytest strategy, SURVEY.md §4)."""
 
+import json
 import os
 import subprocess
 import sys
@@ -161,6 +162,46 @@ class TestWireDtypeFusion:
         assert after[0] - before[0] == 2, (before, after)  # 2 batches
         assert outs[0].dtype == jnp.bfloat16
         assert outs[1].dtype == jnp.float32
+
+    def test_fail_batch_trace_stays_balanced(self, hvd_native, tmp_path):
+        """fail_batch on a never-dispatched pending entry must close
+        its open QUEUE span (tl.error), not emit an unmatched
+        DISPATCH end — the Chrome trace stays well-formed."""
+        import jax.numpy as jnp
+        from horovod_tpu.common.basics import state
+        from horovod_tpu.core import native
+        from horovod_tpu.ops.controller import _PendingAllreduce
+        from horovod_tpu.ops.compression import NoneCompressor
+
+        path = str(tmp_path / "fail.json")
+        hvd_native.start_timeline(path)
+        st = state()
+        ctl = st.engine.controller
+        tl = st.engine.timeline
+        pset = st.process_set_table.global_set
+        h = st.engine.new_handle("doomed")
+        # Mimic the post-agreement state for a local entry: QUEUE span
+        # open (controller opens it right before the execute call),
+        # entry still pending, never dispatched.
+        tl.enqueue("doomed")
+        with ctl._mu:
+            ctl._pending["doomed"] = _PendingAllreduce(
+                [jnp.ones(4)], NoneCompressor, pset, 0, 1.0, 1.0, h,
+                True)
+        bad = native.BatchEntry("doomed", "ar|not|a|sig", 1, "", 0, "")
+        ctl._execute_allreduce_batch([bad])   # must not raise
+        with pytest.raises(RuntimeError, match="malformed"):
+            hvd_native.synchronize(h.id)
+        hvd_native.stop_timeline()
+        events = json.load(open(path))
+        opens = {}
+        for e in events:
+            key = (e.get("tid"), e["name"])
+            if e["ph"] == "B":
+                opens[key] = opens.get(key, 0) + 1
+            elif e["ph"] == "E":
+                opens[key] = opens.get(key, 0) - 1
+        assert all(v == 0 for v in opens.values()), opens
 
     def test_malformed_sig_errors_batch_not_worker(self, hvd_native):
         """A malformed agreed signature (mixed-version peer) must

@@ -1,0 +1,156 @@
+"""Sparse allreduce (BCOO) — the reference's sparse-gradient path
+(reference: horovod/torch/mpi_ops.py sparse_allreduce_async;
+horovod/torch/optimizer.py sparse_as_dense). Single-process semantics
+here; the real 2/4-proc phase lives in tests/mp_worker.py."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.experimental import sparse as jsparse
+
+import horovod_tpu as hvd
+
+
+@pytest.fixture()
+def hvd_init():
+    hvd.init()
+    yield
+    hvd.shutdown()
+
+
+def _bcoo_with_duplicates():
+    # Embedding-row shaped gradient: rows 1 and 4 touched, row 1 twice
+    # (the duplicate-coalescing case the torch sparse path hits when a
+    # token repeats in a batch).
+    idx = jnp.array([[1], [4], [1]])
+    data = jnp.arange(9, dtype=jnp.float32).reshape(3, 3)
+    b = jsparse.BCOO((data, idx), shape=(6, 3))
+    dense = np.zeros((6, 3), np.float32)
+    dense[1] = np.asarray(data[0] + data[2])
+    dense[4] = np.asarray(data[1])
+    return b, dense
+
+
+def test_sparse_allreduce_coalesces_duplicates(hvd_init):
+    b, dense = _bcoo_with_duplicates()
+    out = hvd.sparse_allreduce(b, op=hvd.Sum, name="sp.sum")
+    assert isinstance(out, jsparse.BCOO)
+    assert out.nse == 2  # duplicates summed, not concatenated
+    np.testing.assert_allclose(np.asarray(out.todense()), dense)
+
+
+def test_sparse_allreduce_handle_protocol(hvd_init):
+    b, dense = _bcoo_with_duplicates()
+    h = hvd.sparse_allreduce_async(b, name="sp.h")
+    assert isinstance(h, hvd.SparseAllreduceHandle)
+    out = hvd.synchronize(h)  # duck-typed through the top-level API
+    assert hvd.poll(h)
+    # Average at world size 1 == Sum.
+    np.testing.assert_allclose(np.asarray(out.todense()), dense)
+    # Synchronizing twice returns the cached result.
+    assert hvd.synchronize(h) is out
+
+
+def test_sparse_allreduce_empty_nnz(hvd_init):
+    e = jsparse.BCOO((jnp.zeros((0, 3)), jnp.zeros((0, 1), jnp.int32)),
+                     shape=(6, 3))
+    out = hvd.sparse_allreduce(e)
+    np.testing.assert_allclose(np.asarray(out.todense()),
+                               np.zeros((6, 3)))
+
+
+def test_sparse_allreduce_rejects_adasum_and_dense(hvd_init):
+    b, _ = _bcoo_with_duplicates()
+    with pytest.raises(NotImplementedError):
+        hvd.sparse_allreduce(b, op=hvd.Adasum)
+    with pytest.raises(TypeError):
+        hvd.sparse_allreduce(jnp.ones((3, 3)))
+
+
+def test_optimizer_sparse_eager_path(hvd_init):
+    """BCOO gradient leaves ride sparse_allreduce; the reduced update
+    is dense (optax inner transforms are dense-only — documented
+    divergence from torch's sparse-aware SGD)."""
+    b, dense = _bcoo_with_duplicates()
+    params = {"emb": jnp.ones((6, 3)), "w": jnp.ones((2,))}
+    grads = {"emb": b, "w": jnp.full((2,), 2.0)}
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0))
+    upd, _ = opt.update(grads, opt.init(params), params)
+    np.testing.assert_allclose(np.asarray(upd["emb"]), -dense)
+    np.testing.assert_allclose(np.asarray(upd["w"]), -2.0)
+
+
+def test_optimizer_sparse_as_dense(hvd_init):
+    b, dense = _bcoo_with_duplicates()
+    params = {"emb": jnp.ones((6, 3))}
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0), sparse_as_dense=True)
+    upd, _ = opt.update({"emb": b}, opt.init(params), params)
+    np.testing.assert_allclose(np.asarray(upd["emb"]), -dense)
+
+
+def test_optimizer_sparse_predivide_matches_average(hvd_init):
+    b, dense = _bcoo_with_duplicates()
+    params = {"emb": jnp.ones((6, 3))}
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0),
+                                   gradient_predivide_factor=2.0)
+    upd, _ = opt.update({"emb": b}, opt.init(params), params)
+    np.testing.assert_allclose(np.asarray(upd["emb"]), -dense,
+                               rtol=1e-6)
+
+
+def test_optimizer_groups_remap_around_sparse_leaf(hvd_init):
+    """Explicit fusion groups name FULL-tree leaf indices; with a BCOO
+    leaf in the middle, the dense indices must remap onto the
+    compacted dense list (leaf 1 sparse, group [0, 2] must still fuse
+    leaves 0 and 2, not crash out-of-range)."""
+    b, dense = _bcoo_with_duplicates()
+    params = {"a": jnp.ones((2,)), "emb": jnp.ones((6, 3)),
+              "z": jnp.ones((3,))}
+    grads = {"a": jnp.full((2,), 2.0), "emb": b,
+             "z": jnp.full((3,), 3.0)}
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0), groups=[[0, 2]])
+    upd, _ = opt.update(grads, opt.init(params), params)
+    np.testing.assert_allclose(np.asarray(upd["a"]), -2.0)
+    np.testing.assert_allclose(np.asarray(upd["emb"]), -dense)
+    np.testing.assert_allclose(np.asarray(upd["z"]), -3.0)
+    # A group naming the sparse leaf is rejected with guidance.
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0), groups=[[1]])
+    with pytest.raises(ValueError, match="sparse_allreduce"):
+        opt.update(grads, opt.init(params), params)
+    # Out-of-range indices still error against the FULL tree size.
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0), groups=[[0, 5]])
+    with pytest.raises(ValueError, match="out of range"):
+        opt.update(grads, opt.init(params), params)
+
+
+def test_sparse_handle_error_is_sticky(hvd_init):
+    """After a sub-collective failure the composite handle re-raises
+    the ORIGINAL error on retry (never a bare KeyError from the
+    released engine handle), and poll() reports done."""
+    b, _ = _bcoo_with_duplicates()
+    h = hvd.sparse_allreduce_async(b, name="sp.err")
+    err = RuntimeError("injected wire failure")
+    h._error = err  # simulate a failed values batch after idx release
+    assert hvd.poll(h)
+    with pytest.raises(RuntimeError, match="injected wire failure"):
+        hvd.synchronize(h)
+
+
+def test_optimizer_sparse_restrictions(hvd_init):
+    b, _ = _bcoo_with_duplicates()
+    params = {"emb": jnp.ones((6, 3))}
+    grads = {"emb": b}
+    # Local aggregation needs a dense accumulator.
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0),
+                                   backward_passes_per_step=2)
+    with pytest.raises(ValueError, match="sparse_as_dense"):
+        opt.update(grads, opt.init(params), params)
+    # The in-jit axis path is dense-only.
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0), axis_name="data")
+    with pytest.raises(ValueError, match="sparse_as_dense"):
+        opt.update(grads, opt.init(params), params)
+    # Adasum sparse names the escape hatch.
+    opt = hvd.DistributedOptimizer(optax.sgd(1.0), op=hvd.Adasum)
+    with pytest.raises(NotImplementedError, match="sparse_as_dense"):
+        opt.update(grads, opt.init(params), params)
