@@ -50,14 +50,21 @@ def _require_bcoo(tensor):
     return tensor
 
 
+_BCOO_CLS = None
+
+
 def is_sparse(x) -> bool:
     """True if `x` is a BCOO sparse array (the sparse-gradient leaf
-    type this module reduces)."""
-    try:
-        from jax.experimental import sparse as jsparse
-    except Exception:  # pragma: no cover - sparse always ships with jax
-        return False
-    return isinstance(x, jsparse.BCOO)
+    type this module reduces). Called per leaf on the optimizer hot
+    path, so the BCOO class resolves once."""
+    global _BCOO_CLS
+    if _BCOO_CLS is None:
+        try:
+            from jax.experimental import sparse as jsparse
+        except Exception:  # pragma: no cover - ships with jax
+            return False
+        _BCOO_CLS = jsparse.BCOO
+    return isinstance(x, _BCOO_CLS)
 
 
 class SparseAllreduceHandle:
@@ -143,6 +150,10 @@ def sparse_allreduce_async(tensor, average: Optional[bool] = None,
             "sparse_allreduce supports op=Average or op=Sum; for other "
             "ops densify first (DistributedOptimizer(..., "
             "sparse_as_dense=True))")
+    # Same integer/Average restriction as the dense op — without it
+    # the result dtype would depend on world size (int passthrough at
+    # size 1, float true-divide beyond).
+    C._check_inexact_for_average(rop, [t.data])
     st = _require_init()
     pset = C._pset(process_set)
     name = name or st.engine.auto_name("sparse_allreduce")

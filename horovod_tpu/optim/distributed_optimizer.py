@@ -70,15 +70,16 @@ def _axis_reduce(grads, axis_name: str, op: int, compression, size_hint):
     return jax.tree_util.tree_map(red, grads)
 
 
-def _eager_reduce(grads, op: int, compression,
+def _eager_reduce(leaves: List[Any], op: int, compression,
                   process_set: Optional[ProcessSet], num_groups: int,
                   groups: Optional[Sequence[Sequence[Any]]],
-                  prescale: float, postscale: float):
+                  prescale: float, postscale: float) -> List[Any]:
     """Cross-process reduction through the eager engine, fused into
-    grouped allreduces (the tensor-fusion analog)."""
-    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    grouped allreduces (the tensor-fusion analog). Flat leaves in,
+    reduced leaves out (the caller flattened once to scan for sparse
+    leaves — don't traverse the tree twice on the hot path)."""
     if not leaves:
-        return grads
+        return leaves
     if groups is not None:
         # Explicit fusion groups as lists of leaf indices (the pytree
         # analog of the reference's lists of parameters). Leaves not
@@ -114,7 +115,7 @@ def _eager_reduce(grads, op: int, compression,
             process_set=process_set)
         for i, r in zip(idxs, reduced):
             out[i] = r
-    return jax.tree_util.tree_unflatten(treedef, out)
+    return out
 
 
 def _scale_bcoo(x, factor: float):
@@ -179,9 +180,10 @@ def _eager_reduce_mixed(leaves, treedef, sp_idx, eff_op, compression,
             remapped.append([dense_pos[i] for i in idxs])
         groups = remapped
     if dense_idx:
-        reduced = _eager_reduce([leaves[i] for i in dense_idx], eff_op,
-                                compression, process_set, num_groups,
-                                groups, prescale, postscale)
+        reduced = _eager_reduce([leaves[i] for i in dense_idx],
+                                eff_op, compression, process_set,
+                                num_groups, groups, prescale,
+                                postscale)
         for i, r in zip(dense_idx, reduced):
             leaves[i] = r
     for i, h in handles.items():
@@ -259,8 +261,9 @@ def DistributedGradientTransformation(
                                        compression, process_set,
                                        num_groups, groups, prescale,
                                        postscale)
-        return _eager_reduce(grads, eff_op, compression, process_set,
-                             num_groups, groups, prescale, postscale)
+        return jax.tree_util.tree_unflatten(treedef, _eager_reduce(
+            leaves, eff_op, compression, process_set, num_groups,
+            groups, prescale, postscale))
 
     def init_fn(params):
         inner_state = inner.init(params)

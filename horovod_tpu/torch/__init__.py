@@ -1,0 +1,536 @@
+"""horovod_tpu.torch — the torch frontend binding.
+
+API parity with the reference's torch binding
+(reference: horovod/torch/__init__.py + mpi_ops.py + optimizer.py +
+functions.py): `import horovod_tpu.torch as hvd` is a drop-in for
+`import horovod.torch as hvd` on CPU torch tensors, including the
+in-place `_` variants (torch tensors are mutable, so unlike the JAX
+frontend these exist here), hook-based DistributedOptimizer overlap,
+and state_dict broadcast helpers.
+
+TPU-native design: there is no torch extension / C++ binding layer
+(reference: horovod/torch/mpi_ops_v2.cc, handle_manager.cc,
+ready_event.cc — ~1500 LoC of CUDA-stream plumbing). Tensors bridge
+zero-copy into numpy (CPU) and ride the SAME negotiated eager engine
+as the JAX frontend — one runtime, two frontends, identical
+negotiation/fusion/timeline behavior. bf16 bridges through f32
+(numpy has no bfloat16; exact in that direction, and reduction
+results are bf16-representable so the round-trip is exact too).
+
+This module is intentionally NOT imported by `horovod_tpu` itself:
+torch users opt in with the reference's own import line, JAX users
+never pay the torch import.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import torch
+
+import jax
+import jax.numpy as jnp
+
+import horovod_tpu as _hvd
+from horovod_tpu.ops import collective_ops as _C
+from horovod_tpu.ops.process_set import ProcessSet  # noqa: F401
+from horovod_tpu.ops.compression import Compression  # noqa: F401
+
+# Runtime surface re-exports (reference: horovod/torch/__init__.py
+# re-exports the basics from mpi_ops).
+init = _hvd.init
+shutdown = _hvd.shutdown
+is_initialized = _hvd.is_initialized
+rank = _hvd.rank
+size = _hvd.size
+local_rank = _hvd.local_rank
+local_size = _hvd.local_size
+cross_rank = _hvd.cross_rank
+cross_size = _hvd.cross_size
+Average = _hvd.Average
+Sum = _hvd.Sum
+Adasum = _hvd.Adasum
+Min = _hvd.Min
+Max = _hvd.Max
+Product = _hvd.Product
+add_process_set = _hvd.add_process_set
+remove_process_set = _hvd.remove_process_set
+join = _C.join
+barrier = _C.barrier
+start_timeline = _hvd.start_timeline
+stop_timeline = _hvd.stop_timeline
+nccl_built = _hvd.nccl_built
+mpi_built = _hvd.mpi_built
+gloo_built = _hvd.gloo_built
+cuda_built = _hvd.cuda_built
+rocm_built = _hvd.rocm_built
+
+
+# ---------------------------------------------------------------------------
+# tensor bridging
+# ---------------------------------------------------------------------------
+
+_warned_x64 = False
+
+
+def _to_jax(t: torch.Tensor):
+    global _warned_x64
+    if not isinstance(t, torch.Tensor):
+        raise TypeError(f"expected a torch.Tensor, got {type(t).__name__}")
+    if t.device.type != "cpu":
+        raise ValueError(
+            "horovod_tpu.torch bridges CPU torch tensors; for "
+            "accelerator-resident training use the JAX frontend "
+            "(docs/migrating_from_horovod.md)")
+    t = t.detach()
+    if (t.dtype in (torch.int64, torch.float64)
+            and not jax.config.jax_enable_x64 and not _warned_x64):
+        _warned_x64 = True
+        from horovod_tpu.common.logging import logger
+        logger.warning(
+            "64-bit torch tensors reduce in 32-bit precision unless "
+            "JAX_ENABLE_X64=1 is set (the torch-side dtype is "
+            "preserved on return)")
+    if t.dtype == torch.bfloat16:
+        # numpy has no bfloat16; f32 holds every bf16 exactly.
+        return jnp.asarray(t.float().numpy()).astype(jnp.bfloat16)
+    return jnp.asarray(np.asarray(t))
+
+
+def _to_torch(a, torch_dtype: torch.dtype) -> torch.Tensor:
+    if a.dtype == jnp.bfloat16:
+        out = torch.from_numpy(
+            np.asarray(a.astype(jnp.float32)).copy()).to(torch.bfloat16)
+    else:
+        out = torch.from_numpy(np.asarray(a).copy())
+    return out.to(torch_dtype)
+
+
+# handle id -> torch dtype of the submitted tensor(s), so the torch
+# synchronize can convert back (reference: HandleManager keeps the
+# output tensor per handle).
+_handle_meta: Dict[int, Any] = {}
+
+
+def _remember(handle: int, meta) -> int:
+    _handle_meta[handle] = meta
+    return handle
+
+
+def synchronize(handle: int):
+    """Block until the op completes; returns torch output(s)
+    (reference: mpi_ops.synchronize)."""
+    meta = _handle_meta.pop(handle, None)
+    out = _C.synchronize(handle)
+    if meta is None:
+        return out
+    kind = meta[0]
+    if kind == "one":
+        return _to_torch(out, meta[1])
+    if kind == "group":
+        return [_to_torch(o, dt) for o, dt in zip(out, meta[1])]
+    if kind == "inplace":
+        res = _to_torch(out, meta[1].dtype)
+        meta[1].copy_(res.reshape(meta[1].shape))
+        return meta[1]
+    if kind == "alltoall":
+        gathered, splits = out
+        res = _to_torch(gathered, meta[1])
+        if not meta[2]:   # no splits passed: plain output, like the
+            return res    # reference's splits-less alltoall
+        return res, torch.from_numpy(np.asarray(splits).copy())
+    raise AssertionError(kind)
+
+
+def poll(handle: int) -> bool:
+    return _C.poll(handle)
+
+
+# ---------------------------------------------------------------------------
+# collectives (reference: horovod/torch/mpi_ops.py surface)
+# ---------------------------------------------------------------------------
+
+def allreduce_async(tensor, average=None, name=None, op=None,
+                    prescale_factor=1.0, postscale_factor=1.0,
+                    compression=Compression.none,
+                    process_set=None) -> int:
+    h = _C.allreduce_async(_to_jax(tensor), average=average, name=name,
+                           op=op, prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor,
+                           compression=compression,
+                           process_set=process_set)
+    return _remember(h, ("one", tensor.dtype))
+
+
+def allreduce(tensor, average=None, name=None, op=None,
+              prescale_factor=1.0, postscale_factor=1.0,
+              compression=Compression.none, process_set=None):
+    return synchronize(allreduce_async(
+        tensor, average=average, name=name, op=op,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, compression=compression,
+        process_set=process_set))
+
+
+def allreduce_async_(tensor, average=None, name=None, op=None,
+                     prescale_factor=1.0, postscale_factor=1.0,
+                     compression=Compression.none,
+                     process_set=None) -> int:
+    """In-place variant: on synchronize, the result is copied back
+    into `tensor` (reference: allreduce_async_)."""
+    h = _C.allreduce_async(_to_jax(tensor), average=average, name=name,
+                           op=op, prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor,
+                           compression=compression,
+                           process_set=process_set)
+    return _remember(h, ("inplace", tensor))
+
+
+def allreduce_(tensor, average=None, name=None, op=None,
+               prescale_factor=1.0, postscale_factor=1.0,
+               compression=Compression.none, process_set=None):
+    return synchronize(allreduce_async_(
+        tensor, average=average, name=name, op=op,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, compression=compression,
+        process_set=process_set))
+
+
+def grouped_allreduce_async(tensors: Sequence[torch.Tensor],
+                            average=None, name=None, op=None,
+                            prescale_factor=1.0, postscale_factor=1.0,
+                            compression=Compression.none,
+                            process_set=None) -> int:
+    h = _C.grouped_allreduce_async(
+        [_to_jax(t) for t in tensors], average=average, name=name,
+        op=op, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, compression=compression,
+        process_set=process_set)
+    return _remember(h, ("group", [t.dtype for t in tensors]))
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None,
+                      prescale_factor=1.0, postscale_factor=1.0,
+                      compression=Compression.none, process_set=None):
+    return synchronize(grouped_allreduce_async(
+        tensors, average=average, name=name, op=op,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, compression=compression,
+        process_set=process_set))
+
+
+def allgather_async(tensor, name=None, process_set=None) -> int:
+    h = _C.allgather_async(_to_jax(tensor), name=name,
+                           process_set=process_set)
+    return _remember(h, ("one", tensor.dtype))
+
+
+def allgather(tensor, name=None, process_set=None):
+    return synchronize(allgather_async(tensor, name=name,
+                                       process_set=process_set))
+
+
+def broadcast_async(tensor, root_rank, name=None, process_set=None) -> int:
+    h = _C.broadcast_async(_to_jax(tensor), root_rank=root_rank,
+                           name=name, process_set=process_set)
+    return _remember(h, ("one", tensor.dtype))
+
+
+def broadcast(tensor, root_rank, name=None, process_set=None):
+    return synchronize(broadcast_async(tensor, root_rank=root_rank,
+                                       name=name,
+                                       process_set=process_set))
+
+
+def broadcast_async_(tensor, root_rank, name=None,
+                     process_set=None) -> int:
+    h = _C.broadcast_async(_to_jax(tensor), root_rank=root_rank,
+                           name=name, process_set=process_set)
+    return _remember(h, ("inplace", tensor))
+
+
+def broadcast_(tensor, root_rank, name=None, process_set=None):
+    return synchronize(broadcast_async_(tensor, root_rank=root_rank,
+                                        name=name,
+                                        process_set=process_set))
+
+
+def alltoall_async(tensor, splits=None, name=None,
+                   process_set=None) -> int:
+    if splits is not None and isinstance(splits, torch.Tensor):
+        splits = [int(s) for s in splits]
+    h = _C.alltoall_async(_to_jax(tensor), splits=splits, name=name,
+                          process_set=process_set)
+    return _remember(h, ("alltoall", tensor.dtype, splits is not None))
+
+
+def alltoall(tensor, splits=None, name=None, process_set=None):
+    return synchronize(alltoall_async(tensor, splits=splits, name=name,
+                                      process_set=process_set))
+
+
+def reducescatter_async(tensor, op=None, name=None,
+                        process_set=None) -> int:
+    h = _C.reducescatter_async(_to_jax(tensor), op=op, name=name,
+                               process_set=process_set)
+    return _remember(h, ("one", tensor.dtype))
+
+
+def reducescatter(tensor, op=None, name=None, process_set=None):
+    return synchronize(reducescatter_async(tensor, op=op, name=name,
+                                           process_set=process_set))
+
+
+def sparse_allreduce(tensor, average=None, name=None, op=None,
+                     process_set=None):
+    """torch.sparse COO allreduce (reference: the sparse path in
+    torch/mpi_ops.py): bridges to the BCOO sparse_allreduce and
+    returns a coalesced torch sparse tensor."""
+    from jax.experimental import sparse as jsparse
+    if not (isinstance(tensor, torch.Tensor) and tensor.is_sparse):
+        raise TypeError("sparse_allreduce expects a torch sparse COO "
+                        "tensor; dense tensors go through allreduce")
+    t = tensor.coalesce()
+    vals = t.values()
+    bcoo = jsparse.BCOO(
+        (_to_jax(vals),
+         jnp.asarray(np.asarray(t.indices().t().contiguous()))),
+        shape=tuple(t.shape))
+    out = _hvd.sparse_allreduce(bcoo, average=average, name=name,
+                                op=op, process_set=process_set)
+    return torch.sparse_coo_tensor(
+        torch.from_numpy(np.asarray(out.indices).copy()).t(),
+        _to_torch(out.data, vals.dtype), size=tuple(t.shape)
+    ).coalesce()
+
+
+# ---------------------------------------------------------------------------
+# parameter / optimizer-state broadcast (reference: torch/functions.py)
+# ---------------------------------------------------------------------------
+
+def broadcast_parameters(params, root_rank: int = 0,
+                         process_set=None) -> None:
+    """Broadcast a state_dict or iterable of (name, tensor) IN PLACE
+    (reference: functions.broadcast_parameters)."""
+    if isinstance(params, dict):
+        items = sorted(params.items())
+    else:
+        items = sorted(params)
+    tensors = [(n, t) for n, t in items if isinstance(t, torch.Tensor)]
+    handles = [broadcast_async_(t, root_rank, name=f"bp.{n}",
+                                process_set=process_set)
+               for n, t in tensors]
+    for h in handles:
+        synchronize(h)
+
+
+def broadcast_object(obj, root_rank: int = 0, name=None,
+                     process_set=None):
+    return _hvd.broadcast_object(obj, root_rank=root_rank, name=name,
+                                 process_set=process_set)
+
+
+def allgather_object(obj, name=None, process_set=None):
+    return _hvd.allgather_object(obj, name=name, process_set=process_set)
+
+
+def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
+                              root_rank: int = 0,
+                              process_set=None) -> None:
+    """Broadcast a torch optimizer's state dict from root
+    (reference: functions.broadcast_optimizer_state).
+
+    The ROOT's state defines the structure: root's skeleton and tensor
+    manifest (paths/shapes/dtypes) ship first as one pickled object,
+    then EVERY rank submits the identical set of tensor broadcasts —
+    zeros-backed where the local state lacks an entry. This handles
+    the asymmetric case the function exists for (root resumed from a
+    checkpoint with materialized Adam state, workers fresh with empty
+    state); ranks never submit divergent collective sets, so no
+    negotiation deadlock."""
+    if isinstance(optimizer, DistributedOptimizer):
+        optimizer = optimizer._opt
+    sd = optimizer.state_dict()
+    local: Dict[tuple, torch.Tensor] = {}
+
+    def strip(x, path):
+        if isinstance(x, torch.Tensor):
+            local[tuple(path)] = x
+            return None
+        if isinstance(x, dict):
+            # real keys (optimizer state keys are ints) — pickle
+            # preserves them, and reconstruction navigates by them.
+            return {k: strip(v, path + [k]) for k, v in x.items()}
+        if isinstance(x, list):
+            return [strip(v, path + [i]) for i, v in enumerate(x)]
+        return x
+
+    skeleton = strip(sd, [])
+    manifest = [(p, tuple(t.shape), str(t.dtype).replace("torch.", ""))
+                for p, t in sorted(local.items(), key=lambda kv: str(kv[0]))]
+    skeleton, manifest = broadcast_object(
+        (skeleton, manifest), root_rank=root_rank,
+        name="opt_state_skeleton", process_set=process_set)
+
+    handles = []
+    bufs = []
+    for i, (path, shape, dtype_name) in enumerate(manifest):
+        dt = getattr(torch, dtype_name)
+        t = local.get(tuple(path))
+        if t is None or tuple(t.shape) != tuple(shape) or t.dtype != dt:
+            t = torch.zeros(shape, dtype=dt)
+        bufs.append((tuple(path), t))
+        handles.append(broadcast_async_(t, root_rank,
+                                        name=f"opt_state.{i}",
+                                        process_set=process_set))
+    for h in handles:
+        synchronize(h)
+
+    for path, t in bufs:
+        node = skeleton
+        for k in path[:-1]:
+            node = node[k]
+        node[path[-1]] = t
+    optimizer.load_state_dict(skeleton)
+
+
+# ---------------------------------------------------------------------------
+# DistributedOptimizer (reference: horovod/torch/optimizer.py)
+# ---------------------------------------------------------------------------
+
+class DistributedOptimizer:
+    """Wraps a torch.optim.Optimizer with hook-based gradient
+    averaging (reference: _DistributedOptimizer — per-parameter
+    post-accumulate hooks submit allreduce_async_ in reverse layer
+    order; step() synchronizes then applies).
+
+    The async submissions enter the negotiated engine as soon as each
+    gradient materializes, so negotiation/fusion overlaps the rest of
+    backward exactly like the reference's background thread."""
+
+    def __init__(self, optimizer: torch.optim.Optimizer,
+                 named_parameters=None,
+                 compression=Compression.none,
+                 backward_passes_per_step: int = 1,
+                 op=None, gradient_predivide_factor: float = 1.0,
+                 process_set=None, sparse_as_dense: bool = False):
+        self._opt = optimizer
+        self._compression = compression
+        self._op = Average if op is None else op
+        self._pset = process_set
+        self._sparse_as_dense = sparse_as_dense
+        self._k = int(backward_passes_per_step)
+        if self._k < 1:
+            raise ValueError("backward_passes_per_step must be >= 1")
+        if gradient_predivide_factor != 1.0 and self._op != Average:
+            raise ValueError("gradient_predivide_factor requires "
+                             "op=Average (as in the reference)")
+        self._prescale = 1.0
+        self._postscale = 1.0
+        if gradient_predivide_factor != 1.0:
+            n = (process_set.size if process_set is not None
+                 else _hvd.size())
+            self._prescale = 1.0 / gradient_predivide_factor
+            self._postscale = gradient_predivide_factor / n
+            self._op = Sum
+        if named_parameters is not None:
+            named = [(n, p) for n, p in named_parameters]
+        else:
+            named = [(f"param.{gi}.{pi}", p)
+                     for gi, g in enumerate(optimizer.param_groups)
+                     for pi, p in enumerate(g["params"])]
+        names = [n for n, _ in named]
+        if len(set(names)) != len(names):
+            raise ValueError("parameter names must be unique")
+        self._named = named
+        self._name_of = {id(p): n for n, p in named}
+        self._handles: Dict[int, Tuple[torch.Tensor, int]] = {}
+        self._passes: Dict[int, int] = {}
+        self._skip = False
+        self._hooks = [
+            p.register_post_accumulate_grad_hook(self._hook)
+            for _, p in named if p.requires_grad]
+
+    # -- reference surface delegation ------------------------------------
+    @property
+    def param_groups(self):
+        return self._opt.param_groups
+
+    @property
+    def state(self):
+        return self._opt.state
+
+    def state_dict(self):
+        return self._opt.state_dict()
+
+    def load_state_dict(self, sd):
+        return self._opt.load_state_dict(sd)
+
+    def zero_grad(self, set_to_none: bool = True):
+        if self._handles:
+            raise RuntimeError(
+                "zero_grad() with allreduce submissions in flight; "
+                "call step() (or synchronize()) first, as in the "
+                "reference")
+        return self._opt.zero_grad(set_to_none=set_to_none)
+
+    # -- the hook path ----------------------------------------------------
+    def _hook(self, p: torch.Tensor) -> None:
+        cnt = self._passes.get(id(p), 0) + 1
+        self._passes[id(p)] = cnt
+        if cnt < self._k:
+            return
+        self._passes[id(p)] = 0
+        grad = p.grad
+        if grad is None:
+            return
+        if grad.is_sparse:
+            if self._sparse_as_dense:
+                grad = grad.to_dense()
+                p.grad = grad
+            else:
+                raise NotImplementedError(
+                    "hook-based sparse gradients: pass "
+                    "sparse_as_dense=True (reference optimizer.py "
+                    "option) or use hvd.sparse_allreduce manually")
+        name = self._name_of[id(p)]
+        scale = 1.0 / self._k if self._k > 1 else 1.0
+        h = allreduce_async_(
+            grad, op=self._op, name=f"DistributedOptimizer.{name}",
+            prescale_factor=self._prescale * scale,
+            postscale_factor=self._postscale,
+            compression=self._compression, process_set=self._pset)
+        self._handles[h] = (p, h)
+
+    def synchronize(self) -> None:
+        """Wait for every in-flight gradient reduction
+        (reference: optimizer.synchronize()). Drains ALL handles even
+        when one errs — surviving reductions still write back and the
+        optimizer stays usable (zero_grad/retry) after the raise."""
+        err = None
+        for h in list(self._handles):
+            try:
+                synchronize(h)
+            except Exception as ex:
+                if err is None:
+                    err = ex
+        self._handles.clear()
+        if err is not None:
+            raise err
+
+    @contextlib.contextmanager
+    def skip_synchronize(self):
+        """Reference: with optimizer.skip_synchronize(): step() —
+        apply without reducing (used with manual synchronize())."""
+        self._skip = True
+        try:
+            yield
+        finally:
+            self._skip = False
+
+    def step(self, closure=None):
+        if not self._skip:
+            self.synchronize()
+        return self._opt.step(closure)

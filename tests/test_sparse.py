@@ -60,6 +60,17 @@ def test_sparse_allreduce_empty_nnz(hvd_init):
                                np.zeros((6, 3)))
 
 
+def test_sparse_allreduce_rejects_int_average(hvd_init):
+    """Same integer/Average restriction as the dense op — otherwise
+    the result dtype would depend on world size."""
+    b = jsparse.BCOO((jnp.array([3, 5], jnp.int32),
+                      jnp.array([[0], [2]], jnp.int32)), shape=(4,))
+    with pytest.raises(ValueError, match="[Aa]verage"):
+        hvd.sparse_allreduce(b)   # default op is Average
+    out = hvd.sparse_allreduce(b, op=hvd.Sum, name="sp.int")
+    assert out.data.dtype == jnp.int32
+
+
 def test_sparse_allreduce_rejects_adasum_and_dense(hvd_init):
     b, _ = _bcoo_with_duplicates()
     with pytest.raises(NotImplementedError):

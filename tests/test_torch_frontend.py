@@ -1,0 +1,213 @@
+"""The torch frontend binding: `import horovod_tpu.torch as hvd`
+(reference: horovod/torch — mpi_ops.py surface, optimizer.py hooks,
+functions.py state_dict helpers). Single-process semantics here; the
+real 2-proc run is TestTorchRealLaunch via the launcher."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+import torch
+
+import horovod_tpu.torch as hvd
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def hvd_init():
+    hvd.init()
+    yield
+    hvd.shutdown()
+
+
+class TestTensorOps:
+    def test_allreduce_dtype_preserved(self, hvd_init):
+        for dt in [torch.float32, torch.float16, torch.bfloat16]:
+            out = hvd.allreduce(torch.ones(4, dtype=dt), op=hvd.Sum,
+                                name=f"dt.{dt}")
+            assert out.dtype == dt
+            np.testing.assert_allclose(out.float().numpy(), 1.0)
+
+    def test_allreduce_inplace_mutates(self, hvd_init):
+        t = torch.full((3,), 2.0)
+        ret = hvd.allreduce_(t, op=hvd.Sum, name="inp")
+        assert ret is t
+        np.testing.assert_allclose(t.numpy(), 2.0)
+
+    def test_grouped_allreduce(self, hvd_init):
+        outs = hvd.grouped_allreduce(
+            [torch.ones(2), torch.ones(3, dtype=torch.float16)],
+            name="grp")
+        assert outs[0].dtype == torch.float32
+        assert outs[1].dtype == torch.float16
+
+    def test_broadcast_allgather_reducescatter(self, hvd_init):
+        t = torch.arange(4.0)
+        hvd.broadcast_(t, root_rank=0, name="bc")
+        g = hvd.allgather(torch.ones(2, 3), name="ag")
+        assert g.shape == (2, 3)
+        rs = hvd.reducescatter(torch.ones(2, 3), op=hvd.Sum, name="rs")
+        assert rs.shape == (2, 3)
+
+    def test_alltoall_matches_reference_shapes(self, hvd_init):
+        out = hvd.alltoall(torch.arange(4.0), name="a2a")
+        assert isinstance(out, torch.Tensor)   # splits-less: bare out
+        out, recv = hvd.alltoall(torch.arange(4.0)[:, None],
+                                 splits=[4], name="a2av")
+        assert recv.tolist() == [4]
+
+    def test_async_handle_protocol(self, hvd_init):
+        h = hvd.allreduce_async(torch.ones(4), name="h0")
+        out = hvd.synchronize(h)
+        assert isinstance(out, torch.Tensor)
+
+    def test_sparse_allreduce_coo(self, hvd_init):
+        s = torch.sparse_coo_tensor(torch.tensor([[1, 4, 1]]),
+                                    torch.ones(3, 2), size=(6, 2))
+        d = hvd.sparse_allreduce(s, op=hvd.Sum, name="sp").to_dense()
+        assert float(d[1, 0]) == 2.0 and float(d[4, 0]) == 1.0
+
+    def test_rejects_dense_in_sparse_and_noncpu_guard(self, hvd_init):
+        with pytest.raises(TypeError):
+            hvd.sparse_allreduce(torch.ones(3))
+        with pytest.raises(TypeError):
+            hvd.allreduce(np.ones(3), name="np")
+
+
+class TestDistributedOptimizer:
+    def _fit(self, opt_factory, steps=150):
+        torch.manual_seed(0)
+        model = torch.nn.Linear(4, 1, bias=False)
+        hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+        opt = opt_factory(model)
+        X = torch.randn(64, 4)
+        Y = X @ torch.randn(4, 1)
+        loss = None
+        for _ in range(steps):
+            opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(X), Y)
+            loss.backward()
+            opt.step()
+        return float(loss.detach()), model
+
+    def test_hook_optimizer_converges(self, hvd_init):
+        loss, _ = self._fit(lambda m: hvd.DistributedOptimizer(
+            torch.optim.SGD(m.parameters(), lr=0.1),
+            named_parameters=m.named_parameters()))
+        assert loss < 1e-4, loss
+
+    def test_unnamed_parameters_autoname(self, hvd_init):
+        loss, _ = self._fit(lambda m: hvd.DistributedOptimizer(
+            torch.optim.SGD(m.parameters(), lr=0.1)))
+        assert loss < 1e-4, loss
+
+    def test_backward_passes_per_step_averages(self, hvd_init):
+        """k accumulation passes then one step must equal one step on
+        the averaged gradient (the LocalGradientAggregationHelper
+        contract)."""
+        torch.manual_seed(1)
+        X = torch.randn(6, 3)
+        Y = torch.randn(6, 1)
+
+        def run(k):
+            torch.manual_seed(2)
+            model = torch.nn.Linear(3, 1, bias=False)
+            opt = hvd.DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=1.0),
+                named_parameters=model.named_parameters(),
+                backward_passes_per_step=k)
+            opt.zero_grad()
+            for i in range(k):
+                loss = torch.nn.functional.mse_loss(
+                    model(X), Y)
+                loss.backward()
+            opt.step()
+            return model.weight.detach().clone()
+
+        w2 = run(2)
+        # manual: same two backwards accumulate, grad/2 applied
+        torch.manual_seed(2)
+        model = torch.nn.Linear(3, 1, bias=False)
+        for i in range(2):
+            torch.nn.functional.mse_loss(model(X), Y).backward()
+        with torch.no_grad():
+            want = model.weight - 1.0 * model.weight.grad / 2
+        np.testing.assert_allclose(w2.numpy(), want.numpy(), rtol=1e-6)
+
+    def test_manual_synchronize_and_skip(self, hvd_init):
+        torch.manual_seed(3)
+        model = torch.nn.Linear(3, 1)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters())
+        opt.zero_grad()
+        torch.nn.functional.mse_loss(
+            model(torch.randn(4, 3)), torch.randn(4, 1)).backward()
+        opt.synchronize()
+        with opt.skip_synchronize():
+            opt.step()
+
+    def test_zero_grad_with_inflight_raises(self, hvd_init):
+        torch.manual_seed(4)
+        model = torch.nn.Linear(3, 1)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters())
+        torch.nn.functional.mse_loss(
+            model(torch.randn(4, 3)), torch.randn(4, 1)).backward()
+        with pytest.raises(RuntimeError, match="in flight"):
+            opt.zero_grad()
+        opt.synchronize()
+
+    def test_duplicate_names_rejected(self, hvd_init):
+        model = torch.nn.Linear(3, 1, bias=False)
+        with pytest.raises(ValueError, match="unique"):
+            hvd.DistributedOptimizer(
+                torch.optim.SGD(model.parameters(), lr=0.1),
+                named_parameters=[("w", model.weight),
+                                  ("w", model.weight)])
+
+    def test_synchronize_drains_all_handles_on_error(self, hvd_init):
+        """One failed reduction must not wedge the optimizer: every
+        other handle still applies, state clears, zero_grad works,
+        and the original error surfaces."""
+        torch.manual_seed(6)
+        model = torch.nn.Linear(3, 1)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters())
+        torch.nn.functional.mse_loss(
+            model(torch.randn(4, 3)), torch.randn(4, 1)).backward()
+        opt._handles[999999999] = (None, 999999999)  # dead handle id
+        with pytest.raises(KeyError):
+            opt.synchronize()
+        assert not opt._handles
+        opt.zero_grad()   # must not raise "in flight"
+
+    def test_broadcast_optimizer_state_roundtrip(self, hvd_init):
+        torch.manual_seed(5)
+        model = torch.nn.Linear(3, 1)
+        opt = hvd.DistributedOptimizer(
+            torch.optim.Adam(model.parameters(), lr=0.01),
+            named_parameters=model.named_parameters())
+        opt.zero_grad()
+        torch.nn.functional.mse_loss(
+            model(torch.randn(4, 3)), torch.randn(4, 1)).backward()
+        opt.step()   # materialize Adam state (exp_avg etc.)
+        hvd.broadcast_optimizer_state(opt, root_rank=0)
+        sd = opt.state_dict()
+        assert any("exp_avg" in str(k2)
+                   for st in sd["state"].values() for k2 in st)
+
+
+@pytest.mark.integration
+class TestTorchRealLaunch:
+    def test_two_process_torch_frontend(self):
+        from tests.test_runner import run_launcher
+        r = run_launcher(2, os.path.join("tests", "mp_worker_torch.py"),
+                         timeout=360)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert r.stdout.count("TORCH FRONTEND ALL OK") == 2, r.stdout
