@@ -40,8 +40,20 @@ from horovod_tpu.ops.compression import Compression  # noqa: F401
 
 # Runtime surface re-exports (reference: horovod/torch/__init__.py
 # re-exports the basics from mpi_ops).
-init = _hvd.init
-shutdown = _hvd.shutdown
+def init(*args, **kwargs):
+    # Engine handle ids restart from 1 on re-init; stale metadata from
+    # an abandoned handle of a previous session must never resolve
+    # against a reused id (it would silently write into a dead
+    # tensor). Cleared on both ends for safety.
+    _handle_meta.clear()
+    return _hvd.init(*args, **kwargs)
+
+
+def shutdown(*args, **kwargs):
+    _handle_meta.clear()
+    return _hvd.shutdown(*args, **kwargs)
+
+
 is_initialized = _hvd.is_initialized
 rank = _hvd.rank
 size = _hvd.size
@@ -133,7 +145,10 @@ def synchronize(handle: int):
         return [_to_torch(o, dt) for o, dt in zip(out, meta[1])]
     if kind == "inplace":
         res = _to_torch(out, meta[1].dtype)
-        meta[1].copy_(res.reshape(meta[1].shape))
+        # no_grad: the target is often a requires-grad leaf (broadcast
+        # of model parameters) — the write-back is not a traced op.
+        with torch.no_grad():
+            meta[1].copy_(res.reshape(meta[1].shape))
         return meta[1]
     if kind == "alltoall":
         gathered, splits = out

@@ -40,6 +40,15 @@ class TestExamples:
         assert r.returncode == 0, r.stdout + r.stderr
         assert "epoch 0" in r.stdout
 
+    def test_torch_mnist_two_proc(self):
+        """The reference's canonical torch script, one changed import
+        (the torch frontend binding), trains to accuracy at 2 ranks."""
+        r = run_example("torch_mnist.py", ["--epochs", "2"], np_=2)
+        assert r.returncode == 0, r.stdout + r.stderr
+        acc = float(r.stdout.split("final train accuracy:")[1]
+                    .strip().split()[0])
+        assert acc > 0.9, r.stdout
+
     def test_pipelined_two_proc(self):
         """The pipelined apply-then-grad recipe trains to accuracy
         through the negotiated grouped allreduce at 2 ranks."""

@@ -59,6 +59,36 @@ class TestTensorOps:
                                  splits=[4], name="a2av")
         assert recv.tolist() == [4]
 
+    def test_inplace_on_requires_grad_parameter(self, hvd_init):
+        """broadcast_parameters(model.named_parameters()) — the
+        reference-standard form — writes into requires-grad LEAF
+        tensors; the write-back must run under no_grad."""
+        torch.manual_seed(7)
+        model = torch.nn.Linear(3, 2)
+        hvd.broadcast_parameters(model.named_parameters(), root_rank=0)
+        p = next(model.parameters())
+        assert p.requires_grad
+        hvd.allreduce_(p, name="inp.param")   # direct in-place too
+
+    def test_stale_handle_meta_cleared_across_reinit(self):
+        """An abandoned async handle's metadata must not resolve
+        against the recycled handle id of the NEXT session (engine
+        ids restart at 1), which would write into a dead tensor."""
+        hvd.init()
+        dead = torch.zeros(4)
+        hvd.allreduce_async_(dead, op=hvd.Sum, name="abandoned")
+        hvd.shutdown()
+        hvd.init()
+        try:
+            h = hvd.allreduce_async(torch.ones(2), op=hvd.Sum,
+                                    name="fresh")
+            out = hvd.synchronize(h)
+            assert out.shape == (2,)   # not the stale 4-elem write
+            np.testing.assert_allclose(out.numpy(), 1.0)
+            np.testing.assert_allclose(dead.numpy(), 0.0)
+        finally:
+            hvd.shutdown()
+
     def test_async_handle_protocol(self, hvd_init):
         h = hvd.allreduce_async(torch.ones(4), name="h0")
         out = hvd.synchronize(h)
