@@ -34,6 +34,13 @@ def native_controller_built() -> bool:
         return False
 
 
+def torch_frontend_available() -> bool:
+    """True when `import horovod_tpu.torch as hvd` would work (torch
+    itself is installed). find_spec only — the probe must not pay the
+    torch import."""
+    return _importable("torch")
+
+
 def _importable(mod: str) -> bool:
     import importlib.util
     try:
@@ -117,6 +124,8 @@ def check_build_summary() -> str:
     lines.append(f"  [{mark(native_controller_built())}] native (C++) "
                  "control-plane core")
     lines.append(f"  [{mark(True)}] python control-plane fallback")
+    lines.append(f"  [{mark(torch_frontend_available())}] torch "
+                 "frontend binding (horovod_tpu.torch)")
     lines.append(f"  [ ] NCCL (never linked — by design)")
     lines.append(f"  [ ] MPI (never linked — by design)")
     lines.append(f"  [ ] Gloo (never linked — by design)")

@@ -1,0 +1,69 @@
+"""Elastic training for the torch frontend: hvd.elastic.TorchState
+(reference: horovod/torch/elastic/state.py — TorchState wrapping a
+torch model + optimizer with commit/restore/sync, used with the
+hvd.elastic.run decorator).
+
+Matches the reference's in-memory commit model: snapshots are
+host-side deepcopies of the state_dicts (torch tensors here are CPU
+already). The run decorator, samplers, and exceptions are the shared
+elastic machinery — one runtime, two frontends.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import torch
+
+from horovod_tpu.elastic import (  # noqa: F401
+    ElasticSampler, HorovodInternalError, HostsUpdatedInterrupt,
+    ObjectState, State, run,
+)
+
+
+class TorchState(ObjectState):
+    """Elastic state for torch training: model + optimizer + arbitrary
+    picklable attributes (reference: hvd.elastic.TorchState).
+
+        state = hvd.elastic.TorchState(model, optimizer, batch=0)
+
+        @hvd.elastic.run
+        def train(state):
+            ...
+            state.commit()
+    """
+
+    def __init__(self, model: torch.nn.Module = None,
+                 optimizer=None, **kwargs):
+        self.model = model
+        self.optimizer = optimizer
+        super().__init__(**kwargs)
+
+    def save(self) -> None:
+        super().save()
+        self._model_saved = (copy.deepcopy(self.model.state_dict())
+                             if self.model is not None else None)
+        self._opt_saved = (copy.deepcopy(self.optimizer.state_dict())
+                           if self.optimizer is not None else None)
+
+    def restore(self) -> None:
+        # load_state_dict copies values in (module) / deepcopies
+        # internally (optimizer) — the snapshot is never aliased into
+        # the live objects, so no defensive copy here.
+        super().restore()
+        if self._model_saved is not None:
+            self.model.load_state_dict(self._model_saved)
+        if self._opt_saved is not None:
+            self.optimizer.load_state_dict(self._opt_saved)
+
+    def sync(self) -> None:
+        """Root's state wins after a membership change — new workers
+        receive the model/optimizer over the in-place broadcast path
+        (root-manifest-driven, so fresh optimizer state on joiners
+        cannot deadlock)."""
+        from . import broadcast_optimizer_state, broadcast_parameters
+        if self.model is not None:
+            broadcast_parameters(self.model.state_dict(), root_rank=0)
+        if self.optimizer is not None:
+            broadcast_optimizer_state(self.optimizer, root_rank=0)
+        super().sync()

@@ -88,6 +88,9 @@ def test_metadata_flags():
     summary = hvd.check_build_summary()
     assert "XLA collectives" in summary
     assert "NCCL (never linked" in summary
+    import importlib.util
+    expect = ("[X]" if importlib.util.find_spec("torch") else "[ ]")
+    assert f"{expect} torch frontend binding" in summary
 
 
 def test_process_set_registration(hvd_single):

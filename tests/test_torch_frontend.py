@@ -3,6 +3,7 @@
 functions.py state_dict helpers). Single-process semantics here; the
 real 2-proc run is TestTorchRealLaunch via the launcher."""
 
+import copy
 import os
 import subprocess
 import sys
@@ -231,6 +232,39 @@ class TestDistributedOptimizer:
         sd = opt.state_dict()
         assert any("exp_avg" in str(k2)
                    for st in sd["state"].values() for k2 in st)
+
+
+class TestTorchElastic:
+    def test_torch_state_commit_restore(self, hvd_init):
+        """hvd.elastic.TorchState commit/restore semantics
+        (reference: horovod/torch/elastic TorchState)."""
+        torch.manual_seed(8)
+        model = torch.nn.Linear(3, 2)
+        opt = torch.optim.Adam(model.parameters(), lr=0.01)
+        state = hvd.elastic.TorchState(model, opt, batch=5)
+        torch.nn.functional.mse_loss(
+            model(torch.randn(4, 3)), torch.randn(4, 2)).backward()
+        opt.step()
+        state.batch = 9
+        state.commit()
+        committed = copy.deepcopy(model.state_dict())
+        # diverge, then roll back
+        with torch.no_grad():
+            model.weight.add_(1.0)
+        state.batch = 11
+        state.restore()
+        for k, v in model.state_dict().items():
+            np.testing.assert_allclose(v.numpy(), committed[k].numpy())
+        assert state.batch == 9   # restored to last commit
+        assert "exp_avg" in str(opt.state_dict()["state"])
+
+    def test_torch_state_sync_single(self, hvd_init):
+        model = torch.nn.Linear(2, 2)
+        state = hvd.elastic.TorchState(
+            model, torch.optim.SGD(model.parameters(), lr=0.1),
+            epoch=3)
+        state.sync()   # world size 1: a no-op broadcast, must not err
+        assert state.epoch == 3
 
 
 @pytest.mark.integration
