@@ -74,6 +74,7 @@ barrier = _C.barrier
 start_timeline = _hvd.start_timeline
 stop_timeline = _hvd.stop_timeline
 from horovod_tpu.torch import elastic  # noqa: E402,F401
+from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm  # noqa: E402,F401
 
 nccl_built = _hvd.nccl_built
 mpi_built = _hvd.mpi_built

@@ -111,6 +111,39 @@ def main():
         np.testing.assert_allclose(gathered[i].numpy(),
                                    gathered[0].numpy())
 
+    # SyncBatchNorm oracle: each rank holds a DIFFERENT shard (uneven
+    # sizes!) of a global batch; sync-BN output + input grad on the
+    # shard must equal vanilla BatchNorm run on the concatenated
+    # batch (reference: test_torch.py's sync BN coverage).
+    torch.manual_seed(7)
+    full = torch.randn(2 * n + n * (n + 1) // 2, 3, 4)
+    shard_sizes = [2 + i + 1 for i in range(n)]
+    off = sum(shard_sizes[:r])
+    mine = full[off:off + shard_sizes[r]].clone().requires_grad_(True)
+    bn = hvd.SyncBatchNorm(3, momentum=0.2)
+    y = bn(mine)
+    y.sum().backward()
+
+    ref = torch.nn.BatchNorm1d(3, momentum=0.2)
+    xref = full.clone().requires_grad_(True)
+    yref = ref(xref)
+    yref.sum().backward()
+    np.testing.assert_allclose(
+        y.detach().numpy(),
+        yref[off:off + shard_sizes[r]].detach().numpy(), atol=1e-5)
+    np.testing.assert_allclose(
+        mine.grad.numpy(),
+        xref.grad[off:off + shard_sizes[r]].numpy(), atol=1e-5)
+    np.testing.assert_allclose(bn.running_mean.numpy(),
+                               ref.running_mean.numpy(), atol=1e-6)
+    np.testing.assert_allclose(bn.running_var.numpy(),
+                               ref.running_var.numpy(), atol=1e-5)
+    # weight grad: LOCAL here; averaged by the optimizer like any
+    # other param grad. Allreduce(Sum) of local == the oracle's.
+    wg = hvd.allreduce(bn.weight.grad, op=hvd.Sum, name="t8")
+    np.testing.assert_allclose(wg.numpy(), ref.weight.grad.numpy(),
+                               atol=1e-4)
+
     hvd.barrier()
     print(f"rank {r}: TORCH FRONTEND ALL OK")
     hvd.shutdown()

@@ -234,6 +234,58 @@ class TestDistributedOptimizer:
                    for st in sd["state"].values() for k2 in st)
 
 
+class TestSyncBatchNorm:
+    def test_size1_matches_vanilla(self, hvd_init):
+        """World size 1: must behave exactly like torch BatchNorm
+        (train and eval, stats tracked)."""
+        torch.manual_seed(9)
+        x = torch.randn(8, 3, 5)
+        bn = hvd.SyncBatchNorm(3, momentum=0.3)
+        ref = torch.nn.BatchNorm1d(3, momentum=0.3)
+        np.testing.assert_allclose(bn(x).detach().numpy(),
+                                   ref(x).detach().numpy(), atol=1e-6)
+        np.testing.assert_allclose(bn.running_var.numpy(),
+                                   ref.running_var.numpy(), atol=1e-6)
+        bn.eval(), ref.eval()
+        np.testing.assert_allclose(bn(x).detach().numpy(),
+                                   ref(x).detach().numpy(), atol=1e-6)
+
+    def test_local_mode_edge_parity(self, hvd_init):
+        """The world-size-1 fallback must match torch BatchNorm on
+        the edges: no running stats in eval, momentum=None cumulative
+        averaging, num_batches_tracked counting."""
+        torch.manual_seed(10)
+        x = torch.randn(6, 3)
+        # track_running_stats=False + eval: batch stats, no crash
+        bn = hvd.SyncBatchNorm(3, track_running_stats=False)
+        ref = torch.nn.BatchNorm1d(3, track_running_stats=False)
+        bn.eval(), ref.eval()
+        np.testing.assert_allclose(bn(x).detach().numpy(),
+                                   ref(x).detach().numpy(), atol=1e-6)
+        # momentum=None: cumulative moving average semantics
+        bn = hvd.SyncBatchNorm(3, momentum=None)
+        ref = torch.nn.BatchNorm1d(3, momentum=None)
+        for _ in range(3):
+            bn(x), ref(x)
+        np.testing.assert_allclose(bn.running_var.numpy(),
+                                   ref.running_var.numpy(), atol=1e-6)
+        assert int(bn.num_batches_tracked) == 3
+        bn.eval(), ref.eval()
+        np.testing.assert_allclose(bn(x).detach().numpy(),
+                                   ref(x).detach().numpy(), atol=1e-6)
+
+    def test_convert_recursive(self, hvd_init):
+        m = torch.nn.Sequential(
+            torch.nn.Conv2d(3, 4, 1), torch.nn.BatchNorm2d(4),
+            torch.nn.Sequential(torch.nn.BatchNorm2d(4)))
+        with torch.no_grad():
+            m[1].running_mean.fill_(0.5)
+        c = hvd.SyncBatchNorm.convert_sync_batchnorm(m)
+        assert isinstance(c[1], hvd.SyncBatchNorm)
+        assert isinstance(c[2][0], hvd.SyncBatchNorm)
+        np.testing.assert_allclose(c[1].running_mean.numpy(), 0.5)
+
+
 class TestTorchElastic:
     def test_torch_state_commit_restore(self, hvd_init):
         """hvd.elastic.TorchState commit/restore semantics
