@@ -23,8 +23,10 @@ from .common.basics import (  # noqa: F401
 from .common import basics as _basics
 from .ops.collective_ops import (  # noqa: F401
     allreduce, allreduce_async, grouped_allreduce, grouped_allreduce_async,
-    allgather, allgather_async, broadcast, broadcast_async,
+    allgather, allgather_async, grouped_allgather, grouped_allgather_async,
+    broadcast, broadcast_async,
     alltoall, alltoall_async, reducescatter, reducescatter_async,
+    grouped_reducescatter, grouped_reducescatter_async,
     barrier, join, synchronize, poll, check_execution_order,
     Average, Sum, Adasum, Min, Max, Product,
 )

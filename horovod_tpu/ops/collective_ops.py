@@ -141,6 +141,102 @@ def grouped_allreduce_async(tensors: List[jax.Array], average=None,
     return h.id
 
 
+class GroupedHandle:
+    """Lazy composite over N async submissions: synchronize returns
+    the list of results in submission order (the grouped-op contract
+    — reference: grouped ops return one handle). Thread-free: the
+    children resolve on the caller's first synchronize, which also
+    DRAINS every child on error so no engine handle leaks; the first
+    child error re-raises (sticky, like the sparse handle)."""
+
+    def __init__(self, name: str, handle_ids: List[int]):
+        self.name = name
+        self._ids = handle_ids
+        self._result = None
+        self._done = False
+        self._error: Optional[BaseException] = None
+
+    def poll(self) -> bool:
+        if self._done or self._error is not None:
+            return True
+        return all(poll(h) for h in self._ids)
+
+    def synchronize(self):
+        if self._done:
+            return self._result
+        if self._error is not None:
+            raise self._error
+        out, err = [], None
+        for h in self._ids:
+            try:
+                out.append(synchronize(h))
+            except BaseException as e:
+                if err is None:
+                    err = e
+                out.append(None)
+        if err is not None:
+            self._error = err
+            raise err
+        self._result = out
+        self._done = True
+        return out
+
+
+def grouped_allgather_async(tensors: Sequence[Any],
+                            name: Optional[str] = None,
+                            process_set: Optional[ProcessSet] = None
+                            ) -> GroupedHandle:
+    """Grouped allgather under one handle (reference:
+    torch/mpi_ops.py grouped_allgather_async). The per-tensor
+    submissions land in the same negotiation cycle and execute as one
+    fused launch per dtype; uneven first dims supported per tensor."""
+    st = _require_init()
+    name = name or st.engine.auto_name("grouped_allgather")
+    hs = [allgather_async(t, name=f"{name}.{i}",
+                          process_set=process_set)
+          for i, t in enumerate(tensors)]
+    return GroupedHandle(name, hs)
+
+
+def grouped_allgather(tensors, name=None, process_set=None
+                      ) -> List[jax.Array]:
+    return synchronize(grouped_allgather_async(
+        tensors, name=name, process_set=process_set))
+
+
+def grouped_reducescatter_async(tensors: Sequence[Any], op=None,
+                                name: Optional[str] = None,
+                                prescale_factor: float = 1.0,
+                                postscale_factor: float = 1.0,
+                                process_set: Optional[ProcessSet] = None
+                                ) -> GroupedHandle:
+    """Grouped reducescatter under one handle (reference:
+    torch/mpi_ops.py grouped_reducescatter_async)."""
+    st = _require_init()
+    # Validate the WHOLE group before submitting anything: a mid-list
+    # raise after partial submission would leak the earlier handles.
+    rop = _resolve_op(op, None)
+    if rop not in (SUM, AVERAGE):
+        raise ValueError("reducescatter supports Sum and Average only")
+    _check_inexact_for_average(rop, [jnp.asarray(t) for t in tensors])
+    name = name or st.engine.auto_name("grouped_reducescatter")
+    hs = [reducescatter_async(t, op=op, name=f"{name}.{i}",
+                              prescale_factor=prescale_factor,
+                              postscale_factor=postscale_factor,
+                              process_set=process_set)
+          for i, t in enumerate(tensors)]
+    return GroupedHandle(name, hs)
+
+
+def grouped_reducescatter(tensors, op=None, name=None,
+                          prescale_factor: float = 1.0,
+                          postscale_factor: float = 1.0,
+                          process_set=None) -> List[jax.Array]:
+    return synchronize(grouped_reducescatter_async(
+        tensors, op=op, name=name, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor, process_set=process_set))
+
+
 def _controller_mixed_group(st, name, wires, pset, rop, prescale,
                             postscale, compression) -> int:
     from .compression import wire_dtype_of

@@ -239,6 +239,33 @@ def grouped_allreduce(tensors, average=None, name=None, op=None,
         process_set=process_set))
 
 
+def grouped_allgather_async(tensors: Sequence[torch.Tensor],
+                            name=None, process_set=None) -> int:
+    h = _C.grouped_allgather_async([_to_jax(t) for t in tensors],
+                                   name=name, process_set=process_set)
+    return _remember(h, ("group", [t.dtype for t in tensors]))
+
+
+def grouped_allgather(tensors, name=None, process_set=None):
+    return synchronize(grouped_allgather_async(
+        tensors, name=name, process_set=process_set))
+
+
+def grouped_reducescatter_async(tensors: Sequence[torch.Tensor],
+                                op=None, name=None,
+                                process_set=None) -> int:
+    h = _C.grouped_reducescatter_async(
+        [_to_jax(t) for t in tensors], op=op, name=name,
+        process_set=process_set)
+    return _remember(h, ("group", [t.dtype for t in tensors]))
+
+
+def grouped_reducescatter(tensors, op=None, name=None,
+                          process_set=None):
+    return synchronize(grouped_reducescatter_async(
+        tensors, op=op, name=name, process_set=process_set))
+
+
 def allgather_async(tensor, name=None, process_set=None) -> int:
     h = _C.allgather_async(_to_jax(tensor), name=name,
                            process_set=process_set)

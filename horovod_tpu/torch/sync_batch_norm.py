@@ -95,9 +95,13 @@ class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
         self._pset = process_set
         # Collective names must MATCH across ranks; id(self) differs
         # per process, so the uid is the construction ordinal (SPMD
-        # programs build their modules in the same order everywhere).
+        # programs build their modules in the same order everywhere,
+        # including an elastic joiner rebuilding the model — which is
+        # also why no step counter appears in the name: a survivor's
+        # counter would have advanced past a fresh joiner's. In-flight
+        # name uniqueness holds anyway because the grouped reduce
+        # blocks until delivery).
         self._bn_uid = f"sync_bn.{next(self._uid_counter)}"
-        self._step = 0
 
     _uid_counter = itertools.count()
 
@@ -119,10 +123,9 @@ class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
             # subtlety (None running stats in eval, momentum=None
             # cumulative averaging, num_batches_tracked) — delegate.
             return super().forward(x)
-        self._step += 1
-        name = f"{self._bn_uid}.{self._step}"
         y, mean, var, count = _SyncBatchNormFn.apply(
-            x, self.weight, self.bias, self.eps, name, self._pset)
+            x, self.weight, self.bias, self.eps, self._bn_uid,
+            self._pset)
         if self.track_running_stats:
             with torch.no_grad():
                 self.num_batches_tracked += 1

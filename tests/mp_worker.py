@@ -113,6 +113,26 @@ def main():
     np.testing.assert_allclose(
         np.asarray(out), np.full((2, 3), sum(range(1, n + 1))))
 
+    # grouped allgather (uneven dims per tensor) + grouped
+    # reducescatter under ONE umbrella handle each (reference:
+    # grouped_allgather / grouped_reducescatter in torch/mpi_ops.py)
+    outs = hvd.grouped_allgather(
+        [jnp.full((r + 1, 2), float(r)), jnp.full((1,), float(r))],
+        name="t6g")
+    np.testing.assert_allclose(
+        np.asarray(outs[0]),
+        np.concatenate([np.full((i + 1, 2), float(i))
+                        for i in range(n)]))
+    np.testing.assert_allclose(np.asarray(outs[1]),
+                               np.arange(float(n)))
+    outs = hvd.grouped_reducescatter(
+        [jnp.ones((2 * n, 3)) * (r + 1), jnp.ones((n,)) * (r + 1)],
+        op=hvd.Sum, name="t6gr")
+    np.testing.assert_allclose(
+        np.asarray(outs[0]), np.full((2, 3), sum(range(1, n + 1))))
+    np.testing.assert_allclose(
+        np.asarray(outs[1]), np.full((1,), sum(range(1, n + 1))))
+
     # sparse allreduce (BCOO): rank-dependent nnz, rank 0 contributes
     # ZERO rows (the empty-contribution edge of the uneven allgather),
     # every other rank touches row 1 (cross-rank duplicate coalescing)

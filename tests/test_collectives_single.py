@@ -95,6 +95,48 @@ def test_reducescatter_single(hvd_single):
     np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
 
 
+def test_grouped_allgather_single(hvd_single):
+    """One handle over N allgathers, results in submission order
+    (reference: grouped_allgather)."""
+    hvd = hvd_single
+    xs = [jnp.ones((2, 3)), jnp.arange(4.0), jnp.ones((1,), jnp.int32)]
+    outs = hvd.grouped_allgather(xs, name="gag")
+    assert isinstance(outs, list) and len(outs) == 3
+    for x, o in zip(xs, outs):
+        np.testing.assert_array_equal(np.asarray(o), np.asarray(x))
+    assert outs[2].dtype == jnp.int32
+
+
+def test_grouped_reducescatter_single(hvd_single):
+    hvd = hvd_single
+    xs = [jnp.ones((4, 2)), jnp.full((2,), 3.0)]
+    outs = hvd.grouped_reducescatter(xs, op=hvd.Sum, name="grs")
+    assert len(outs) == 2
+    np.testing.assert_array_equal(np.asarray(outs[0]),
+                                  np.asarray(xs[0]))
+    np.testing.assert_array_equal(np.asarray(outs[1]),
+                                  np.asarray(xs[1]))
+
+
+def test_grouped_handle_drains_children_on_error(hvd_single):
+    """A failing child must not strand its siblings: the composite
+    synchronize drains every child (releasing engine handles) before
+    re-raising, and the error is sticky."""
+    import pytest
+    from horovod_tpu.ops.collective_ops import GroupedHandle
+    hvd = hvd_single
+    good = hvd.allgather_async(jnp.ones(3), name="drain.good")
+    h = GroupedHandle("drain", [good, 999999999])
+    with pytest.raises(KeyError):
+        h.synchronize()
+    with pytest.raises(KeyError):   # sticky, not a new probe
+        h.synchronize()
+    # the good child was drained: its handle is released, so a direct
+    # synchronize now raises (already collected), not hangs
+    with pytest.raises(KeyError):
+        hvd.synchronize(good)
+
+
 def test_barrier_single(hvd_single):
     hvd_single.barrier()
 

@@ -53,6 +53,15 @@ class TestTensorOps:
         rs = hvd.reducescatter(torch.ones(2, 3), op=hvd.Sum, name="rs")
         assert rs.shape == (2, 3)
 
+    def test_grouped_allgather_and_reducescatter(self, hvd_init):
+        outs = hvd.grouped_allgather(
+            [torch.ones(2, 3), torch.arange(4.0)], name="gag")
+        assert [o.shape for o in outs] == [(2, 3), (4,)]
+        outs = hvd.grouped_reducescatter(
+            [torch.ones(4, 2), torch.full((2,), 3.0)], op=hvd.Sum,
+            name="grs")
+        assert len(outs) == 2 and outs[0].shape == (4, 2)
+
     def test_alltoall_matches_reference_shapes(self, hvd_init):
         out = hvd.alltoall(torch.arange(4.0), name="a2a")
         assert isinstance(out, torch.Tensor)   # splits-less: bare out
