@@ -191,10 +191,14 @@ def grouped_allgather_async(tensors: Sequence[Any],
     submissions land in the same negotiation cycle and execute as one
     fused launch per dtype; uneven first dims supported per tensor."""
     st = _require_init()
+    # Convert the WHOLE list before submitting anything: a conversion
+    # failure mid-list would leak the already-submitted handles (and
+    # hang peers that submitted the full group).
+    ts = [jnp.asarray(t) for t in tensors]
     name = name or st.engine.auto_name("grouped_allgather")
     hs = [allgather_async(t, name=f"{name}.{i}",
                           process_set=process_set)
-          for i, t in enumerate(tensors)]
+          for i, t in enumerate(ts)]
     return GroupedHandle(name, hs)
 
 
@@ -213,18 +217,21 @@ def grouped_reducescatter_async(tensors: Sequence[Any], op=None,
     """Grouped reducescatter under one handle (reference:
     torch/mpi_ops.py grouped_reducescatter_async)."""
     st = _require_init()
-    # Validate the WHOLE group before submitting anything: a mid-list
-    # raise after partial submission would leak the earlier handles.
+    # Convert + validate the WHOLE group before submitting anything:
+    # a mid-list raise after partial submission would leak the
+    # earlier handles. Converted once, submitted as-is (asarray on a
+    # jax.Array is free).
+    ts = [jnp.asarray(t) for t in tensors]
     rop = _resolve_op(op, None)
     if rop not in (SUM, AVERAGE):
         raise ValueError("reducescatter supports Sum and Average only")
-    _check_inexact_for_average(rop, [jnp.asarray(t) for t in tensors])
+    _check_inexact_for_average(rop, ts)
     name = name or st.engine.auto_name("grouped_reducescatter")
     hs = [reducescatter_async(t, op=op, name=f"{name}.{i}",
                               prescale_factor=prescale_factor,
                               postscale_factor=postscale_factor,
                               process_set=process_set)
-          for i, t in enumerate(tensors)]
+          for i, t in enumerate(ts)]
     return GroupedHandle(name, hs)
 
 

@@ -59,8 +59,16 @@ class TestTensorOps:
         assert [o.shape for o in outs] == [(2, 3), (4,)]
         outs = hvd.grouped_reducescatter(
             [torch.ones(4, 2), torch.full((2,), 3.0)], op=hvd.Sum,
-            name="grs")
+            name="grs", prescale_factor=2.0)
         assert len(outs) == 2 and outs[0].shape == (4, 2)
+        np.testing.assert_allclose(outs[1].numpy(), 6.0)
+        # double-synchronize on a composite handle must keep
+        # returning TORCH tensors (the meta rides the handle object)
+        h = hvd.grouped_allgather_async([torch.ones(2)], name="gag2")
+        first = hvd.synchronize(h)
+        again = hvd.synchronize(h)
+        assert isinstance(again[0], torch.Tensor)
+        np.testing.assert_allclose(again[0].numpy(), first[0].numpy())
 
     def test_alltoall_matches_reference_shapes(self, hvd_init):
         out = hvd.alltoall(torch.arange(4.0), name="a2a")
