@@ -44,7 +44,10 @@ def init(*args, **kwargs):
     # Engine handle ids restart from 1 on re-init; stale metadata from
     # an abandoned handle of a previous session must never resolve
     # against a reused id (it would silently write into a dead
-    # tensor). Cleared on both ends for safety.
+    # tensor). Cleared on both ends for safety; composite handles
+    # carry a session epoch instead (their meta rides the object).
+    global _session_epoch
+    _session_epoch += 1
     _handle_meta.clear()
     return _hvd.init(*args, **kwargs)
 
@@ -140,11 +143,13 @@ def _to_jax(t: torch.Tensor):
 
 def _to_torch(a, torch_dtype: torch.dtype) -> torch.Tensor:
     if _dlpack_usable():
-        # clone() breaks aliasing: XLA may alias an output buffer to
-        # an input (e.g. identity lowering at world size 1), and a
-        # user mutating the returned tensor must never corrupt it.
+        # copy=True breaks aliasing in ONE memcpy even when a dtype
+        # conversion is also needed (e.g. 32->64 bit): XLA may alias
+        # an output buffer to an input (identity lowering at world
+        # size 1), and a user mutating the returned tensor must never
+        # corrupt it.
         try:
-            return torch.from_dlpack(a).clone().to(torch_dtype)
+            return torch.from_dlpack(a).to(torch_dtype, copy=True)
         except Exception:
             pass
     if a.dtype == jnp.bfloat16:
@@ -162,6 +167,7 @@ def _to_torch(a, torch_dtype: torch.dtype) -> torch.Tensor:
 # carry their meta as an attribute — they cache their result and may
 # synchronize more than once, so the meta must survive the first call.
 _handle_meta: Dict[int, Any] = {}
+_session_epoch = 0
 
 
 def _remember(handle, meta):
@@ -169,6 +175,7 @@ def _remember(handle, meta):
         _handle_meta[handle] = meta
     else:
         handle._torch_meta = meta
+        handle._torch_epoch = _session_epoch
     return handle
 
 
@@ -179,6 +186,15 @@ def synchronize(handle):
         meta = _handle_meta.pop(handle, None)
     else:
         meta = getattr(handle, "_torch_meta", None)
+        if (meta is not None
+                and getattr(handle, "_torch_epoch", None)
+                != _session_epoch):
+            # A composite handle from a previous init/shutdown
+            # session: its child ids would resolve against the NEW
+            # engine's recycled ids — refuse loudly.
+            raise RuntimeError(
+                "handle was created in a previous hvd.init() session "
+                "and cannot be synchronized after re-init")
     out = _C.synchronize(handle)
     if meta is None:
         return out
@@ -521,7 +537,13 @@ class DistributedOptimizer:
 
     The async submissions enter the negotiated engine as soon as each
     gradient materializes, so negotiation/fusion overlaps the rest of
-    backward exactly like the reference's background thread."""
+    backward exactly like the reference's background thread.
+
+    The reference's `num_groups`/`groups` knobs are intentionally
+    absent: they exist to batch per-parameter submissions into grouped
+    allreduces, which the fusion engine already does to the hook storm
+    (same-wire-dtype entries agreed in one cycle execute as one
+    launch; raise HOROVOD_BATCH_QUIESCENCE to widen the cut)."""
 
     def __init__(self, optimizer: torch.optim.Optimizer,
                  named_parameters=None,

@@ -148,6 +148,12 @@ def main():
     # non-allreduce responses too). exec_counts tracks
     # [batches, entries] per kind on the dispatch worker.
     ctl = st.engine.controller
+    # Hold batch cuts until the ready set is stable for 3 cycles:
+    # these phases assert FUSION, and on a loaded 1-core host an
+    # unheld coordinator legitimately cuts single-entry batches
+    # between slow submissions (observed flake). Restored to 0 after.
+    ctl.core.set_quiescence(max(3, getattr(ctl.cfg,
+                                           "batch_quiescence", 0)))
     bc0 = list(ctl.exec_counts.get("bc", [0, 0]))
     hs = [hvd.broadcast_async(
             jnp.full((4,), float(i) if r == 0 else -1.0),
@@ -221,6 +227,9 @@ def main():
         f"{rs_entries} entries")
     print(f"rank {r}: reducescatter fusion OK "
           f"({rs_entries} entries in {rs_batches} batch(es))")
+    # restore the CONFIGURED value, not a hardcoded 0 — the process
+    # may have been launched with HOROVOD_BATCH_QUIESCENCE set.
+    ctl.core.set_quiescence(getattr(ctl.cfg, "batch_quiescence", 0))
 
     # 4) join: rank 1 joins immediately; rank 0 keeps reducing, then
     # proves a generic op agreed while a rank has joined gets a CLEAN

@@ -107,6 +107,21 @@ class TestTensorOps:
         finally:
             hvd.shutdown()
 
+    def test_composite_handle_rejected_across_reinit(self):
+        """A grouped handle held across shutdown+init must refuse to
+        synchronize (its child ids would resolve against the new
+        engine's recycled ids)."""
+        hvd.init()
+        h = hvd.grouped_allgather_async([torch.ones(2)], name="xsess")
+        hvd.synchronize(h)
+        hvd.shutdown()
+        hvd.init()
+        try:
+            with pytest.raises(RuntimeError, match="previous"):
+                hvd.synchronize(h)
+        finally:
+            hvd.shutdown()
+
     def test_async_handle_protocol(self, hvd_init):
         h = hvd.allreduce_async(torch.ones(4), name="h0")
         out = hvd.synchronize(h)
