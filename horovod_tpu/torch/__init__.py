@@ -44,11 +44,13 @@ def init(*args, **kwargs):
     # Engine handle ids restart from 1 on re-init; stale metadata from
     # an abandoned handle of a previous session must never resolve
     # against a reused id (it would silently write into a dead
-    # tensor). Cleared on both ends for safety; composite handles
-    # carry a session epoch instead (their meta rides the object).
-    global _session_epoch
-    _session_epoch += 1
-    _handle_meta.clear()
+    # tensor). Every remembered meta carries a weakref to its engine
+    # (checked in synchronize/poll — this also covers elastic resets,
+    # which re-init through common.basics and never pass here); the
+    # dict clear below just prevents leak accumulation, and only when
+    # the session actually changes (init is idempotent).
+    if not _hvd.is_initialized():
+        _handle_meta.clear()
     return _hvd.init(*args, **kwargs)
 
 
@@ -167,15 +169,36 @@ def _to_torch(a, torch_dtype: torch.dtype) -> torch.Tensor:
 # carry their meta as an attribute — they cache their result and may
 # synchronize more than once, so the meta must survive the first call.
 _handle_meta: Dict[int, Any] = {}
-_session_epoch = 0
+
+
+def _engine_ref():
+    import weakref
+    from horovod_tpu.common.basics import state
+    return weakref.ref(state().engine)
+
+
+def _session_changed(ref) -> bool:
+    try:
+        from horovod_tpu.common.basics import state
+        return ref() is not state().engine
+    except Exception:
+        return True
+
+
+def _raise_stale():
+    raise RuntimeError(
+        "handle was created in a previous hvd session (init/shutdown "
+        "or an elastic reset re-created the engine); its ids would "
+        "resolve against recycled handles — resubmit the op")
 
 
 def _remember(handle, meta):
+    ref = _engine_ref()
     if isinstance(handle, int):
-        _handle_meta[handle] = meta
+        _handle_meta[handle] = (ref, meta)
     else:
         handle._torch_meta = meta
-        handle._torch_epoch = _session_epoch
+        handle._torch_engine = ref
     return handle
 
 
@@ -183,18 +206,16 @@ def synchronize(handle):
     """Block until the op completes; returns torch output(s)
     (reference: mpi_ops.synchronize)."""
     if isinstance(handle, int):
-        meta = _handle_meta.pop(handle, None)
+        ent = _handle_meta.pop(handle, None)
+        meta = None
+        if ent is not None:
+            ref, meta = ent
+            if _session_changed(ref):
+                _raise_stale()
     else:
         meta = getattr(handle, "_torch_meta", None)
-        if (meta is not None
-                and getattr(handle, "_torch_epoch", None)
-                != _session_epoch):
-            # A composite handle from a previous init/shutdown
-            # session: its child ids would resolve against the NEW
-            # engine's recycled ids — refuse loudly.
-            raise RuntimeError(
-                "handle was created in a previous hvd.init() session "
-                "and cannot be synchronized after re-init")
+        if meta is not None and _session_changed(handle._torch_engine):
+            _raise_stale()
     out = _C.synchronize(handle)
     if meta is None:
         return out
@@ -229,7 +250,15 @@ def synchronize(handle):
     raise AssertionError(kind)
 
 
-def poll(handle: int) -> bool:
+def poll(handle) -> bool:
+    if isinstance(handle, int):
+        ent = _handle_meta.get(handle)
+        if ent is not None and _session_changed(ent[0]):
+            _raise_stale()
+    else:
+        ref = getattr(handle, "_torch_engine", None)
+        if ref is not None and _session_changed(ref):
+            _raise_stale()
     return _C.poll(handle)
 
 

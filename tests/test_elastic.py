@@ -39,14 +39,14 @@ def read_logs(tmp_path):
     return lines
 
 
-def launch(script, env, extra=()):
+def launch(script, env, extra=(), worker="elastic_worker.py"):
     return subprocess.Popen(
         [sys.executable, "-m", "horovod_tpu.runner",
          "--host-discovery-script", str(script),
          "--min-num-proc", "1",
          "--host-change-detection-interval", "0.5",
          *extra,
-         sys.executable, os.path.join("tests", "elastic_worker.py")],
+         sys.executable, os.path.join("tests", worker)],
         cwd=REPO, env=env, stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT, text=True)
 
@@ -123,6 +123,40 @@ class TestElastic:
         # committed steps never regress below the resize point: the
         # max step logged in world 2 must be <= min step logged by the
         # new world's rank 0 continuation + 1
+        w2 = [int(ln.split()[1]) for ln in lines
+              if ln.startswith("step") and "world 2" in ln]
+        w3 = [int(ln.split()[1]) for ln in lines
+              if ln.startswith("step") and "world 3" in ln]
+        assert w2 and w3 and min(w3) >= max(w2) - 1, (max(w2), min(w3))
+
+    def test_torch_frontend_elastic_scale_up(self, tmp_path):
+        """The torch frontend rides the same elastic machinery:
+        TorchState + hook optimizer survive a mid-run scale-up with
+        committed progress intact and identical final weights."""
+        hosts_file = tmp_path / "hosts.txt"
+        hosts_file.write_text("localhost:2\n")
+        script = write_discovery(tmp_path, f"cat {hosts_file}")
+        env = make_env(tmp_path, steps=24, sleep=0.25)
+        p = launch(script, env, worker="elastic_worker_torch.py")
+        try:
+            deadline = time.time() + 240
+            while time.time() < deadline:
+                if any("world 2" in ln for ln in read_logs(tmp_path)):
+                    break
+                if p.poll() is not None:
+                    break
+                time.sleep(0.5)
+            hosts_file.write_text("localhost:3\n")
+            out, _ = p.communicate(timeout=300)
+        finally:
+            if p.poll() is None:
+                p.kill()
+                out = p.communicate()[0]
+        assert p.returncode == 0, out
+        lines = read_logs(tmp_path)
+        assert any("world 2" in ln for ln in lines), (lines, out)
+        assert any("world 3" in ln for ln in lines), (lines, out)
+        assert sum("done" in ln for ln in lines) == 3, lines
         w2 = [int(ln.split()[1]) for ln in lines
               if ln.startswith("step") and "world 2" in ln]
         w3 = [int(ln.split()[1]) for ln in lines
