@@ -206,12 +206,15 @@ def synchronize(handle):
     """Block until the op completes; returns torch output(s)
     (reference: mpi_ops.synchronize)."""
     if isinstance(handle, int):
-        ent = _handle_meta.pop(handle, None)
+        ent = _handle_meta.get(handle)
         meta = None
         if ent is not None:
             ref, meta = ent
             if _session_changed(ref):
+                # keep the entry: the guard must keep firing on retry,
+                # not fall through to the new engine's recycled ids.
                 _raise_stale()
+            _handle_meta.pop(handle, None)
     else:
         meta = getattr(handle, "_torch_meta", None)
         if meta is not None and _session_changed(handle._torch_engine):

@@ -111,6 +111,27 @@ def main():
         np.testing.assert_allclose(gathered[i].numpy(),
                                    gathered[0].numpy())
 
+    # dtype x op matrix through the bridge (reference analog:
+    # test_torch.py's exhaustive dtype/op coverage under -np 2).
+    vals = [i + 2 for i in range(n)]
+    for dt in [torch.float32, torch.float16, torch.bfloat16,
+               torch.int32, torch.uint8]:
+        is_float = dt.is_floating_point
+        ops = [(hvd.Sum, float(sum(vals))),
+               (hvd.Min, float(min(vals))),
+               (hvd.Max, float(max(vals))),
+               (hvd.Product, float(np.prod(vals)))]
+        if is_float:
+            ops.append((hvd.Average, sum(vals) / n))
+        for op_, want in ops:
+            x = torch.full((4, 3), r + 2).to(dt)
+            out = hvd.allreduce(x, op=op_, name=f"mx.{dt}.{op_}")
+            assert out.dtype == dt, (out.dtype, dt)
+            tol = 5e-2 if dt in (torch.bfloat16, torch.float16) else 1e-6
+            np.testing.assert_allclose(
+                out.to(torch.float64).numpy(), np.full((4, 3), want),
+                rtol=tol)
+
     # SyncBatchNorm oracle: each rank holds a DIFFERENT shard (uneven
     # sizes!) of a global batch; sync-BN output + input grad on the
     # shard must equal vanilla BatchNorm run on the concatenated
