@@ -53,6 +53,7 @@ from .optim.functions import (  # noqa: F401
 )
 from . import elastic  # noqa: F401
 from . import callbacks  # noqa: F401
+from . import flax  # noqa: F401
 from .sync_batch_norm import SyncBatchNorm, to_sync_batch_norm  # noqa: F401
 
 __version__ = "0.1.0"

@@ -113,6 +113,24 @@ def main():
     np.testing.assert_allclose(
         np.asarray(out), np.full((2, 3), sum(range(1, n + 1))))
 
+    # hvd.flax.DistributedTrainState: rank-DIFFERENT init must equal
+    # rank 0's after create (broadcast), and a step on rank-different
+    # grads must keep params identical (averaged reduction).
+    import optax
+    st_flax = hvd.flax.DistributedTrainState.create(
+        apply_fn=lambda v, x: x,
+        params={"w": jnp.full((3,), float(r + 1))}, tx=optax.sgd(1.0))
+    np.testing.assert_allclose(np.asarray(st_flax.params["w"]), 1.0)
+    st_flax = st_flax.apply_gradients(
+        grads={"w": jnp.full((3,), float(r))})
+    want_w = 1.0 - sum(range(n)) / n
+    np.testing.assert_allclose(np.asarray(st_flax.params["w"]),
+                               want_w, rtol=1e-6)
+    stats = hvd.flax.sync_batch_stats(
+        {"m": jnp.full((2,), float(r))})
+    np.testing.assert_allclose(np.asarray(stats["m"]),
+                               sum(range(n)) / n)
+
     # grouped allgather (uneven dims per tensor) + grouped
     # reducescatter under ONE umbrella handle each (reference:
     # grouped_allgather / grouped_reducescatter in torch/mpi_ops.py)
