@@ -27,6 +27,8 @@ import horovod_tpu as _hvd
 from horovod_tpu.optim.distributed_optimizer import (
     DistributedGradientTransformation,
 )
+from horovod_tpu.ops.compression import NoneCompressor
+from horovod_tpu.ops.dispatch import AVERAGE
 
 
 class DistributedTrainState(train_state.TrainState):
@@ -45,10 +47,10 @@ class DistributedTrainState(train_state.TrainState):
 
     @classmethod
     def create(cls, *, apply_fn, params, tx,
-               root_rank: int = 0,
+               root_rank: Optional[int] = None,
                broadcast: bool = True,
-               op: Optional[int] = None,
-               compression=None,
+               op: int = AVERAGE,
+               compression=NoneCompressor,
                axis_name: Optional[str] = None,
                backward_passes_per_step: int = 1,
                process_set=None,
@@ -56,13 +58,21 @@ class DistributedTrainState(train_state.TrainState):
                sparse_as_dense: bool = False,
                size_hint: Optional[int] = None,
                **kwargs) -> "DistributedTrainState":
-        from horovod_tpu.ops.compression import NoneCompressor
-        from horovod_tpu.ops.dispatch import AVERAGE
+        if root_rank is None:
+            # default to the SET's first member, not global rank 0
+            # (which may not belong to a subset process_set)
+            root_rank = (process_set.ranks[0]
+                         if process_set is not None else 0)
+        elif process_set is not None and \
+                root_rank not in process_set.ranks:
+            raise ValueError(
+                f"root_rank={root_rank} is not a member of "
+                f"{process_set}; pass one of its ranks (default: its "
+                "first member)")
         tx = DistributedGradientTransformation(
             tx,
-            op=AVERAGE if op is None else op,
-            compression=(NoneCompressor if compression is None
-                         else compression),
+            op=op,
+            compression=compression,
             axis_name=axis_name,
             backward_passes_per_step=backward_passes_per_step,
             process_set=process_set,

@@ -11,14 +11,7 @@ import pytest
 import horovod_tpu as hvd
 
 
-@pytest.fixture()
-def hvd_init():
-    hvd.init()
-    yield
-    hvd.shutdown()
-
-
-def test_train_state_converges_eager(hvd_init):
+def test_train_state_converges_eager(hvd_single):
     """The 5-line flax experience trains a linear model to the exact
     solution through the distributed transformation."""
     key = jax.random.PRNGKey(0)
@@ -43,7 +36,7 @@ def test_train_state_converges_eager(hvd_init):
     assert float(loss_fn(state.params)) < 1e-6
 
 
-def test_train_state_forwards_knobs(hvd_init):
+def test_train_state_forwards_knobs(hvd_single):
     state = hvd.flax.DistributedTrainState.create(
         apply_fn=lambda v, x: x, params={"w": jnp.ones((2,))},
         tx=optax.sgd(1.0), compression=hvd.Compression.bf16,
@@ -57,7 +50,7 @@ def test_train_state_forwards_knobs(hvd_init):
                                rtol=1e-2)  # bf16 wire
 
 
-def test_sync_batch_stats_identity_at_size1(hvd_init):
+def test_sync_batch_stats_identity_at_size1(hvd_single):
     stats = {"bn": {"mean": jnp.arange(3.0), "var": jnp.ones(3)}}
     out = hvd.flax.sync_batch_stats(stats)
     np.testing.assert_allclose(np.asarray(out["bn"]["mean"]),
