@@ -37,6 +37,13 @@ def check_build(verbose: bool = False) -> str:
         f"    {_mark(True)} JAX coordination service "
         "(rendezvous/KV/heartbeat)",
     ]
+    lines += [
+        "",
+        "Frontends:",
+        "    [X] JAX/optax (hvd.DistributedOptimizer, hvd.flax)",
+        f"    {_mark(metadata.torch_frontend_available())} torch "
+        "binding (import horovod_tpu.torch as hvd)",
+    ]
     try:
         devs = jax.devices()
         plat = devs[0].platform
