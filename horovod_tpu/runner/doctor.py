@@ -40,7 +40,8 @@ def check_build(verbose: bool = False) -> str:
     lines += [
         "",
         "Frontends:",
-        "    [X] JAX/optax (hvd.DistributedOptimizer, hvd.flax)",
+        f"    {_mark(True)} JAX/optax (hvd.DistributedOptimizer, "
+        "hvd.flax)",
         f"    {_mark(metadata.torch_frontend_available())} torch "
         "binding (import horovod_tpu.torch as hvd)",
     ]
