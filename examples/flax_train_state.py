@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""The flax-idiom 5-line experience (reference analog:
+examples/keras/keras_mnist.py — the framework-native sugar path):
+`hvd.flax.DistributedTrainState.create` wraps the optax
+transformation with cross-worker reduction AND broadcasts
+params/opt_state from the root in one call.
+
+  python examples/flax_train_state.py --epochs 3
+  python -m horovod_tpu.runner -np 2 python examples/flax_train_state.py
+"""
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+
+
+class MLP(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Dense(128)(x.reshape((x.shape[0], -1))))
+        return nn.Dense(10)(x)
+
+
+def synthetic_mnist(n, seed):
+    rng = np.random.default_rng(seed)
+    proto = rng.normal(size=(10, 784)).astype(np.float32)
+    labels = rng.integers(0, 10, size=n)
+    imgs = proto[labels] + 0.3 * rng.normal(size=(n, 784)
+                                            ).astype(np.float32)
+    return jnp.asarray(imgs), jnp.asarray(labels)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    args = ap.parse_args()
+
+    hvd.init()
+    model = MLP()
+    params = model.init(jax.random.PRNGKey(hvd.rank()),  # rank-seeded
+                        jnp.zeros((1, 784)))["params"]   # on purpose:
+    # create() broadcasts from rank 0, so the rank-different init
+    # above is erased — the one-call version of the reference's
+    # BroadcastGlobalVariablesCallback.
+    state = hvd.flax.DistributedTrainState.create(
+        apply_fn=model.apply, params=params,
+        tx=optax.adam(args.lr * hvd.size()),
+        compression=hvd.Compression.bf16)
+
+    X, Y = synthetic_mnist(4096, seed=0)
+    X = X[hvd.rank()::hvd.size()]
+    Y = Y[hvd.rank()::hvd.size()]
+
+    def loss_fn(params, xb, yb):
+        logits = state.apply_fn({"params": params}, xb)
+        onehot = jax.nn.one_hot(yb, 10)
+        return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits),
+                                 axis=-1))
+
+    grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+    rng = np.random.default_rng(1 + hvd.rank())
+    for epoch in range(args.epochs):
+        perm = rng.permutation(len(X))
+        correct = total = 0
+        for i in range(0, len(X), args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            xb, yb = X[idx], Y[idx]
+            loss, grads = grad_fn(state.params, xb, yb)
+            state = state.apply_gradients(grads=grads)
+            pred = state.apply_fn({"params": state.params}, xb
+                                  ).argmax(-1)
+            correct += int((pred == yb).sum())
+            total += len(yb)
+        acc = hvd.allreduce(jnp.asarray([correct / total]),
+                            name=f"acc.{epoch}")
+        if hvd.rank() == 0:
+            print(f"epoch {epoch}: train accuracy {float(acc[0]):.4f}")
+    if hvd.rank() == 0:
+        print(f"final train accuracy: {float(acc[0]):.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

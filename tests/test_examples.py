@@ -40,6 +40,17 @@ class TestExamples:
         assert r.returncode == 0, r.stdout + r.stderr
         assert "epoch 0" in r.stdout
 
+    def test_flax_train_state_two_proc(self):
+        """The flax-idiom sugar path (DistributedTrainState.create)
+        trains to accuracy at 2 ranks with rank-different init erased
+        by the built-in broadcast."""
+        r = run_example("flax_train_state.py", ["--epochs", "2"],
+                        np_=2)
+        assert r.returncode == 0, r.stdout + r.stderr
+        acc = float(r.stdout.split("final train accuracy:")[1]
+                    .strip().split()[0])
+        assert acc > 0.9, r.stdout
+
     def test_torch_mnist_two_proc(self):
         """The reference's canonical torch script, one changed import
         (the torch frontend binding), trains to accuracy at 2 ranks."""
