@@ -110,6 +110,37 @@ def aot_compile(step_fn, *args):
     return compiled, flops
 
 
+def _metrics_snapshot():
+    """Compact hvd.metrics() digest for the JSON artifact: counters
+    and gauges summed across label sets, histograms as count/sum —
+    so a round's recorded benchmark carries the runtime's own
+    accounting (bytes moved, batches fused, programs compiled,
+    stalls) alongside the headline rate."""
+    try:
+        snap = hvd.metrics()
+    except Exception as e:  # pragma: no cover - defensive
+        log(f"bench: metrics snapshot unavailable ({e})")
+        return {}
+    out = {}
+    for name, series in snap.items():
+        total, count, hsum = 0.0, 0, 0.0
+        is_hist = False
+        for v in series.values():
+            if isinstance(v, dict):
+                is_hist = True
+                count += v["count"]
+                hsum += v["sum"]
+            else:
+                total += v
+        if is_hist:
+            if count:
+                out[name + "_count"] = count
+                out[name + "_sum"] = round(hsum, 6)
+        elif total:
+            out[name] = round(total, 6)
+    return out
+
+
 def _make_reduced_resnet(stages: str):
     """Reduced-depth ResNet for multi-process CPU runs (8 procs
     compiling full ResNet-50 on shared cores takes tens of minutes;
@@ -475,6 +506,7 @@ def eager_main(model_name: str = "resnet50"):
         "value": round(rate, 2),
         "unit": unit,
         "vs_baseline": round(vs, 4),
+        "metrics": _metrics_snapshot(),
     }), flush=True)
 
 
@@ -577,6 +609,7 @@ def transformer_main():
         "value": round(tok_sec_chip, 2),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(vs, 4),
+        "metrics": _metrics_snapshot(),
     }), flush=True)
 
 
@@ -751,6 +784,7 @@ def main(model_name: str = "resnet50"):
         "value": round(img_sec_chip, 2),
         "unit": "img/sec/chip",
         "vs_baseline": round(vs, 4),
+        "metrics": _metrics_snapshot(),
     }), flush=True)
 
 

@@ -24,6 +24,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import jax
 import jax.numpy as jnp
+from horovod_tpu.common.compat import shard_map
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -75,7 +76,7 @@ def main():
         # load-balance losses so the scalar is truly replicated
         return out, jax.lax.pmean(aux, "expert")
 
-    step = jax.jit(jax.shard_map(
+    step = jax.jit(shard_map(
         fwd, mesh=mesh,
         in_specs=(P("expert"), P(), P("expert"), P("expert")),
         out_specs=(P("expert"), P())))

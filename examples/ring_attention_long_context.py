@@ -25,6 +25,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import jax
 import jax.numpy as jnp
+from horovod_tpu.common.compat import shard_map
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -60,7 +61,7 @@ def main():
     seq_sh = NamedSharding(mesh, P(None, "seq"))
     q, k, v = (jax.device_put(t, seq_sh) for t in (q, k, v))
 
-    ring = jax.jit(jax.shard_map(
+    ring = jax.jit(shard_map(
         lambda q, k, v: ring_attention(q, k, v, axis_name="seq"),
         mesh=mesh,
         in_specs=(P(None, "seq"), P(None, "seq"), P(None, "seq")),

@@ -55,8 +55,29 @@ from . import elastic  # noqa: F401
 from . import callbacks  # noqa: F401
 from . import flax  # noqa: F401
 from .sync_batch_norm import SyncBatchNorm, to_sync_batch_norm  # noqa: F401
+from . import metrics as _metrics_module
 
 __version__ = "0.1.0"
+
+
+def metrics() -> dict:
+    """Snapshot of the process-wide runtime metrics registry.
+
+    Returns ``{metric_name: {label_values_tuple: value}}``: counters
+    and gauges map to floats (the unlabeled series key is ``()``),
+    histograms to ``{"count", "sum", "buckets"}`` dicts with
+    cumulative ``(le, count)`` bucket pairs. The same numbers are
+    served in Prometheus text form on ``HOROVOD_METRICS_PORT``'s
+    ``/metrics`` endpoint; see ``horovod_tpu/metrics.py``. Works
+    before/without init (the registry is process-wide), so a metric
+    only appears once the subsystem owning it has run.
+
+    NOTE: ``hvd.metrics()`` (this function) shadows the
+    ``horovod_tpu.metrics`` submodule attribute on the package —
+    import the module explicitly (``from horovod_tpu.metrics import
+    REGISTRY``) to reach the registry classes.
+    """
+    return _metrics_module.snapshot()
 
 
 def add_process_set(ranks) -> ProcessSet:

@@ -27,6 +27,8 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from .metrics import REGISTRY as _METRICS
+
 _MB = 1024 * 1024
 
 FUSION_GRID = [0, 1 * _MB, 2 * _MB, 4 * _MB, 8 * _MB, 16 * _MB,
@@ -146,6 +148,22 @@ class Autotuner:
         if self.mode == "gp":
             self._gp_pts, self._gp_pairs = _gp_candidates()
             self._gp = GaussianProcessSearch(self._gp_pts)
+        # Current knob positions as gauges, so a dashboard shows WHERE
+        # the tuner sits without parsing the CSV log (reference: the
+        # ParameterManager's readiness logging, made scrapeable).
+        self._g_fusion = _METRICS.gauge(
+            "hvd_autotune_fusion_threshold_bytes",
+            "Autotuner's current fusion-threshold knob value.")
+        self._g_cycle = _METRICS.gauge(
+            "hvd_autotune_cycle_time_ms",
+            "Autotuner's current negotiation-cycle-time knob value.")
+        self._g_quiesce = _METRICS.gauge(
+            "hvd_autotune_quiescence_cycles",
+            "Autotuner's current batch-quiescence knob value.")
+        self._g_score = _METRICS.gauge(
+            "hvd_autotune_best_score_bytes_per_second",
+            "Best bytes-reduced/sec score the autotuner has observed.")
+        self._publish_gauges()
         if self.log_path:
             with open(self.log_path, "w") as f:
                 f.write("fusion_threshold,cycle_time_ms,quiescence,"
@@ -196,6 +214,13 @@ class Autotuner:
             self._step_gp()
         else:
             self._step_knob()
+        self._publish_gauges()
+
+    def _publish_gauges(self) -> None:
+        self._g_fusion.set(self.fusion_threshold)
+        self._g_cycle.set(self.cycle_time_ms)
+        self._g_quiesce.set(self.quiescence)
+        self._g_score.set(max(self._best_score, 0.0))
 
     def _step_knob(self) -> None:
         if self._knob == 0:

@@ -37,6 +37,8 @@ class HorovodTpuState:
         self.engine = None              # eager fusion engine (ops.engine)
         self.timeline = None            # timeline.Timeline when enabled
         self.autotuner = None
+        self.metrics_server = None      # metrics.MetricsServer when enabled
+        self.metrics_summary = None     # metrics.SummaryLogger (rank 0)
         self.elastic_enabled = False
         self._lock = threading.Lock()
         self._owns_distributed = False
@@ -56,14 +58,21 @@ def _ensure_distributed(cfg: Config) -> bool:
         # (60 under the elastic launcher, jax's 300 otherwise).
         shutdown_timeout = int(cfg.shutdown_barrier_timeout) or (
             60 if os.environ.get("HOROVOD_ELASTIC") else 300)
+        kwargs = dict(
+            coordinator_address=cfg.coordinator_addr,
+            num_processes=cfg.size,
+            process_id=max(cfg.rank, 0),
+            initialization_timeout=int(max(cfg.start_timeout, 1)),
+            shutdown_timeout_seconds=shutdown_timeout,
+        )
+        # Older jax lacks the shutdown-barrier knob; dropping it only
+        # loses the tuned barrier timeout, not correctness.
+        import inspect
+        if "shutdown_timeout_seconds" not in inspect.signature(
+                jax.distributed.initialize).parameters:
+            kwargs.pop("shutdown_timeout_seconds")
         try:
-            jax.distributed.initialize(
-                coordinator_address=cfg.coordinator_addr,
-                num_processes=cfg.size,
-                process_id=max(cfg.rank, 0),
-                initialization_timeout=int(max(cfg.start_timeout, 1)),
-                shutdown_timeout_seconds=shutdown_timeout,
-            )
+            jax.distributed.initialize(**kwargs)
         except Exception:
             # A FAILED initialize can leave jax's global distributed
             # state partially set (service bound, client half
@@ -92,7 +101,8 @@ def init(config_overrides: Optional[Dict[str, Any]] = None,
             return
         cfg = Config(config_overrides)
         _state.config = cfg
-        hlog.configure(cfg.log_level, cfg.log_timestamp)
+        hlog.configure(cfg.log_level, cfg.log_timestamp,
+                       cfg.log_rank0_only)
         # Fail fast on bad knob values BEFORE any threads/sockets/
         # backends exist — a raise later would leak a live engine
         # because shutdown() early-returns while !initialized.
@@ -167,6 +177,35 @@ def init(config_overrides: Optional[Dict[str, Any]] = None,
             _state.autotuner = Autotuner(cfg)
             _state.engine.attach_autotuner(_state.autotuner)
 
+        # Metrics: the registry is always on (every subsystem above
+        # already instruments against it); the scrape endpoint and the
+        # rank-0 summary heartbeat are opt-in.
+        from ..metrics import REGISTRY as _registry
+        from ..metrics import MetricsServer, SummaryLogger
+        _registry.gauge("hvd_rank",
+                        "This process's world rank.").set(
+            _state.topology.rank)
+        _registry.gauge("hvd_world_size",
+                        "Number of processes in the world.").set(
+            _state.topology.size)
+        if cfg.metrics_port:
+            port = int(cfg.metrics_port) + max(
+                _state.topology.local_rank, 0)
+            try:
+                _state.metrics_server = MetricsServer(port)
+                hlog.info("metrics: serving Prometheus text on "
+                          ":%d/metrics", _state.metrics_server.port)
+            except (OSError, OverflowError) as e:
+                # Observability must never kill training: warn and run
+                # registry-only. OverflowError covers an out-of-range
+                # port (e.g. base + local_rank past 65535) — the bind
+                # raises it instead of OSError.
+                hlog.warning("metrics: could not bind port %d (%s); "
+                             "scrape endpoint disabled", port, e)
+        if cfg.metrics_summary_seconds > 0 and _state.topology.rank == 0:
+            _state.metrics_summary = SummaryLogger(
+                cfg.metrics_summary_seconds)
+
         # Hierarchical allreduce (reference: HOROVOD_HIERARCHICAL_
         # ALLREDUCE / NCCLHierarchicalAllreduce): factor the process
         # axis as (slice over DCN) x (chip-within-slice over ICI)
@@ -196,6 +235,12 @@ def shutdown() -> None:
         if _state.timeline is not None:
             _state.timeline.close()
             _state.timeline = None
+        if _state.metrics_summary is not None:
+            _state.metrics_summary.stop()
+            _state.metrics_summary = None
+        if _state.metrics_server is not None:
+            _state.metrics_server.stop()
+            _state.metrics_server = None
         if _state._owns_distributed:
             try:
                 jax.distributed.shutdown()

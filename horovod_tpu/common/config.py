@@ -123,6 +123,19 @@ KNOBS: List[Knob] = [
     Knob("HOROVOD_GPU_OPERATIONS", str, "",
          "Unused on TPU; recognised for compatibility and ignored. The "
          "data plane is always XLA collectives over ICI/DCN via PJRT."),
+    # -- metrics -------------------------------------------------------------
+    Knob("HOROVOD_METRICS_PORT", int, 0,
+         "Opt-in Prometheus scrape endpoint: serve the process-wide "
+         "metrics registry (hvd.metrics()) as text exposition on "
+         "http://0.0.0.0:<port + local_rank>/metrics — each rank "
+         "offsets by its local rank so single-host multi-rank jobs "
+         "don't collide on the bind. 0 disables serving; the registry "
+         "itself is always on (registry-only fast path)."),
+    Knob("HOROVOD_METRICS_SUMMARY_SECONDS", float, 0.0,
+         "Rank-0 periodic metrics summary: log an INFO line with the "
+         "registry's nonzero counters/gauges every this many seconds "
+         "(the greppable heartbeat when no scraper is attached). "
+         "0 disables."),
     # -- timeline / profiling -----------------------------------------------
     Knob("HOROVOD_TIMELINE", str, "",
          "Path to write a Chrome-trace JSON timeline of per-tensor "
@@ -161,6 +174,12 @@ KNOBS: List[Knob] = [
          "Log level: trace, debug, info, warning, error, fatal."),
     Knob("HOROVOD_LOG_TIMESTAMP", _parse_bool, True,
          "Prefix log lines with a timestamp."),
+    Knob("HOROVOD_LOG_RANK0_ONLY", _parse_bool, False,
+         "Suppress INFO-and-below log records on nonzero ranks "
+         "(warnings and errors always pass everywhere) — the log "
+         "declutter for large jobs where every rank saying the same "
+         "thing N times drowns the signal. Rank 0 keeps full "
+         "verbosity."),
     # -- elastic -------------------------------------------------------------
     Knob("HOROVOD_ELASTIC_TIMEOUT", float, 600.0,
          "Seconds to wait for the elastic job to reach min size after a "
@@ -238,6 +257,8 @@ class Config:
         "shutdown_barrier_timeout": "HOROVOD_SHUTDOWN_BARRIER_TIMEOUT",
         "hierarchical_allreduce": "HOROVOD_HIERARCHICAL_ALLREDUCE",
         "controller": "HOROVOD_CONTROLLER",
+        "metrics_port": "HOROVOD_METRICS_PORT",
+        "metrics_summary_seconds": "HOROVOD_METRICS_SUMMARY_SECONDS",
         "timeline_path": "HOROVOD_TIMELINE",
         "timeline_mark_cycles": "HOROVOD_TIMELINE_MARK_CYCLES",
         "autotune": "HOROVOD_AUTOTUNE",
@@ -258,6 +279,7 @@ class Config:
         "stall_shutdown_time": "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS",
         "log_level": "HOROVOD_LOG_LEVEL",
         "log_timestamp": "HOROVOD_LOG_TIMESTAMP",
+        "log_rank0_only": "HOROVOD_LOG_RANK0_ONLY",
         "elastic_timeout": "HOROVOD_ELASTIC_TIMEOUT",
         "dynamic_process_sets": "HOROVOD_DYNAMIC_PROCESS_SETS",
         "rank": "HOROVOD_RANK",

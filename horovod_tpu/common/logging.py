@@ -27,24 +27,37 @@ logger = _pylog.getLogger("horovod_tpu")
 
 
 class _RankFilter(_pylog.Filter):
-    """Injects the process rank into every record once known."""
+    """Injects the process rank into every record once known; under
+    HOROVOD_LOG_RANK0_ONLY also drops INFO-and-below on nonzero ranks
+    (warnings/errors always pass — a straggler's stall warning must
+    not be silenced by a verbosity knob)."""
 
     rank = None
+    rank0_only = False
 
     def filter(self, record):
         record.hvdrank = f"[{self.rank}]" if self.rank is not None else ""
+        if (self.rank0_only and self.rank not in (None, 0)
+                and record.levelno <= _pylog.INFO):
+            return False
         return True
 
 
 _rank_filter = _RankFilter()
 
 
-def configure(level: str = None, timestamp: bool = None) -> None:
+def configure(level: str = None, timestamp: bool = None,
+              rank0_only: bool = None) -> None:
     level = level if level is not None else os.environ.get(
         "HOROVOD_LOG_LEVEL", "warning")
     if timestamp is None:
         timestamp = os.environ.get("HOROVOD_LOG_TIMESTAMP", "1").lower() in (
             "1", "true", "yes", "on")
+    if rank0_only is None:
+        rank0_only = os.environ.get(
+            "HOROVOD_LOG_RANK0_ONLY", "").lower() in (
+                "1", "true", "yes", "on")
+    _rank_filter.rank0_only = bool(rank0_only)
     logger.setLevel(_LEVELS.get(level.lower(), _pylog.WARNING))
     logger.handlers.clear()
     handler = _pylog.StreamHandler(sys.stderr)
@@ -58,6 +71,10 @@ def configure(level: str = None, timestamp: bool = None) -> None:
 
 def set_rank(rank: int) -> None:
     _rank_filter.rank = rank
+
+
+def set_rank0_only(flag: bool) -> None:
+    _rank_filter.rank0_only = bool(flag)
 
 
 def trace(msg, *args):

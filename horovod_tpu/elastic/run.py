@@ -20,8 +20,19 @@ import time
 from typing import Callable
 
 from ..common import basics, logging as hlog
+from ..metrics import REGISTRY as _METRICS
 from . import notifications
 from .state import HorovodInternalError, HostsUpdatedInterrupt
+
+_m_resets = _METRICS.counter(
+    "hvd_elastic_resets_total",
+    "World re-initializations (collective failure or graceful "
+    "membership change).")
+_m_reset_latency = _METRICS.histogram(
+    "hvd_elastic_reset_latency_seconds",
+    "Wall time of a successful elastic re-initialization (teardown + "
+    "rendezvous re-poll + coordination-service re-init).",
+    buckets=(0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1200.0))
 
 
 def _reinitialize() -> None:
@@ -61,6 +72,8 @@ def _reinitialize() -> None:
     deadline = time.time() + float(
         os.environ.get("HOROVOD_ELASTIC_TIMEOUT", "600"))
     attempt = 0
+    _m_resets.inc()
+    t_reset = time.monotonic()
     try:
         while True:
             try:
@@ -70,6 +83,7 @@ def _reinitialize() -> None:
                         max_timeout))
                 attempt += 1
                 basics.init()
+                _m_reset_latency.observe(time.monotonic() - t_reset)
                 return
             except SystemExit:
                 raise  # removed by resize: clean exit, not a retry

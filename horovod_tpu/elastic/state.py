@@ -26,6 +26,19 @@ import numpy as np
 # Raised by the COLLECTIVE layer on control-plane loss; re-exported
 # here for API parity (hvd.elastic.HorovodInternalError).
 from ..common.exceptions import HorovodInternalError  # noqa: F401,E402
+from ..metrics import REGISTRY as _METRICS
+
+_m_commits = _METRICS.counter(
+    "hvd_elastic_commits_total",
+    "Elastic state commits (State.commit: save + host-update check).")
+_m_restores = _METRICS.counter(
+    "hvd_elastic_restores_total",
+    "Elastic state restores (rollback to the last commit after a "
+    "collective failure).")
+_m_syncs = _METRICS.counter(
+    "hvd_elastic_syncs_total",
+    "Elastic state syncs (rank-0 broadcast at attempt start / after "
+    "membership changes).")
 
 
 class HostsUpdatedInterrupt(Exception):
@@ -62,6 +75,7 @@ class State:
         (JaxState: the async Orbax manager)."""
 
     def commit(self) -> None:
+        _m_commits.inc()
         self.save()
         self.check_host_updates()
 
@@ -124,10 +138,12 @@ class ObjectState(State):
                        for k in self._known_attrs}
 
     def restore(self) -> None:
+        _m_restores.inc()
         for k, v in self._saved.items():
             setattr(self, k, copy.deepcopy(v))
 
     def sync(self) -> None:
+        _m_syncs.inc()
         synced = self._bcast_object(
             {k: getattr(self, k) for k in self._known_attrs}, root_rank=0)
         for k, v in synced.items():

@@ -17,8 +17,16 @@ import urllib.request
 from typing import Optional
 
 from ..common import logging as hlog
+from ..metrics import REGISTRY as _METRICS
 from ..runner import secret as _secret
 from . import notifications
+
+_m_rendezvous = _METRICS.counter(
+    "hvd_elastic_rendezvous_total",
+    "Rendezvous assignment re-polls after membership changes.")
+_m_notify = _METRICS.counter(
+    "hvd_elastic_notifications_total",
+    "Membership-change notifications delivered to this worker.")
 
 _listener: Optional["NotificationListener"] = None
 
@@ -44,6 +52,7 @@ class NotificationListener:
     def _on_poke(req: dict, peer) -> dict:
         info = {k: v for k, v in req.items() if k != "type"}
         hlog.info("elastic: hosts-updated notification: %s", info)
+        _m_notify.inc()
         notifications.notify(info)
         return {"ok": True}
 
@@ -109,6 +118,7 @@ def refresh_env_from_rendezvous() -> None:
     addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "")
     if not addr:
         return
+    _m_rendezvous.inc()
     me = os.environ.get("HOROVOD_HOSTNAME", socket.gethostname())
     lr = os.environ.get("HOROVOD_LOCAL_RANK", "0")
     path = f"/rank/{me}/{lr}"

@@ -31,6 +31,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from ..common.compat import axis_size as _compat_axis_size
 import numpy as np
 from jax import lax
 
@@ -86,7 +87,7 @@ def _axis_size(name: Optional[str]) -> int:
     if name is None:
         return 1
     try:
-        return lax.axis_size(name)
+        return _compat_axis_size(name)
     except NameError:
         return 1
 

@@ -50,6 +50,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..common.compat import shard_map
 from .process_set import ProcessSet
 from . import dispatch
 
@@ -158,7 +159,7 @@ def _adasum_kernel(mesh, n: int, sig: Tuple, use_pallas: bool = False):
             off += sz
         return tuple(outs)
 
-    fn = jax.shard_map(body, mesh=mesh,
+    fn = shard_map(body, mesh=mesh,
                        in_specs=tuple(P("proc") for _ in sig),
                        out_specs=tuple(P("proc") for _ in sig))
     return jax.jit(fn)
@@ -202,7 +203,7 @@ def _adasum_kernel_vhdd_wide(mesh, n: int, ndev: int, sig: Tuple):
             off += sz
         return tuple(outs)
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc", "dev"),
+    fn = shard_map(body, mesh=mesh, in_specs=P("proc", "dev"),
                        out_specs=tuple(P("proc") for _ in sig),
                        check_vma=False)
     return jax.jit(fn)
@@ -399,7 +400,7 @@ def _adasum_kernel_vhdd(mesh, n: int, sig: Tuple):
             off += sz
         return tuple(outs)
 
-    fn = jax.shard_map(body, mesh=mesh,
+    fn = shard_map(body, mesh=mesh,
                        in_specs=tuple(P("proc") for _ in sig),
                        out_specs=tuple(P("proc") for _ in sig),
                        check_vma=False)

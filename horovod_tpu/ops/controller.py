@@ -41,6 +41,8 @@ import numpy as np
 
 from ..common import logging as hlog
 from ..core import native
+from ..metrics import (BYTES_BUCKETS, COUNT_BUCKETS, LATENCY_BUCKETS,
+                       REGISTRY as _METRICS)
 from . import dispatch
 from .dispatch import ADASUM, AVERAGE, SUM
 
@@ -99,7 +101,8 @@ def parse_allreduce_sig(sig: str):
 
 class _PendingAllreduce:
     __slots__ = ("tensors", "compression", "pset", "rop",
-                 "prescale", "postscale", "handle", "grouped")
+                 "prescale", "postscale", "handle", "grouped",
+                 "submitted")
 
     def __init__(self, tensors, compression, pset, rop, prescale,
                  postscale, handle, grouped):
@@ -114,39 +117,43 @@ class _PendingAllreduce:
         self.postscale = postscale
         self.handle = handle
         self.grouped = grouped
+        self.submitted = time.monotonic()
 
 
 class _PendingGeneric:
-    __slots__ = ("fn", "handle", "wants_meta")
+    __slots__ = ("fn", "handle", "wants_meta", "submitted")
 
     def __init__(self, fn, handle, wants_meta=False):
         self.fn = fn
         self.handle = handle
         self.wants_meta = wants_meta  # fn takes the per-rank metas list
+        self.submitted = time.monotonic()
 
 
 class _PendingBroadcast:
-    __slots__ = ("tensor", "root", "pset", "handle")
+    __slots__ = ("tensor", "root", "pset", "handle", "submitted")
 
     def __init__(self, tensor, root, pset, handle):
         self.tensor = tensor
         self.root = root
         self.pset = pset
         self.handle = handle
+        self.submitted = time.monotonic()
 
 
 class _PendingAllgather:
-    __slots__ = ("tensor", "pset", "handle")
+    __slots__ = ("tensor", "pset", "handle", "submitted")
 
     def __init__(self, tensor, pset, handle):
         self.tensor = tensor
         self.pset = pset
         self.handle = handle
+        self.submitted = time.monotonic()
 
 
 class _PendingReducescatter:
     __slots__ = ("tensor", "pset", "rop", "prescale", "postscale",
-                 "handle")
+                 "handle", "submitted")
 
     def __init__(self, tensor, pset, rop, prescale, postscale, handle):
         self.tensor = tensor
@@ -155,6 +162,7 @@ class _PendingReducescatter:
         self.prescale = prescale
         self.postscale = postscale
         self.handle = handle
+        self.submitted = time.monotonic()
 
 
 class PythonCore:
@@ -320,6 +328,50 @@ class NegotiatedController:
         # distinct compositions = recompiling instead of reusing.
         self._ar_compositions: set = set()
         self._churn_warned = False
+
+        # Process-wide metrics (hvd.metrics() / the /metrics scrape).
+        self._m_negotiation = _METRICS.histogram(
+            "hvd_negotiation_latency_seconds",
+            "Submit-to-agreement latency per locally-submitted "
+            "collective (coordinator-measured).",
+            buckets=LATENCY_BUCKETS)
+        self._m_batch_entries = _METRICS.histogram(
+            "hvd_fusion_batch_entries",
+            "Entries per agreed fused batch (fusion efficiency: "
+            "1 = nothing fused).", buckets=COUNT_BUCKETS)
+        self._m_batch_bytes = _METRICS.histogram(
+            "hvd_fusion_batch_bytes",
+            "Raw payload bytes per fused allreduce batch (compare "
+            "against HOROVOD_FUSION_THRESHOLD).",
+            buckets=BYTES_BUCKETS)
+        self._m_batches = _METRICS.counter(
+            "hvd_fused_batches_total",
+            "Agreed batches executed, by collective kind.", ("kind",))
+        self._m_entries = _METRICS.counter(
+            "hvd_fused_entries_total",
+            "Entries executed inside agreed batches, by kind.",
+            ("kind",))
+        self._m_cache_hits = _METRICS.counter(
+            "hvd_fused_program_cache_hits_total",
+            "Fused allreduce batches whose composition was seen "
+            "before (compiled XLA program reused).")
+        self._m_cache_misses = _METRICS.counter(
+            "hvd_fused_program_cache_misses_total",
+            "Fused allreduce batches with a NEW composition (a fresh "
+            "XLA compile; a rising rate is the composition-churn "
+            "slowdown — see HOROVOD_BATCH_QUIESCENCE).")
+        # Stall-inspector gauges: the Python-side mirror of the native
+        # core's stall inspector (stall_inspector.cc analog) — tensors
+        # pending agreement longer than HOROVOD_STALL_CHECK_TIME_
+        # SECONDS, so stalls become alertable instead of log-only.
+        self._m_stalled = _METRICS.gauge(
+            "hvd_stalled_tensors",
+            "Collectives pending negotiation longer than "
+            "HOROVOD_STALL_CHECK_TIME_SECONDS right now.")
+        self._m_stall_age = _METRICS.gauge(
+            "hvd_stall_max_age_seconds",
+            "Age of the oldest currently-stalled pending collective "
+            "(0 when nothing is stalled).")
 
         if cfg.controller == "python" and topology.size > 1 and \
                 core is None:
@@ -564,10 +616,12 @@ class NegotiatedController:
                     self._poll_join()
                     self._fail_pending(self._terminated)
                     self._join_event.set()
+                    self._clear_stall_gauges()
                     break
                 if batch:
                     self._execute(batch)
                 self._poll_join()
+                self._update_stall_gauges()
         except BaseException as e:  # pragma: no cover - defensive
             hlog.error("controller worker died: %s", e)
             self._error = e
@@ -581,6 +635,26 @@ class NegotiatedController:
             if lastrank >= 0:
                 self._join_result = lastrank
                 self._join_event.set()
+
+    def _update_stall_gauges(self) -> None:
+        """Refresh the stall gauges from the pending registry; runs on
+        every worker-loop pass (<= 20 Hz, O(pending) dict scan)."""
+        warn = self.cfg.stall_check_time
+        if self.cfg.stall_check_disable or warn <= 0:
+            # 0 means "stall checking off" (the sentinel the native
+            # core receives for disabled), not "everything is stalled".
+            return
+        now = time.monotonic()
+        with self._mu:
+            ages = [now - p.submitted for p in self._pending.values()]
+        stalled = [a for a in ages if a >= warn]
+        self._m_stalled.set(len(stalled))
+        self._m_stall_age.set(max(stalled) if stalled else 0.0)
+
+    def _clear_stall_gauges(self) -> None:
+        # A dead controller must not leave a stuck "stalled" alert.
+        self._m_stalled.set(0)
+        self._m_stall_age.set(0.0)
 
     def _fail_pending(self, err: BaseException) -> None:
         with self._mu:
@@ -606,17 +680,20 @@ class NegotiatedController:
 
     def _execute(self, batch):
         tl = self.engine.timeline
-        local = set()
+        # The batch was just agreed: locally-submitted entries close
+        # their NEGOTIATE lanes and score the negotiation-latency
+        # histogram (a joined rank executing a zero-fill entry never
+        # submitted — skip it to keep lanes/metrics balanced).
+        with self._mu:
+            local = {e.name for e in batch if e.name in self._pending}
+        for e in batch:
+            if e.name in local:
+                self._m_negotiation.observe(
+                    max(getattr(e, "negotiate_us", 0) or 0, 0) / 1e6)
         if tl is not None:
-            # The batch was just agreed: NEGOTIATE ends for every
-            # locally-submitted entry (a joined rank executing a
-            # zero-fill entry never opened a NEGOTIATE span — skip it
-            # to keep lanes balanced). The core measured the
-            # coordinator-side duration in e.negotiate_us; lanes use
-            # local clocks. Mark the cycle boundary if requested.
-            with self._mu:
-                local = {e.name for e in batch
-                         if e.name in self._pending}
+            # The core measured the coordinator-side duration in
+            # e.negotiate_us; lanes use local clocks. Mark the cycle
+            # boundary if requested.
             cyc = self.core.cycles()
             if cyc != self._last_cycle_mark:
                 self._last_cycle_mark = cyc
@@ -655,6 +732,9 @@ class NegotiatedController:
         c = self.exec_counts.setdefault(kind, [0, 0])
         c[0] += 1
         c[1] += len(live)
+        self._m_batches.labels(kind=kind).inc()
+        self._m_entries.labels(kind=kind).inc(len(live))
+        self._m_batch_entries.observe(len(live))
         if kind == "ar":
             self._execute_allreduce_batch(live)
         elif kind == "bc":
@@ -716,6 +796,7 @@ class NegotiatedController:
         try:
             label = (f"[{len(slots)}]" if len(slots) > 1
                      else f"::{slots[0][0].name}")
+            t0 = time.perf_counter()
             with jax.profiler.TraceAnnotation(f"hvd::fused{label}"):
                 outs = run()
         except BaseException as ex:
@@ -724,6 +805,7 @@ class NegotiatedController:
                 if self.engine.timeline is not None:
                     self.engine.timeline.done(e.name, error=True)
             return
+        self.engine.dispatch_latency.observe(time.perf_counter() - t0)
         for (e, p), o in zip(slots, outs):
             p.handle.set_result(o)
 
@@ -849,11 +931,20 @@ class NegotiatedController:
         # Churn watch: a growing set of distinct batch compositions
         # means each cut is compiling a NEW fused program (the
         # measured 300x eager slowdown mode — docs/benchmarks.md).
-        # Point at the knob that stabilizes the cut.
-        if not self._churn_warned and not self.cfg.batch_quiescence:
-            self._ar_compositions.add(
-                tuple((tuple(t.shape), str(t.dtype)) for t in tensors))
-            if len(self._ar_compositions) > 16:
+        # Hit = composition seen before (compiled program reused),
+        # miss = fresh compile; the counter pair makes churn a
+        # scrapeable rate, the one-shot warning points at the knob
+        # that stabilizes the cut. (The set mirrors the XLA compile
+        # cache's own footprint — one small tuple per compiled fused
+        # program.)
+        comp = tuple((tuple(t.shape), str(t.dtype)) for t in tensors)
+        if comp in self._ar_compositions:
+            self._m_cache_hits.inc()
+        else:
+            self._ar_compositions.add(comp)
+            self._m_cache_misses.inc()
+            if (not self._churn_warned and not self.cfg.batch_quiescence
+                    and len(self._ar_compositions) > 16):
                 self._churn_warned = True
                 hlog.warning(
                     "eager allreduce batches have taken %d distinct "
@@ -866,8 +957,12 @@ class NegotiatedController:
                     "DistributedOptimizer which submit one stable "
                     "group", len(self._ar_compositions))
 
+        batch_bytes = dispatch._raw_nbytes(tensors)
+        self._m_batch_bytes.observe(batch_bytes)
+
         tuner = self.engine.autotuner
         t0 = time.perf_counter() if tuner is not None else 0.0
+        t0d = time.perf_counter()
 
         eff_op, eff_post = rop, post
         if rop == AVERAGE:
@@ -909,15 +1004,14 @@ class NegotiatedController:
                     if self.engine.timeline is not None:
                         self.engine.timeline.done(e.name, error=True)
             return
+        self.engine.dispatch_latency.observe(time.perf_counter() - t0d)
         if tuner is not None:
             # Autotune scores bytes-reduced/sec (reference:
             # ParameterManager): needs completion time, so block only
             # when tuning; then propagate the (possibly stepped)
             # fusion threshold into the negotiation core.
             jax.block_until_ready(outs)
-            nbytes = int(sum(
-                np.prod(t.shape) * jnp.dtype(t.dtype).itemsize
-                for t in tensors))
+            nbytes = batch_bytes
             # The denominator must include the NEGOTIATION latency
             # (submit -> agreement, measured by the coordinator and
             # carried on each entry) or the quiescence/cycle knobs'
@@ -968,3 +1062,4 @@ class NegotiatedController:
             for p in self._pending.values():
                 p.handle.set_error(RuntimeError("shutdown"))
             self._pending.clear()
+        self._clear_stall_gauges()

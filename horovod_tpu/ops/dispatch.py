@@ -26,6 +26,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..common.compat import shard_map
+from ..metrics import record_collective as _record_collective
 from .process_set import ProcessSet
 
 # Reduce-op enum (reference: horovod/common/common.h ReduceOp and the
@@ -48,6 +50,20 @@ def op_name(op: int) -> str:
 
 def _as_local(x) -> jax.Array:
     return x if isinstance(x, jax.Array) else jnp.asarray(x)
+
+
+def _raw_nbytes(tensors) -> int:
+    return int(sum((np.prod(t.shape) if t.shape else 1)
+                   * jnp.dtype(t.dtype).itemsize for t in tensors))
+
+
+def _count(kind: str, pset: ProcessSet, tensors) -> None:
+    """Per-collective-kind / per-process-set metrics seam: raw local
+    payload bytes + tensor counts, recorded once per dispatch entry
+    (group helpers count here; single-tensor wrappers count only on
+    their non-delegating paths so nothing is double-counted)."""
+    _record_collective(kind, pset.process_set_id, _raw_nbytes(tensors),
+                       len(tensors))
 
 
 def _is_bool(x) -> bool:
@@ -170,7 +186,7 @@ def _allreduce_kernel(mesh, n: int, op: int, prescale: float,
             off += sz
         return tuple(outs)
 
-    fn = jax.shard_map(body, mesh=mesh,
+    fn = shard_map(body, mesh=mesh,
                        in_specs=tuple(P("proc") for _ in sig),
                        out_specs=tuple(P("proc") for _ in sig))
     return jax.jit(fn)
@@ -334,7 +350,7 @@ def _allreduce_kernel_wide(mesh, n: int, ndev: int, op: int,
 
     # check_vma off: the 'dev' all_gather makes outputs replicated
     # over 'dev', which the static replication checker cannot infer.
-    fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc", "dev"),
+    fn = shard_map(body, mesh=mesh, in_specs=P("proc", "dev"),
                        out_specs=tuple(P("proc") for _ in sig),
                        check_vma=False)
     return jax.jit(fn)
@@ -458,7 +474,7 @@ def _broadcast_kernel_wide(mesh, n: int, ndev: int, root: int,
             off += sz
         return tuple(outs)
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc", "dev"),
+    fn = shard_map(body, mesh=mesh, in_specs=P("proc", "dev"),
                        out_specs=tuple(P("proc") for _ in sig),
                        check_vma=False)
     return jax.jit(fn)
@@ -599,7 +615,7 @@ def _allreduce_kernel_hier_wide(mesh, n: int, op: int, prescale: float,
             off += sz
         return tuple(outs)
 
-    fn = jax.shard_map(body, mesh=mesh,
+    fn = shard_map(body, mesh=mesh,
                        in_specs=P(("cross", "local"), "dev"),
                        out_specs=tuple(P(("cross", "local"))
                                        for _ in sig),
@@ -659,7 +675,7 @@ def _allreduce_kernel_hier(mesh, n: int, op: int, prescale: float,
             off += sz
         return tuple(outs)
 
-    fn = jax.shard_map(body, mesh=mesh,
+    fn = shard_map(body, mesh=mesh,
                        in_specs=tuple(P(("cross", "local"))
                                       for _ in sig),
                        out_specs=tuple(P(("cross", "local"))
@@ -678,7 +694,7 @@ def _allgather_kernel(mesh, n: int, sizes: Tuple[int, ...], sig: Tuple):
         pieces = [g[i, : sizes[i]] for i in range(n)]
         return jnp.concatenate(pieces, axis=0)[None]
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc"),
+    fn = shard_map(body, mesh=mesh, in_specs=P("proc"),
                        out_specs=P("proc"))
     return jax.jit(fn)
 
@@ -701,7 +717,7 @@ def _allgather_kernel_hier(mesh, n: int, sizes: Tuple[int, ...],
         pieces = [g[i // L, i % L, : sizes[i]] for i in range(n)]
         return jnp.concatenate(pieces, axis=0)[None]
 
-    fn = jax.shard_map(body, mesh=mesh,
+    fn = shard_map(body, mesh=mesh,
                        in_specs=P(("cross", "local")),
                        out_specs=P(("cross", "local")))
     return jax.jit(fn)
@@ -735,7 +751,7 @@ def _allgather_group_kernel(mesh, n: int,
             off += fsz
         return tuple(outs)
 
-    fn = jax.shard_map(body, mesh=mesh,
+    fn = shard_map(body, mesh=mesh,
                        in_specs=tuple(P("proc") for _ in sig),
                        out_specs=tuple(P("proc") for _ in sig))
     return jax.jit(fn)
@@ -770,7 +786,7 @@ def _allgather_group_kernel_hier(mesh, n: int,
             off += fsz
         return tuple(outs)
 
-    fn = jax.shard_map(body, mesh=mesh,
+    fn = shard_map(body, mesh=mesh,
                        in_specs=tuple(P(("cross", "local"))
                                       for _ in sig),
                        out_specs=tuple(P(("cross", "local"))
@@ -809,7 +825,7 @@ def _allgather_group_kernel_wide(mesh, n: int, ndev: int,
             off += fsz
         return tuple(outs)
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc", "dev"),
+    fn = shard_map(body, mesh=mesh, in_specs=P("proc", "dev"),
                        out_specs=tuple(P("proc") for _ in sig),
                        check_vma=False)
     return jax.jit(fn)
@@ -883,7 +899,7 @@ def _reducescatter_group_kernel_wide(mesh, n: int, ndev: int, op: int,
         full = lax.all_gather(red.reshape(-1), "dev", tiled=True)
         return full[None]                              # (1, sp)
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc", "dev"),
+    fn = shard_map(body, mesh=mesh, in_specs=P("proc", "dev"),
                        out_specs=P("proc"), check_vma=False)
     return jax.jit(fn)
 
@@ -948,7 +964,7 @@ def _allgather_group_kernel_hier_wide(mesh, n: int, ndev: int,
             off += fsz
         return tuple(outs)
 
-    fn = jax.shard_map(body, mesh=mesh,
+    fn = shard_map(body, mesh=mesh,
                        in_specs=P(("cross", "local"), "dev"),
                        out_specs=tuple(P(("cross", "local"))
                                        for _ in sig),
@@ -970,7 +986,7 @@ def _alltoall_kernel(mesh, n: int, maxsplit: int, sig: Tuple):
         # out: (n, 1, maxsplit, *rest) -> (1, n, maxsplit, *rest)
         return jnp.swapaxes(out, 0, 1)
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc"),
+    fn = shard_map(body, mesh=mesh, in_specs=P("proc"),
                        out_specs=P("proc"))
     return jax.jit(fn)
 
@@ -1012,7 +1028,7 @@ def _alltoall_kernel_wide(mesh, n: int, ndev: int, ms2: int,
         full = lax.all_gather(out, "dev", axis=1, tiled=True)
         return full[None]                 # (1, n, ms2, *rest)
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc", "dev"),
+    fn = shard_map(body, mesh=mesh, in_specs=P("proc", "dev"),
                        out_specs=P("proc"), check_vma=False)
     return jax.jit(fn)
 
@@ -1037,7 +1053,7 @@ def _ppermute_shift_kernel_wide(mesh, n: int, ndev: int, shift: int,
         full = lax.all_gather(got, "dev", axis=0, tiled=True)
         return full[None]                 # (1, rows2, *rest)
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc", "dev"),
+    fn = shard_map(body, mesh=mesh, in_specs=P("proc", "dev"),
                        out_specs=P("proc"), check_vma=False)
     return jax.jit(fn)
 
@@ -1057,7 +1073,7 @@ def _ppermute_shift_kernel(mesh, n: int, shift: int, sig: Tuple):
     def body(block):
         return lax.ppermute(block, "proc", perm=pairs)
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc"),
+    fn = shard_map(body, mesh=mesh, in_specs=P("proc"),
                        out_specs=P("proc"))
     return jax.jit(fn)
 
@@ -1265,7 +1281,7 @@ def _reducescatter_kernel(mesh, n: int, op: int, prescale: float,
             red = red * jnp.asarray(postscale, red.dtype)
         return red[None]
 
-    fn = jax.shard_map(body, mesh=mesh, in_specs=P("proc"),
+    fn = shard_map(body, mesh=mesh, in_specs=P("proc"),
                        out_specs=P("proc"))
     return jax.jit(fn)
 
@@ -1283,6 +1299,7 @@ def allreduce_group(tensors: List[jax.Array], pset: ProcessSet, op: int,
     fp16/bf16 wire cast into the same single XLA launch — no
     per-tensor compress/decompress programs."""
     tensors = [_as_local(t) for t in tensors]
+    _count("allreduce", pset, tensors)
     if compressors is not None:
         from .compression import NoneCompressor
         if all(c is NoneCompressor for c in compressors):
@@ -1391,7 +1408,7 @@ def _broadcast_group_kernel(mesh, n: int, root: int, sig: Tuple):
             off += sz
         return tuple(outs)
 
-    fn = jax.shard_map(body, mesh=mesh,
+    fn = shard_map(body, mesh=mesh,
                        in_specs=tuple(P("proc") for _ in sig),
                        out_specs=tuple(P("proc") for _ in sig))
     return jax.jit(fn)
@@ -1403,6 +1420,7 @@ def broadcast_group(tensors: List[jax.Array], root: int,
     Mixed dtypes are split into same-dtype fused subgroups by the
     caller; bools ride as uint8."""
     tensors = [_as_local(t) for t in tensors]
+    _count("broadcast", pset, tensors)
     if pset.size == 1:
         return tensors
     bools = [t.dtype == jnp.bool_ for t in tensors]
@@ -1436,12 +1454,15 @@ def allgather(tensor: jax.Array, pset: ProcessSet,
     x = _as_local(tensor)
     n = pset.size
     if n == 1:
+        _count("allgather", pset, [x])
         return tensor
     maxr = max(all_rows)
     rest = int(np.prod(x.shape[1:])) if x.ndim > 1 else 1
     spanable = (_wide_mesh(pset, maxr * rest) is not None
                 if _hier_mesh(pset) is None
                 else _hier_mesh_wide(pset) is not None)
+    if not spanable:
+        _count("allgather", pset, [x])
     if spanable:
         # Single tensor = group of one through the device-spanning
         # (possibly hierarchical) kernel, exactly like broadcast()
@@ -1479,6 +1500,7 @@ def allgather_group(tensors: List[jax.Array], pset: ProcessSet,
     n = pset.size
     xs = [_as_local(t) for t in tensors]
     xs = [x[None] if x.ndim == 0 else x for x in xs]
+    _count("allgather", pset, xs)
     bools = [x.dtype == jnp.bool_ for x in xs]
     xs = [x.astype(jnp.uint8) if b else x for x, b in zip(xs, bools)]
     if n == 1:
@@ -1567,6 +1589,7 @@ def alltoall(tensor: jax.Array, splits: Sequence[int],
     ragged ppermute-rounds path whose wire bytes track sum(splits)
     instead of n * maxsplit (see HOROVOD_ALLTOALL_MODE)."""
     x = _as_local(tensor)
+    _count("alltoall", pset, [x])
     n = pset.size
     if n == 1:
         return tensor
@@ -1659,6 +1682,7 @@ def reducescatter(tensor: jax.Array, pset: ProcessSet, op: int,
     x = _as_local(tensor)
     n = pset.size
     if n == 1:
+        _count("reducescatter", pset, [x])
         scale = prescale * postscale
         return x * jnp.asarray(scale, x.dtype) if scale != 1.0 else tensor
     d0 = x.shape[0]
@@ -1669,9 +1693,11 @@ def reducescatter(tensor: jax.Array, pset: ProcessSet, op: int,
     if (op in (SUM, AVERAGE)
             and _wide_mesh(pset, int(np.prod(x.shape))) is not None):
         # Single tensor = group of one through the device-spanning
-        # kernel (same routing as broadcast/allgather).
+        # kernel (same routing as broadcast/allgather; the group
+        # records the metrics).
         return reducescatter_group([x], pset, op, prescale,
                                    postscale)[0]
+    _count("reducescatter", pset, [x])
     kern = _reducescatter_kernel(pset.mesh, n, op, float(prescale),
                                  float(postscale), rows, _sig([x]))
     out = local_shard(kern(to_global(x, pset)))
@@ -1723,7 +1749,7 @@ def _reducescatter_group_kernel(mesh, n: int, op: int, prescale: float,
             off += sz
         return tuple(outs)
 
-    fn = jax.shard_map(body, mesh=mesh,
+    fn = shard_map(body, mesh=mesh,
                        in_specs=tuple(P("proc") for _ in sig),
                        out_specs=tuple(P("proc") for _ in sig))
     return jax.jit(fn)
@@ -1742,6 +1768,7 @@ def reducescatter_group(tensors: List[jax.Array], pset: ProcessSet,
     """Fused reduce-scatter of a group; each output is this rank's
     trimmed row block of the corresponding reduction."""
     xs = [_as_local(t) for t in tensors]
+    _count("reducescatter", pset, xs)
     n = pset.size
     if n == 1:
         scale = prescale * postscale

@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, List, Optional
 import jax
 
 from ..common import logging as hlog
+from ..metrics import LATENCY_BUCKETS, REGISTRY as _METRICS
 
 
 class Handle:
@@ -109,8 +110,24 @@ class Engine:
             from .order_check import OrderCheck
             self.order_check = OrderCheck()
         self._shutdown = False
-        # Bytes/latency accounting for autotune scoring.
-        self._bytes_processed = 0
+        # Process-wide metrics. _bytes_processed was a bare unlocked
+        # int accumulated from both the caller thread (inline path)
+        # and the controller's dispatch worker — a data race; the
+        # thread-safe Counter is the fix AND the export. Counters
+        # outlive engine instances (process-wide), so the per-engine
+        # shutdown log diffs against the value at construction.
+        self._bytes_processed = _METRICS.counter(
+            "hvd_engine_bytes_total",
+            "Payload bytes dispatched through the eager engine.")
+        self._ops_processed = _METRICS.counter(
+            "hvd_engine_ops_total",
+            "Eager ops dispatched through the engine (inline path).")
+        self.dispatch_latency = _METRICS.histogram(
+            "hvd_dispatch_latency_seconds",
+            "Host-side dispatch latency per eager launch (async XLA "
+            "dispatch, not device completion).",
+            buckets=LATENCY_BUCKETS)
+        self._bytes_at_start = self._bytes_processed.value()
 
     # -- hooks ---------------------------------------------------------------
     def attach_timeline(self, timeline) -> None:
@@ -170,7 +187,9 @@ class Engine:
             self.timeline.dispatched(name)
         if self.order_check is not None:
             self.order_check.record(name)
-        self._bytes_processed += nbytes
+        self.dispatch_latency.observe(time.perf_counter() - t0)
+        self._bytes_processed.inc(nbytes)
+        self._ops_processed.inc()
         if self.autotuner is not None:
             # Throughput scoring needs the wall time to completion, not
             # async-dispatch latency, so block only when autotuning.
@@ -191,4 +210,5 @@ class Engine:
             self.controller.shutdown()
             self.controller = None
         hlog.debug("engine shut down; %d bytes processed",
-                   self._bytes_processed)
+                   int(self._bytes_processed.value()
+                       - self._bytes_at_start))

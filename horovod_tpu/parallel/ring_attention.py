@@ -29,6 +29,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from ..common.compat import axis_size as _compat_axis_size
 from jax import lax
 
 from .mesh import SEQ_AXIS
@@ -55,7 +56,7 @@ def _merge(acc_num, acc_den, acc_max, scores, v):
 def _ring_body(q, k, v, axis_name: str, causal: bool, scale: float):
     """Runs inside shard_map: q,k,v are this device's blocks
     (B, L, H, D)."""
-    n = lax.axis_size(axis_name)
+    n = _compat_axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     B, Lq, H, D = q.shape
     qf = q.astype(jnp.float32)

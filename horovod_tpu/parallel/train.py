@@ -29,6 +29,7 @@ from jax import lax
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..common.compat import GRADS_PRE_SUMMED, shard_map
 from .mesh import FSDP_AXIS, batch_axes
 from .sharding import Rules, replicated
 
@@ -186,7 +187,35 @@ def build_train_step(
     # axes. The true data-parallel MEAN gradient is therefore that
     # psum divided by the batch-axis product; one uniform scale is
     # correct for replicated AND model-sharded parameters alike.
+    def _sum_missing_axes(grads):
+        """Legacy-jax leg: without VMA typing (and with the legacy
+        replication checker off — see compat.shard_map) the transpose
+        does NOT psum a replicated parameter's cotangent, so each
+        device holds only its LOCAL contribution. Insert exactly the
+        missing psums: every mesh axis the parameter's spec does not
+        name (the axes it is replicated across)."""
+        axis_names = tuple(mesh.shape.keys())
+        spec_tree = _broadcast_specs(param_specs, grads)
+
+        def one(g, spec):
+            named = set()
+            if isinstance(spec, P):
+                for entry in spec:
+                    if entry is None:
+                        continue
+                    for nm in (entry if isinstance(entry, tuple)
+                               else (entry,)):
+                        named.add(nm)
+            for a in axis_names:
+                if a not in named:
+                    g = lax.psum(g, a)
+            return g
+
+        return jax.tree.map(one, grads, spec_tree)
+
     def reduce_grads(grads):
+        if not GRADS_PRE_SUMMED:
+            grads = _sum_missing_axes(grads)
         if grad_reducer is not None:
             return grad_reducer(grads)
         if n_batch == 1:
@@ -220,7 +249,7 @@ def build_train_step(
                 lambda a: _pmean_axes(a, baxes), aux)
         return params, opt_state, metrics
 
-    step = jax.shard_map(
+    step = shard_map(
         local_step, mesh=mesh,
         in_specs=(param_specs, opt_state_specs, batch_spec),
         out_specs=(param_specs, opt_state_specs, P()),

@@ -89,6 +89,12 @@ class Timeline:
         self._emit(name, "DISPATCH", "B")
 
     def done(self, name: str, error: bool = False) -> None:
+        if error:
+            # ERROR instant rides inside the DISPATCH span so the lane
+            # shows WHERE the failure landed, then the span closes —
+            # keeping the trace well-formed (the error-path analog of
+            # error(), which covers pre-dispatch failures).
+            self.error_marker(name)
         self._emit(name, "DISPATCH", "E")
 
     def error(self, name: str) -> None:
@@ -113,15 +119,35 @@ class Timeline:
 
     # -- writer thread -------------------------------------------------------
     def _write_loop(self) -> None:
+        # Durability: flush once per DRAIN of the queue, not per event
+        # — a SIGKILLed rank keeps everything written up to its last
+        # quiet moment, while a busy hot path amortizes the flush over
+        # the whole backlog.
         while True:
             ev = self._q.get()
             if ev is None:
+                self._file.flush()
                 return
-            line = json.dumps(ev)
-            if not self._first:
-                line = ",\n" + line
-            self._first = False
-            self._file.write(line)
+            batch = [ev]
+            closing = False
+            while True:
+                try:
+                    nxt = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    closing = True
+                    break
+                batch.append(nxt)
+            for e in batch:
+                line = json.dumps(e)
+                if not self._first:
+                    line = ",\n" + line
+                self._first = False
+                self._file.write(line)
+            self._file.flush()
+            if closing:
+                return
 
     def close(self) -> None:
         if self._closed:

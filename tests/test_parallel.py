@@ -4,6 +4,7 @@ technique 2 — fake devices instead of a cluster)."""
 
 import jax
 import jax.numpy as jnp
+from horovod_tpu.common.compat import shard_map
 import numpy as np
 import pytest
 from jax import lax
@@ -63,7 +64,7 @@ class TestRingAttention:
         oracle = attention(q, k, v, causal=causal)
 
         mesh = seq_mesh(4)
-        ring = jax.jit(jax.shard_map(
+        ring = jax.jit(shard_map(
             lambda q, k, v: ring_attention(q, k, v, "seq", causal=causal),
             mesh=mesh, in_specs=(P(None, "seq"),) * 3,
             out_specs=P(None, "seq")))
@@ -79,7 +80,7 @@ class TestRingAttention:
         mesh = seq_mesh(4)
 
         def loss_ring(q, k, v):
-            f = jax.shard_map(
+            f = shard_map(
                 lambda q, k, v: ring_attention(q, k, v, "seq"),
                 mesh=mesh, in_specs=(P(None, "seq"),) * 3,
                 out_specs=P(None, "seq"))
@@ -102,7 +103,7 @@ class TestUlysses:
                    for kk in jax.random.split(key, 3))
         oracle = attention(q, k, v, causal=True)
         mesh = seq_mesh(4)
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(shard_map(
             lambda q, k, v: ulysses_attention(q, k, v, "seq"),
             mesh=mesh, in_specs=(P(None, "seq"),) * 3,
             out_specs=P(None, "seq")))
@@ -132,7 +133,7 @@ class TestMoE:
         mesh = Mesh(np.array(jax.devices()[:ep]), axis_names=("expert",))
         # tokens replicated per-device would double T; instead shard
         # tokens over expert axis too (each device routes its half).
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(shard_map(
             lambda t, r, wi, wo: moe_ffn(t, r, wi, wo,
                                          capacity_factor=4.0,
                                          axis_name="expert")[0],
@@ -180,7 +181,7 @@ class TestPipeline:
             h, _ = lax.scan(body, h, pw)
             return h
 
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(shard_map(
             # shard_map keeps the sharded leading dim (size 1): squeeze
             lambda pw, x: pipeline_apply(stage_fn, pw[0], x, "pipe"),
             mesh=mesh, in_specs=(P("pipe"), P()), out_specs=P()))
@@ -198,7 +199,7 @@ class TestPipeline:
             return jnp.tanh(h @ pw[0])
 
         def loss(w):
-            f = jax.shard_map(
+            f = shard_map(
                 lambda pw, x: pipeline_apply(stage_fn, pw[0], x, "pipe"),
                 mesh=mesh, in_specs=(P("pipe"), P()), out_specs=P())
             return jnp.sum(f(w, x) ** 2)

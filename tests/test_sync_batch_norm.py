@@ -6,6 +6,7 @@ with a live axis — this is that test."""
 
 import jax
 import jax.numpy as jnp
+from horovod_tpu.common.compat import shard_map
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -42,7 +43,7 @@ def test_sync_bn_matches_global_batch(eight_device_mesh):
         y, _ = sync_bn.apply(vars_, xs[0], mutable=["batch_stats"])
         return y[None]
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         body, mesh=mesh, in_specs=P("proc"), out_specs=P("proc")))
     g = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("proc")))
     out = np.asarray(f(g))                      # (n, per, feat)
@@ -76,7 +77,7 @@ def test_sync_bn_running_stats_are_global(eight_device_mesh):
         return y[None], (upd["batch_stats"]["mean"][None],
                          upd["batch_stats"]["var"][None])
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         body, mesh=mesh, in_specs=P("proc"),
         out_specs=(P("proc"), (P("proc"), P("proc")))))
     g = jax.device_put(jnp.asarray(x), NamedSharding(mesh, P("proc")))
@@ -107,7 +108,7 @@ def test_resnet_sync_bn_axes_live(eight_device_mesh):
                                 mutable=["batch_stats"])
         return logits[None]
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         body, mesh=mesh, in_specs=P("proc"), out_specs=P("proc")))
     g = jax.device_put(
         jnp.broadcast_to(x_local, (8,) + x_local.shape),
