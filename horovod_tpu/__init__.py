@@ -52,6 +52,7 @@ from .optim.functions import (  # noqa: F401
     allgather_object, allreduce_parameters,
 )
 from . import elastic  # noqa: F401
+from . import faults  # noqa: F401
 from . import callbacks  # noqa: F401
 from . import flax  # noqa: F401
 from .sync_batch_norm import SyncBatchNorm, to_sync_batch_norm  # noqa: F401

@@ -184,6 +184,58 @@ KNOBS: List[Knob] = [
     Knob("HOROVOD_ELASTIC_TIMEOUT", float, 600.0,
          "Seconds to wait for the elastic job to reach min size after a "
          "membership change before giving up."),
+    Knob("HOROVOD_ELASTIC_INIT_BASE_TIMEOUT", float, 15.0,
+         "First-attempt coordination-service init timeout during an "
+         "elastic re-init; doubles per retry (churn-stale workers "
+         "abandon a wrong coordinator quickly and re-poll)."),
+    Knob("HOROVOD_ELASTIC_INIT_TIMEOUT", float, 120.0,
+         "Per-attempt cap the growing elastic re-init timeout doubles "
+         "up to."),
+    Knob("HOROVOD_ELASTIC_DRAIN_GRACE", float, 30.0,
+         "Seconds a gracefully-removed worker may keep running past "
+         "the resize before the driver terminates it."),
+    Knob("HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT", float, 0.0,
+         "Worker-liveness failure detector: workers PUT a signed "
+         "heartbeat to the rendezvous (background pacer + commit "
+         "boundaries); the elastic driver kills a worker whose last "
+         "heartbeat is older than this and gang-restarts, so a "
+         "hung-but-alive worker is recovered like a crash instead of "
+         "stalling the job forever. 0 disables (no heartbeats, no "
+         "detection)."),
+    Knob("HOROVOD_ELASTIC_HEARTBEAT_INTERVAL", float, 0.0,
+         "Heartbeat pacer period in seconds. 0 = auto: a third of "
+         "HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT (three missed beats "
+         "before a worker is declared hung), floored at 0.5 s."),
+    Knob("HOROVOD_ELASTIC_REGISTER_RETRIES", int, 5,
+         "Retries (with jittered exponential backoff) for the "
+         "worker's notify-listener registration at the rendezvous; a "
+         "worker that never registers misses every resize poke."),
+    Knob("HOROVOD_CONTROL_RETRY_BACKOFF", float, 0.2,
+         "Base seconds for control-plane retry backoff (doubles per "
+         "attempt, capped at 5 s, +/-50% jitter so a gang of workers "
+         "does not re-stampede a recovering endpoint in lockstep)."),
+    Knob("HOROVOD_ELASTIC_BLACKLIST_WINDOW", float, 60.0,
+         "Base host-blacklist window after a worker failure; the "
+         "window doubles per repeated failure of the same host."),
+    Knob("HOROVOD_ELASTIC_BLACKLIST_WINDOW_MAX", float, 900.0,
+         "Cap on the escalating per-host blacklist window."),
+    Knob("HOROVOD_DISCOVERY_STALENESS_WINDOW", float, 60.0,
+         "Discovery circuit breaker: consecutive discovery-script "
+         "failures are served from the last-known-good host list for "
+         "up to this many seconds before failures propagate again."),
+    # -- fault injection (chaos testing) -------------------------------------
+    Knob("HOROVOD_FAULTS", str, "",
+         "Deterministic fault-injection spec (faults.py): rules "
+         "'point:action[:k=v,...]' joined by ';', e.g. "
+         "'wire.send:drop:p=0.05;elastic.step:crash:at=40'. Points: "
+         "wire.send, wire.recv, rendezvous.http, discovery.poll, "
+         "elastic.step, dispatch.entry. Actions: drop, delay, "
+         "corrupt, error, crash, hang. Empty = every injection point "
+         "compiles to a no-op."),
+    Knob("HOROVOD_FAULTS_SEED", int, 0,
+         "Seed for the fault-injection schedule; each rule draws from "
+         "a private stream keyed on (seed, point, action), so the "
+         "same spec + seed reproduces the same failure schedule."),
     # -- process sets --------------------------------------------------------
     Knob("HOROVOD_DYNAMIC_PROCESS_SETS", _parse_bool, False,
          "Allow process sets to be registered after init."),
@@ -281,6 +333,18 @@ class Config:
         "log_timestamp": "HOROVOD_LOG_TIMESTAMP",
         "log_rank0_only": "HOROVOD_LOG_RANK0_ONLY",
         "elastic_timeout": "HOROVOD_ELASTIC_TIMEOUT",
+        "elastic_init_base_timeout": "HOROVOD_ELASTIC_INIT_BASE_TIMEOUT",
+        "elastic_init_timeout": "HOROVOD_ELASTIC_INIT_TIMEOUT",
+        "elastic_drain_grace": "HOROVOD_ELASTIC_DRAIN_GRACE",
+        "heartbeat_timeout": "HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT",
+        "heartbeat_interval": "HOROVOD_ELASTIC_HEARTBEAT_INTERVAL",
+        "register_retries": "HOROVOD_ELASTIC_REGISTER_RETRIES",
+        "control_retry_backoff": "HOROVOD_CONTROL_RETRY_BACKOFF",
+        "blacklist_window": "HOROVOD_ELASTIC_BLACKLIST_WINDOW",
+        "blacklist_window_max": "HOROVOD_ELASTIC_BLACKLIST_WINDOW_MAX",
+        "discovery_staleness_window": "HOROVOD_DISCOVERY_STALENESS_WINDOW",
+        "faults": "HOROVOD_FAULTS",
+        "faults_seed": "HOROVOD_FAULTS_SEED",
         "dynamic_process_sets": "HOROVOD_DYNAMIC_PROCESS_SETS",
         "rank": "HOROVOD_RANK",
         "size": "HOROVOD_SIZE",

@@ -119,8 +119,14 @@ def run(func: Callable) -> Callable:
 
     @functools.wraps(func)
     def wrapper(state, *args, **kwargs):
-        from .worker import register_with_rendezvous
+        from .worker import register_with_rendezvous, start_heartbeat
         register_with_rendezvous()
+        # Liveness pacer (no-op unless HOROVOD_ELASTIC_HEARTBEAT_
+        # TIMEOUT is set): beats through init/compile/resize phases
+        # where commits are far apart, so the driver's hung-worker
+        # detector never mistakes a slow phase for a livelock.
+        if start_heartbeat():
+            hlog.debug("elastic: liveness heartbeat pacer started")
         # Deliberately NOT consuming pending notifications here: a poke
         # (or the registration catch-up above) that raced our startup
         # is a REAL membership change the first commit must act on;

@@ -16,6 +16,7 @@ topology is fixed.
 from __future__ import annotations
 
 import copy
+import time
 from typing import Any, Callable, Dict, Optional
 
 import jax
@@ -26,6 +27,7 @@ import numpy as np
 # Raised by the COLLECTIVE layer on control-plane loss; re-exported
 # here for API parity (hvd.elastic.HorovodInternalError).
 from ..common.exceptions import HorovodInternalError  # noqa: F401,E402
+from ..common import logging as hlog
 from ..metrics import REGISTRY as _METRICS
 
 _m_commits = _METRICS.counter(
@@ -75,7 +77,26 @@ class State:
         (JaxState: the async Orbax manager)."""
 
     def commit(self) -> None:
+        # Chaos seam at the commit boundary — the natural "step N"
+        # marker of an elastic run: "error" raises HorovodInternalError
+        # (the restore + re-init path), "crash" hard-exits (the gang-
+        # restart path), "hang" parks this worker forever WITH its
+        # heartbeat pacer stopped, simulating a livelocked process for
+        # the driver's stale-heartbeat detector to catch.
+        from .. import faults as _faults
+        from . import worker as _worker
+        act = _faults.fire("elastic.step", exc=HorovodInternalError)
+        if act == "hang":
+            _worker.suspend_heartbeat()
+            hlog.warning("faults: hanging this worker (heartbeat "
+                         "parked; liveness detector should kill it)")
+            while True:
+                time.sleep(60)
         _m_commits.inc()
+        # Commit == progress: beat the liveness heartbeat here too
+        # (rate-limited inside), so a worker stuck BETWEEN the pacer's
+        # beats still advertises forward progress at every commit.
+        _worker.maybe_heartbeat()
         self.save()
         self.check_host_updates()
 

@@ -11,11 +11,13 @@ from __future__ import annotations
 import json
 import os
 import socket
+import threading
 import time
 import urllib.error
 import urllib.request
 from typing import Optional
 
+from .. import faults as _faults
 from ..common import logging as hlog
 from ..metrics import REGISTRY as _METRICS
 from ..runner import secret as _secret
@@ -27,6 +29,13 @@ _m_rendezvous = _METRICS.counter(
 _m_notify = _METRICS.counter(
     "hvd_elastic_notifications_total",
     "Membership-change notifications delivered to this worker.")
+_m_heartbeats = _METRICS.counter(
+    "hvd_elastic_heartbeats_total",
+    "Liveness heartbeats this worker delivered to the rendezvous.")
+_m_register_retries = _METRICS.counter(
+    "hvd_control_retries_total",
+    "Control-plane RPC retries after a transient failure, by op.",
+    ("op",))
 
 _listener: Optional["NotificationListener"] = None
 
@@ -71,38 +80,173 @@ def start_listener() -> int:
 def register_with_rendezvous() -> None:
     """Start the notification listener (once) and register its port
     with the driver's rendezvous so membership changes get pushed here
-    (reference: WorkerNotificationManager.init + registration)."""
+    (reference: WorkerNotificationManager.init + registration).
+
+    Registration is RETRIED with jittered exponential backoff
+    (HOROVOD_ELASTIC_REGISTER_RETRIES attempts): a single transient
+    failure here used to mean the worker permanently missed every
+    resize poke — it would train the job to completion in a stale
+    world while newly-published epochs waited on it forever. Only
+    after the retry budget is exhausted does it degrade to the old
+    warn-and-continue (the catch-up epoch check at the next
+    registration opportunity is then the last line of defense)."""
     addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "")
     if not addr:
         return
+    from ..runner.service import retry_backoff
     port = start_listener()
     me = os.environ.get("HOROVOD_HOSTNAME", socket.gethostname())
     lr = os.environ.get("HOROVOD_LOCAL_RANK", "0")
     path = f"/notify/{me}/{lr}"
     body = json.dumps({"port": port}).encode()
+    retries = int(os.environ.get(
+        "HOROVOD_ELASTIC_REGISTER_RETRIES", "5") or 0)
+    backoff = float(os.environ.get(
+        "HOROVOD_CONTROL_RETRY_BACKOFF", "0.2") or 0.2)
+    for attempt in range(retries + 1):
+        req = urllib.request.Request(
+            f"http://{addr}{path}", data=body, method="PUT",
+            headers={_secret.HEADER: _secret.sign(
+                _secret.from_env(), path.encode() + body)})
+        try:
+            _faults.fire("rendezvous.http", exc=OSError)
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                reply = json.loads(resp.read().decode() or "{}")
+            hlog.debug("elastic: registered notify port %d", port)
+            # Catch-up: if the world moved on while this worker was
+            # still starting (the driver's poke predates our
+            # listener), surface the missed membership change now so
+            # the next commit boundary resizes instead of training to
+            # completion in the old world.
+            cur = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", "0") or 0)
+            latest = int(reply.get("epoch", cur) or cur)
+            if latest != cur:
+                hlog.info("elastic: missed membership change "
+                          "(epoch %d -> %d); scheduling resize",
+                          cur, latest)
+                notifications.notify({"epoch": latest})
+            return
+        except (OSError, ValueError) as e:
+            # ValueError covers a malformed reply body (json/int
+            # parse); both are transient from here — retry.
+            if attempt >= retries:
+                hlog.warning(
+                    "elastic: notify registration failed after %d "
+                    "attempt(s): %s — this worker will miss resize "
+                    "pokes until it re-registers", attempt + 1, e)
+                return
+            _m_register_retries.labels(op="notify_register").inc()
+            hlog.warning("elastic: notify registration failed (%s); "
+                         "retry %d/%d", e, attempt + 1, retries)
+            time.sleep(retry_backoff(attempt, backoff))
+
+
+# -- worker-liveness heartbeats ---------------------------------------------
+# The driver's _monitor loop only ever saw proc.poll(): a worker that
+# hung (deadlocked collective, livelocked loop) while staying alive
+# stalled the whole gang forever. Workers now PUT a signed heartbeat
+# to the rendezvous — from a background pacer thread and (rate-
+# limited) at every commit boundary — and the driver treats a
+# heartbeat older than HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT as a hung
+# worker: kill, blacklist-candidate, gang restart, exactly the hard-
+# failure path a crash takes.
+
+_hb_thread: Optional[threading.Thread] = None
+_hb_stop = threading.Event()
+_hb_lock = threading.Lock()
+_hb_last = 0.0
+
+
+def heartbeat_timeout() -> float:
+    return float(os.environ.get(
+        "HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT", "0") or 0)
+
+
+def heartbeat_interval() -> float:
+    """Pacer period: explicit knob, else timeout/3 (three missed beats
+    before the driver calls it hung), floored at 0.5 s."""
+    iv = float(os.environ.get(
+        "HOROVOD_ELASTIC_HEARTBEAT_INTERVAL", "0") or 0)
+    if iv > 0:
+        return iv
+    return max(0.5, heartbeat_timeout() / 3.0)
+
+
+def _heartbeat_once(timeout: float = 3.0) -> bool:
+    """One best-effort signed heartbeat PUT. The rendezvous stamps
+    arrival time server-side, so worker/driver clock skew never fakes
+    a hang."""
+    addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "")
+    if not addr:
+        return False
+    me = os.environ.get("HOROVOD_HOSTNAME", socket.gethostname())
+    lr = os.environ.get("HOROVOD_LOCAL_RANK", "0")
+    path = f"/heartbeat/{me}/{lr}"
+    body = b"{}"
     req = urllib.request.Request(
         f"http://{addr}{path}", data=body, method="PUT",
         headers={_secret.HEADER: _secret.sign(
             _secret.from_env(), path.encode() + body)})
+    # The rate-limit anchor advances on every ATTEMPT, success or not:
+    # anchored to successes, an unreachable rendezvous (driver mid-
+    # gang-restart) would make every commit block on a failing connect
+    # up to the HTTP timeout — a 20x slowdown of a 100 ms step loop
+    # for the whole outage.
+    global _hb_last
+    with _hb_lock:
+        _hb_last = time.monotonic()
     try:
-        with urllib.request.urlopen(req, timeout=10) as resp:
-            reply = json.loads(resp.read().decode() or "{}")
-        hlog.debug("elastic: registered notify port %d", port)
-        # Catch-up: if the world moved on while this worker was still
-        # starting (the driver's poke predates our listener), surface
-        # the missed membership change now so the next commit boundary
-        # resizes instead of training to completion in the old world.
-        cur = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", "0") or 0)
-        latest = int(reply.get("epoch", cur) or cur)
-        if latest != cur:
-            hlog.info("elastic: missed membership change "
-                      "(epoch %d -> %d); scheduling resize", cur, latest)
-            notifications.notify({"epoch": latest})
-    except (OSError, ValueError) as e:
-        # ValueError covers a malformed reply body (json/int parse):
-        # registration stays best-effort warn-and-continue, never a
-        # startup crash.
-        hlog.warning("elastic: notify registration failed: %s", e)
+        with urllib.request.urlopen(req, timeout=timeout):
+            pass
+    except OSError as e:
+        hlog.debug("elastic: heartbeat failed: %s", e)
+        return False
+    _m_heartbeats.inc()
+    return True
+
+
+def _hb_loop() -> None:
+    while not _hb_stop.wait(heartbeat_interval()):
+        # Re-reads env every beat: a resize can reassign this worker's
+        # (hostname, local_rank) key, and the pacer must follow it.
+        _heartbeat_once()
+
+
+def start_heartbeat() -> bool:
+    """Start (once) the background heartbeat pacer; no-op when the
+    detector is disabled (timeout knob unset) or outside elastic runs."""
+    global _hb_thread
+    if heartbeat_timeout() <= 0:
+        return False
+    if not os.environ.get("HOROVOD_RENDEZVOUS_ADDR", ""):
+        return False
+    if _hb_thread is not None and _hb_thread.is_alive():
+        return True
+    _hb_stop.clear()
+    _hb_thread = threading.Thread(target=_hb_loop,
+                                  name="hvd-heartbeat", daemon=True)
+    _hb_thread.start()
+    return True
+
+
+def maybe_heartbeat() -> None:
+    """Commit-boundary beat, rate-limited to half the pacer interval
+    so a tight training loop does not turn every step into an HTTP
+    round-trip. No-op when the detector is off."""
+    if heartbeat_timeout() <= 0:
+        return
+    with _hb_lock:
+        due = time.monotonic() - _hb_last >= heartbeat_interval() / 2
+    if due:
+        _heartbeat_once(timeout=2.0)
+
+
+def suspend_heartbeat() -> None:
+    """Park the pacer (chaos testing: a REAL livelock — a native
+    deadlock holding the GIL — takes the pacer down with it; the
+    injected 'hang' action mirrors that by stopping the thread before
+    the main thread sleeps forever)."""
+    _hb_stop.set()
 
 
 def refresh_env_from_rendezvous() -> None:
@@ -114,33 +258,60 @@ def refresh_env_from_rendezvous() -> None:
     this worker to drain. Exit cleanly (reference: a removed host's
     workers simply end; the reference driver counts that as normal
     host removal, not failure). The brief retry absorbs the
-    publish/poke race on a loaded machine."""
+    publish/poke race on a loaded machine. Transient failures (socket
+    errors, 5xx) retry under their own longer deadline — one dropped
+    HTTP round-trip must not turn a routine resize into a worker
+    death."""
     addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "")
     if not addr:
         return
+    from ..runner.service import retry_backoff
     _m_rendezvous.inc()
     me = os.environ.get("HOROVOD_HOSTNAME", socket.gethostname())
     lr = os.environ.get("HOROVOD_LOCAL_RANK", "0")
     path = f"/rank/{me}/{lr}"
+    backoff = float(os.environ.get(
+        "HOROVOD_CONTROL_RETRY_BACKOFF", "0.2") or 0.2)
     deadline = time.time() + 10.0
+    err_deadline = time.time() + 60.0
+    err_attempt = 0
     while True:
         req = urllib.request.Request(
             f"http://{addr}{path}",
             headers={_secret.HEADER: _secret.sign(
                 _secret.from_env(), path.encode())})
         try:
+            _faults.fire("rendezvous.http", exc=OSError)
             with urllib.request.urlopen(req, timeout=30) as resp:
                 assignment = json.loads(resp.read().decode())
             break
         except urllib.error.HTTPError as e:
-            if e.code != 404:
+            if e.code == 404:
+                if time.time() > deadline:
+                    hlog.info("elastic: no assignment for %s:%s in "
+                              "the new world — removed by resize; "
+                              "exiting", me, lr)
+                    raise SystemExit(0)
+                # 404 while the driver publishes is a POLL cadence,
+                # not a failure retry — fixed half-second re-ask.
+                time.sleep(0.5)
+                continue
+            if e.code >= 500 and time.time() < err_deadline:
+                _m_register_retries.labels(op="rank_poll").inc()
+                hlog.warning("elastic: rendezvous re-poll got %d; "
+                             "retrying", e.code)
+            else:
                 raise
-            if time.time() > deadline:
-                hlog.info("elastic: no assignment for %s:%s in the "
-                          "new world — removed by resize; exiting",
-                          me, lr)
-                raise SystemExit(0)
-            time.sleep(0.5)
+            time.sleep(retry_backoff(err_attempt, backoff))
+            err_attempt += 1
+        except OSError as e:
+            if time.time() > err_deadline:
+                raise
+            _m_register_retries.labels(op="rank_poll").inc()
+            hlog.warning("elastic: rendezvous re-poll failed (%s); "
+                         "retrying", e)
+            time.sleep(retry_backoff(err_attempt, backoff))
+            err_attempt += 1
     for k, v in assignment.items():
         os.environ[k] = str(v)
     hlog.info("elastic: refreshed assignment: %s", assignment)

@@ -1,0 +1,282 @@
+"""Deterministic fault injection for chaos testing.
+
+The reference's fault-tolerance story is tested with hand-rolled rank
+suicide (test/integration/elastic_common.py kills a worker at a step);
+every other failure mode — dropped control-plane frames, flaky
+rendezvous HTTP, discovery script outages, hung-but-alive workers —
+ships untested. This module gives every recovery seam a NAMED injection
+point with a compact spec grammar so a single env var can drive a
+reproducible failure schedule through the real code paths:
+
+    HOROVOD_FAULTS="wire.send:drop:p=0.05;elastic.step:crash:at=40"
+    HOROVOD_FAULTS_SEED=7
+
+Grammar: rules separated by ";", each rule "point:action[:params]"
+with params "k=v" separated by ",". Params:
+
+    p=F       fire with probability F per hit (seeded, deterministic)
+    at=N      fire on exactly the Nth hit of the point (1-based)
+    after=N   eligible only after N hits
+    every=N   fire on every Nth hit
+    times=M   stop after M fires (0 = unlimited)
+    rank=R    fire only in the process whose HOROVOD_RANK is R
+    ms=F      delay duration for the "delay" action (default 100)
+    once=PATH filesystem latch: fire at most once ACROSS process
+              restarts (a gang restart re-arms schedules from env;
+              the latch is how "crash exactly once" survives it)
+
+Actions: "delay" (sleep, applied inside fire), "error" (raise the
+seam's exception class), "crash" (os._exit(43)), "drop" / "corrupt" /
+"hang" (returned to the seam, which implements the data-plane effect —
+a dropped wire frame, a flipped byte, a parked worker). Each point
+only accepts the actions its seam implements (see POINTS); the parser
+rejects the rest so a spec can never log fires that inject nothing.
+
+Determinism: each rule owns a private random.Random seeded from
+(HOROVOD_FAULTS_SEED, point, action, rule index), so one point's
+firing schedule never depends on how often other points were hit.
+Re-running with the same spec + seed reproduces the schedule exactly.
+
+Fast path: with HOROVOD_FAULTS unset the module plan is None and
+fire() is one attribute load + compare — the same always-on/no-op
+contract as the metrics registry's fast path (metrics.py), guarded by
+the same style of overhead test.
+
+Every fire is counted in hvd_faults_fired_total{point,action} and
+logged at WARNING with its hit number, so a failure seen in the wild
+can be replayed from the log line + seed.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .common import logging as hlog
+from .metrics import REGISTRY as _METRICS
+
+_m_fired = _METRICS.counter(
+    "hvd_faults_fired_total",
+    "Injected faults fired, by injection point and action.",
+    ("point", "action"))
+
+# Named injection points threaded through the real seams, each with
+# the actions its seam actually implements. Parsing rejects anything
+# else — unknown points, unknown actions, AND known actions at seams
+# that would silently ignore them — so a typo'd or unimplementable
+# spec fails loudly at arm time instead of logging fires that inject
+# nothing. delay/error/crash act inside fire() and work everywhere;
+# drop/corrupt/hang are returned to the seam, so they are only legal
+# where the seam interprets them.
+POINTS: Dict[str, frozenset] = {
+    # runner/service.py send_frame: swallows "drop", flips a byte on
+    # "corrupt".
+    "wire.send": frozenset({"drop", "corrupt", "delay", "error",
+                            "crash"}),
+    # runner/service.py recv_frame: "drop" raises WireError (lost
+    # frame as seen from the reader).
+    "wire.recv": frozenset({"drop", "delay", "error", "crash"}),
+    # elastic/worker.py rendezvous HTTP requests.
+    "rendezvous.http": frozenset({"delay", "error", "crash"}),
+    # runner/elastic/discovery.py host discovery.
+    "discovery.poll": frozenset({"delay", "error", "crash"}),
+    # elastic/state.py commit boundary: "hang" parks the worker with
+    # its heartbeat pacer stopped; "error" raises
+    # HorovodInternalError.
+    "elastic.step": frozenset({"delay", "error", "crash", "hang"}),
+    # ops/dispatch.py collective entry.
+    "dispatch.entry": frozenset({"delay", "error", "crash"}),
+}
+
+ACTIONS = frozenset().union(*POINTS.values())
+
+CRASH_EXIT_CODE = 43
+
+
+class FaultInjected(RuntimeError):
+    """Default exception for the "error" action when the seam does not
+    name a more natural class (seams pass exc=OSError etc. so injected
+    errors travel the same handling path as real ones)."""
+
+
+class _Rule:
+    def __init__(self, point: str, action: str,
+                 params: Dict[str, str], seed: int, index: int):
+        import random
+        self.point = point
+        self.action = action
+        params = dict(params)
+        try:
+            self.p = float(params.pop("p", 1.0))
+            self.at = int(params.pop("at", 0))
+            self.after = int(params.pop("after", 0))
+            self.every = int(params.pop("every", 0))
+            self.times = int(params.pop("times", 0))
+            self.ms = float(params.pop("ms", 100.0))
+            rank = params.pop("rank", None)
+            self.rank = int(rank) if rank is not None else None
+            self.once = params.pop("once", None)
+        except ValueError as e:
+            raise ValueError(
+                f"bad fault param value in {point}:{action}: {e}")
+        if params:
+            raise ValueError(
+                f"unknown fault param(s) {sorted(params)} in "
+                f"{point}:{action}")
+        if not 0.0 <= self.p <= 1.0:
+            raise ValueError(f"fault p={self.p} outside [0, 1]")
+        self.hits = 0
+        self.fired = 0
+        # Private stream per rule: schedules are independent of other
+        # points' traffic and reproducible from (seed, point, action,
+        # index) alone.
+        self.rng = random.Random(f"{seed}:{point}:{action}:{index}")
+
+    def should_fire(self) -> bool:
+        """Called under the plan lock; advances the hit counter."""
+        self.hits += 1
+        if self.rank is not None:
+            if os.environ.get("HOROVOD_RANK", "") != str(self.rank):
+                return False
+        if self.times and self.fired >= self.times:
+            return False
+        if self.at:
+            if self.hits != self.at:
+                return False
+        elif self.after and self.hits <= self.after:
+            return False
+        elif self.every and self.hits % self.every != 0:
+            return False
+        if self.p < 1.0 and self.rng.random() >= self.p:
+            return False
+        if self.once:
+            # Cross-restart latch: O_EXCL create is the atomic
+            # test-and-set (same idiom as the elastic tests' die
+            # markers), so a respawned process re-armed from env does
+            # not re-fire an exactly-once fault.
+            try:
+                fd = os.open(self.once,
+                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                os.close(fd)
+            except FileExistsError:
+                return False
+        self.fired += 1
+        return True
+
+
+class _Plan:
+    def __init__(self, rules: List[_Rule], spec: str, seed: int):
+        self.spec = spec
+        self.seed = seed
+        self._lock = threading.Lock()
+        self._by_point: Dict[str, List[_Rule]] = {}
+        for r in rules:
+            self._by_point.setdefault(r.point, []).append(r)
+
+    def fire(self, point: str, exc) -> Optional[str]:
+        rules = self._by_point.get(point)
+        if not rules:
+            return None
+        for rule in rules:
+            with self._lock:
+                go = rule.should_fire()
+                hits, fired = rule.hits, rule.fired
+            if not go:
+                continue
+            _m_fired.labels(point=point, action=rule.action).inc()
+            hlog.warning("faults: firing %s at %s (hit %d, fired %d)",
+                         rule.action, point, hits, fired)
+            if rule.action == "delay":
+                time.sleep(rule.ms / 1000.0)
+                return "delay"
+            if rule.action == "error":
+                raise (exc or FaultInjected)(
+                    f"injected fault at {point}")
+            if rule.action == "crash":
+                os._exit(CRASH_EXIT_CODE)
+            return rule.action      # drop / corrupt / hang: seam's job
+        return None
+
+
+_plan: Optional[_Plan] = None
+
+
+def parse(spec: str, seed: int = 0) -> List[_Rule]:
+    """Parse a fault spec into rules; raises ValueError on anything
+    malformed (unknown point/action/param, bad numbers, empty rule)."""
+    rules: List[_Rule] = []
+    for i, raw in enumerate(spec.split(";")):
+        raw = raw.strip()
+        if not raw:
+            continue
+        parts = raw.split(":")
+        if len(parts) < 2 or len(parts) > 3:
+            raise ValueError(
+                f"bad fault rule {raw!r}: want point:action[:params]")
+        point, action = parts[0].strip(), parts[1].strip()
+        if point not in POINTS:
+            raise ValueError(
+                f"unknown fault point {point!r} (known: "
+                f"{sorted(POINTS)})")
+        if action not in ACTIONS:
+            raise ValueError(
+                f"unknown fault action {action!r} (known: "
+                f"{sorted(ACTIONS)})")
+        if action not in POINTS[point]:
+            raise ValueError(
+                f"fault action {action!r} is not implemented at "
+                f"{point!r} (supported there: "
+                f"{sorted(POINTS[point])})")
+        params: Dict[str, str] = {}
+        if len(parts) == 3 and parts[2].strip():
+            for kv in parts[2].split(","):
+                if "=" not in kv:
+                    raise ValueError(
+                        f"bad fault param {kv!r} in {raw!r}: want k=v")
+                k, v = kv.split("=", 1)
+                params[k.strip()] = v.strip()
+        rules.append(_Rule(point, action, params, seed, i))
+    return rules
+
+
+def configure(spec: Optional[str], seed: int = 0) -> None:
+    """Arm (or, with a falsy spec, disarm) the module plan."""
+    global _plan
+    if not spec:
+        _plan = None
+        return
+    plan = _Plan(parse(spec, seed), spec, seed)
+    _plan = plan
+    hlog.warning("faults: armed spec=%r seed=%d (reproduce with "
+                 "HOROVOD_FAULTS=%r HOROVOD_FAULTS_SEED=%d)",
+                 spec, seed, spec, seed)
+
+
+def configure_from_env() -> None:
+    spec = os.environ.get("HOROVOD_FAULTS", "")
+    seed = int(os.environ.get("HOROVOD_FAULTS_SEED", "0") or 0)
+    configure(spec, seed)
+
+
+def active() -> bool:
+    return _plan is not None
+
+
+def fire(point: str, exc=None) -> Optional[str]:
+    """The seam entry. Disarmed: one load + compare, nanoseconds
+    (guarded by test_faults.py's overhead test). Armed: evaluates the
+    point's rules; "delay" sleeps here, "error" raises `exc` (or
+    FaultInjected), "crash" exits the process, and "drop" / "corrupt" /
+    "hang" are returned for the seam to apply."""
+    plan = _plan
+    if plan is None:
+        return None
+    return plan.fire(point, exc)
+
+
+# Arm from the environment at import: workers, the elastic driver and
+# the launcher all inherit HOROVOD_FAULTS through the forwarded env,
+# so every process in the job runs the same (seeded) schedule.
+configure_from_env()

@@ -26,6 +26,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import faults as _faults
 from ..common.compat import shard_map
 from ..metrics import record_collective as _record_collective
 from .process_set import ProcessSet
@@ -61,7 +62,12 @@ def _count(kind: str, pset: ProcessSet, tensors) -> None:
     """Per-collective-kind / per-process-set metrics seam: raw local
     payload bytes + tensor counts, recorded once per dispatch entry
     (group helpers count here; single-tensor wrappers count only on
-    their non-delegating paths so nothing is double-counted)."""
+    their non-delegating paths so nothing is double-counted). Also
+    the chaos seam for the data plane — delay/error at dispatch entry
+    models a stalled or failing collective launch; a module-level
+    no-op when HOROVOD_FAULTS is unset (guarded by the same style of
+    overhead test as the metrics fast path)."""
+    _faults.fire("dispatch.entry")
     _record_collective(kind, pset.process_set_id, _raw_nbytes(tensors),
                        len(tensors))
 

@@ -1,7 +1,7 @@
 """Launcher-side elastic machinery (reference: horovod/runner/elastic/)."""
 
 from .discovery import (  # noqa: F401
-    FixedHosts, HostDiscovery, HostDiscoveryScript,
+    FixedHosts, HostDiscovery, HostDiscoveryScript, ResilientDiscovery,
 )
 from .driver import ElasticDriver  # noqa: F401
 from .rendezvous import RendezvousServer  # noqa: F401

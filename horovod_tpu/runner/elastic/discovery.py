@@ -9,9 +9,21 @@ diffs the result to detect added/removed hosts.
 from __future__ import annotations
 
 import subprocess
+import time
 from typing import Dict, List
 
+from ... import faults as _faults
+from ...common import logging as hlog
+from ...metrics import REGISTRY as _METRICS
 from ..hosts import HostSlots, parse_hosts
+
+_m_failures = _METRICS.counter(
+    "hvd_discovery_failures_total",
+    "Host-discovery poll failures (script error, timeout, injected).")
+_m_stale = _METRICS.counter(
+    "hvd_discovery_stale_serves_total",
+    "Discovery polls answered from the last-known-good host list "
+    "because the live poll failed inside the staleness window.")
 
 
 class HostDiscovery:
@@ -27,6 +39,7 @@ class FixedHosts(HostDiscovery):
             [HostSlots("localhost", np_)]
 
     def find_available_hosts_and_slots(self) -> List[HostSlots]:
+        _faults.fire("discovery.poll", exc=RuntimeError)
         return list(self._hosts)
 
 
@@ -39,6 +52,7 @@ class HostDiscoveryScript(HostDiscovery):
         self.timeout = timeout
 
     def find_available_hosts_and_slots(self) -> List[HostSlots]:
+        _faults.fire("discovery.poll", exc=RuntimeError)
         r = subprocess.run([self.script], capture_output=True,
                            text=True, timeout=self.timeout, shell=False)
         if r.returncode != 0:
@@ -56,6 +70,44 @@ class HostDiscoveryScript(HostDiscovery):
             else:
                 out.append(HostSlots(line, 1))
         return out
+
+
+class ResilientDiscovery(HostDiscovery):
+    """Circuit breaker over any HostDiscovery: consecutive poll
+    failures are answered from the last successful result for up to
+    `staleness_window` seconds (a flaky discovery script — cloud API
+    blip, cron race — must not look like a membership change or crash
+    the driver), then start propagating again so a genuinely dead
+    discovery source cannot serve phantom hosts forever."""
+
+    def __init__(self, inner: HostDiscovery,
+                 staleness_window: float = 60.0):
+        self.inner = inner
+        self.staleness_window = float(staleness_window)
+        self.consecutive_failures = 0
+        self._last_good: List[HostSlots] = []
+        self._last_good_time = 0.0
+
+    def find_available_hosts_and_slots(self) -> List[HostSlots]:
+        try:
+            hosts = self.inner.find_available_hosts_and_slots()
+        except Exception as e:  # noqa: BLE001 — scripts fail arbitrarily
+            self.consecutive_failures += 1
+            _m_failures.inc()
+            age = time.time() - self._last_good_time
+            if self._last_good_time and age <= self.staleness_window:
+                _m_stale.inc()
+                hlog.warning(
+                    "discovery: poll failed (%s; failure %d); serving "
+                    "last-known-good hosts (%.1fs old, window %.0fs)",
+                    e, self.consecutive_failures, age,
+                    self.staleness_window)
+                return list(self._last_good)
+            raise
+        self.consecutive_failures = 0
+        self._last_good = list(hosts)
+        self._last_good_time = time.time()
+        return hosts
 
 
 def hosts_key(hosts: List[HostSlots]) -> Dict[str, int]:

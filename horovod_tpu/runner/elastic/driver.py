@@ -34,15 +34,25 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from ...common import logging as hlog
+from ...metrics import REGISTRY as _METRICS
 from .. import secret as _secret
 from ..hosts import HostSlots, RankInfo, assign_ranks
 from ..launch import (_prefix_pump, _ssh_command,
                       _write_env_stdin, free_port)
 from ..service import BasicClient
-from .discovery import HostDiscovery, hosts_key
+from .discovery import HostDiscovery, ResilientDiscovery, hosts_key
 from .rendezvous import RendezvousServer
 
 import os
+
+_m_blacklisted = _METRICS.gauge(
+    "hvd_elastic_blacklisted_hosts",
+    "Hosts currently inside their blacklist window (flapping hosts "
+    "show up here as a persistently nonzero gauge).")
+_m_hung = _METRICS.counter(
+    "hvd_elastic_hung_workers_total",
+    "Workers killed by the liveness detector after their rendezvous "
+    "heartbeat went stale (hung-but-alive, recovered like a crash).")
 
 
 class _Slot:
@@ -61,7 +71,14 @@ class ElasticDriver:
                  env: Optional[Dict[str, str]] = None,
                  verbose: bool = False):
         self.command = command
-        self.discovery = discovery
+        # Circuit breaker: consecutive discovery failures are served
+        # from the last-known-good host list for a bounded staleness
+        # window (HOROVOD_DISCOVERY_STALENESS_WINDOW) before failures
+        # start propagating to the per-call-site handling below.
+        _env = dict(env if env is not None else os.environ)
+        self.discovery = ResilientDiscovery(
+            discovery, staleness_window=float(_env.get(
+                "HOROVOD_DISCOVERY_STALENESS_WINDOW", "60") or 60))
         self.min_np = min_np
         self.max_np = max_np
         self.poll_interval = poll_interval
@@ -81,7 +98,19 @@ class ElasticDriver:
         self.slots: Dict[Tuple[str, int], _Slot] = {}
         self._io_lock = threading.Lock()
         self.blacklist: Dict[str, float] = {}  # host -> until timestamp
-        self.blacklist_window = 60.0
+        # Escalating blacklist: a flat window let a flapping host
+        # rejoin every 60 s and re-kill the gang forever. The window
+        # doubles per repeated failure of the SAME host, capped.
+        self.blacklist_window = float(_env.get(
+            "HOROVOD_ELASTIC_BLACKLIST_WINDOW", "60") or 60)
+        self.blacklist_window_max = float(_env.get(
+            "HOROVOD_ELASTIC_BLACKLIST_WINDOW_MAX", "900") or 900)
+        self._host_failures: Dict[str, int] = {}
+        # Liveness detector: a rendezvous heartbeat older than this is
+        # a hung worker (0 disables — detection requires workers to
+        # heartbeat, which the same knob switches on worker-side).
+        self.heartbeat_timeout = float(_env.get(
+            "HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT", "0") or 0)
         # Removed-slot drain: (host, local_rank) -> (_Slot, deadline).
         self._draining: Dict[Tuple[str, int], Tuple[_Slot, float]] = {}
         self.drain_grace = float(
@@ -92,9 +121,18 @@ class ElasticDriver:
     def _discover(self) -> List[HostSlots]:
         hosts = self.discovery.find_available_hosts_and_slots()
         now = time.time()
+        _m_blacklisted.set(
+            sum(1 for t in self.blacklist.values() if t >= now))
         live = [h for h in hosts
                 if self.blacklist.get(h.host, 0) < now]
         return live
+
+    def _blacklist_window_for(self, host: str) -> float:
+        """Current window for `host` given its failure count so far
+        (exponential per repeated failure, capped)."""
+        n = self._host_failures.get(host, 0)
+        return min(self.blacklist_window * (2 ** max(0, n - 1)),
+                   self.blacklist_window_max)
 
     def _world_np(self, hosts: List[HostSlots]) -> int:
         total = sum(h.slots for h in hosts)
@@ -178,7 +216,8 @@ class ElasticDriver:
                 continue
             cli = BasicClient(host, port, self.secret, timeout=5.0)
             if cli.try_request({"type": "hosts_updated",
-                                "epoch": self.epoch}) is None:
+                                "epoch": self.epoch},
+                               retries=2) is None:
                 hlog.debug("elastic: notify %s:%d unreachable", host, lr)
 
     def _publish_epoch(self, hosts: List[HostSlots]
@@ -238,6 +277,10 @@ class ElasticDriver:
                     self.rendezvous.drop_notify(key)
             cur = self.slots.get(key)
             if cur is None or cur.proc.poll() is not None:
+                # Fresh incarnation: a heartbeat left over from the
+                # slot's previous process must not age into a "hung"
+                # verdict against the new one before its first beat.
+                self.rendezvous.clear_heartbeat(key)
                 self.slots[key] = self._spawn(info, dict(table[key]))
 
     def _reap_draining(self) -> None:
@@ -264,7 +307,15 @@ class ElasticDriver:
     def run(self) -> int:
         deadline0 = time.time() + self.elastic_timeout
         while True:
-            hosts = self._discover()
+            # Guarded like every other discovery call site: one
+            # transient script failure at startup retries until
+            # elastic_timeout instead of crashing the driver.
+            try:
+                hosts = self._discover()
+            except Exception as e:
+                hlog.warning("elastic: initial discovery failed: %s; "
+                             "retrying until elastic timeout", e)
+                hosts = []
             if self._world_np(hosts) >= self.min_np:
                 break
             if time.time() > deadline0:
@@ -288,12 +339,55 @@ class ElasticDriver:
                     slot.proc.kill()
             self.rendezvous.stop()
 
+    def _check_hung_workers(self) -> None:
+        """Liveness detector: kill any still-running worker whose
+        rendezvous heartbeat is older than the timeout. The kill is
+        the whole intervention — the next _monitor pass sees the
+        nonzero exit and runs the ordinary hard-failure path
+        (blacklist candidate + gang restart), so livelock recovery IS
+        crash recovery. Slots with no heartbeat on record are skipped:
+        a worker still initializing (or one predating the detector)
+        must not be shot before its first beat.
+
+        Known limitation: for ssh-spawned workers the kill reaches
+        the LOCAL ssh transport; with no tty allocated the remote
+        hung process gets no signal and only dies when it next
+        touches the closed pipe (which a fully-hung process may
+        never do) or when the gang teardown collapses its
+        coordination service. The failure path's host blacklist is
+        the designed mitigation — the escalating window steers the
+        restart away from the host still holding a zombie."""
+        now = time.time()
+        beats = self.rendezvous.heartbeats()
+        for key, slot in self.slots.items():
+            hb = beats.get(key)
+            if hb is None or slot.proc.poll() is not None:
+                continue
+            age = now - hb
+            if age > self.heartbeat_timeout:
+                hlog.warning(
+                    "elastic: worker %s:%d heartbeat stale "
+                    "(%.1fs > %.1fs); killing hung worker",
+                    key[0], key[1], age, self.heartbeat_timeout)
+                if not slot.info.is_local:
+                    hlog.warning(
+                        "elastic: %s is a remote slot — the ssh "
+                        "transport dies now but the hung remote "
+                        "process may linger until the gang teardown "
+                        "reaps it; relying on the host blacklist to "
+                        "steer the restart elsewhere", key[0])
+                _m_hung.inc()
+                self.rendezvous.clear_heartbeat(key)
+                slot.proc.kill()
+
     def _monitor(self, current: Dict[str, int]) -> int:
         last_poll = 0.0
         while True:
             time.sleep(0.1)
             if self._draining:
                 self._reap_draining()
+            if self.heartbeat_timeout > 0:
+                self._check_hung_workers()
 
             # 1) process exits
             exited = {k: s for k, s in self.slots.items()
@@ -353,10 +447,16 @@ class ElasticDriver:
                     # Blacklist failing hosts — but never below
                     # min_np capacity (a single-host job must restart
                     # on the same host, not starve out the window).
+                    # The window escalates exponentially per repeated
+                    # failure of the same host (capped), so a
+                    # flapping host cannot rejoin-and-kill on a fixed
+                    # cadence forever.
                     for host in {k[0] for k in bad}:
+                        self._host_failures[host] = \
+                            self._host_failures.get(host, 0) + 1
+                        window = self._blacklist_window_for(host)
                         proposed = dict(self.blacklist)
-                        proposed[host] = time.time() + \
-                            self.blacklist_window
+                        proposed[host] = time.time() + window
                         try:
                             avail = (self.discovery
                                      .find_available_hosts_and_slots())
@@ -370,6 +470,10 @@ class ElasticDriver:
                             if proposed.get(h.host, 0) < time.time()]
                         if self._world_np(remaining) >= self.min_np:
                             self.blacklist = proposed
+                            hlog.warning(
+                                "elastic: blacklisting %s for %.0fs "
+                                "(failure %d of this host)", host,
+                                window, self._host_failures[host])
                         else:
                             hlog.info(
                                 "elastic: not blacklisting %s (would "

@@ -12,6 +12,10 @@ Endpoints:
   GET /world                     -> {"epoch": N, "size": M}
   PUT /notify/<host>/<local_rank> body={"port": p} -> register the
                                     worker's notification listener
+  PUT /heartbeat/<host>/<local_rank> -> record worker liveness; the
+                                    arrival time is stamped SERVER-
+                                    side so worker clock skew cannot
+                                    fake (or mask) a hang
 
 Every request must carry an HMAC of the path (GET) or path+body (PUT)
 in the X-HVD-Auth header, keyed on the launcher-generated job secret
@@ -25,6 +29,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
@@ -41,6 +46,8 @@ class _State:
         self.assignments: Dict[Tuple[str, int], Dict[str, str]] = {}
         # (host, local_rank) -> notify port
         self.notify_ports: Dict[Tuple[str, int], int] = {}
+        # (host, local_rank) -> server-clock time of last heartbeat
+        self.heartbeats: Dict[Tuple[str, int], float] = {}
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -103,6 +110,11 @@ class _Handler(BaseHTTPRequestHandler):
             # to completion in the stale world while newly-spawned
             # ranks wait forever for a coordinator that never binds.
             self._json(200, {"ok": True, "epoch": epoch})
+        elif len(parts) == 3 and parts[0] == "heartbeat":
+            key = (parts[1], int(parts[2]))
+            with st.lock:
+                st.heartbeats[key] = time.time()
+            self._json(200, {"ok": True})
         else:
             self._json(404, {"error": "not found"})
 
@@ -134,6 +146,17 @@ class RendezvousServer:
     def drop_notify(self, key: Tuple[str, int]) -> None:
         with self._state.lock:
             self._state.notify_ports.pop(key, None)
+
+    def heartbeats(self) -> Dict[Tuple[str, int], float]:
+        with self._state.lock:
+            return dict(self._state.heartbeats)
+
+    def clear_heartbeat(self, key: Tuple[str, int]) -> None:
+        """Forget a slot's liveness record. Called at every (re)spawn:
+        a stale beat from the slot's PREVIOUS incarnation must not get
+        the fresh process killed as hung before its first beat."""
+        with self._state.lock:
+            self._state.heartbeats.pop(key, None)
 
     def stop(self) -> None:
         self._httpd.shutdown()

@@ -417,6 +417,12 @@ def make_parser() -> argparse.ArgumentParser:
                    default=1.0)
     p.add_argument("--reset-limit", type=int, default=0)
     p.add_argument("--elastic-timeout", type=float, default=600.0)
+    p.add_argument("--heartbeat-timeout", type=float, default=None,
+                   help="worker-liveness failure detector: kill and "
+                        "gang-restart a worker whose rendezvous "
+                        "heartbeat is older than this many seconds "
+                        "(HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT; elastic "
+                        "mode only, default off)")
 
     # Tuning/diagnostic flags mirroring HOROVOD_* env knobs, forwarded
     # to every rank (reference: horovodrun's ~80-flag surface in
@@ -570,6 +576,11 @@ def main(argv: Optional[List[str]] = None) -> int:
               "path and will be ignored", file=sys.stderr)
     if args.host_discovery_script:
         from .elastic import ElasticDriver, HostDiscoveryScript
+        if args.heartbeat_timeout is not None:
+            # Rides the env so both the driver (detector) and the
+            # workers (heartbeat pacer) read the same knob.
+            env["HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT"] = \
+                str(args.heartbeat_timeout)
         min_np = args.min_num_proc if args.min_num_proc is not None \
             else args.num_proc
         driver = ElasticDriver(

@@ -16,16 +16,26 @@ spawning worker ranks.
 from __future__ import annotations
 
 import json
+import os
+import random
 import socket
 import struct
 import threading
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
+from .. import faults as _faults
 from ..common import logging as hlog
+from ..metrics import REGISTRY as _METRICS
 from . import secret as _secret
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 16 << 20
+
+_m_retries = _METRICS.counter(
+    "hvd_control_retries_total",
+    "Control-plane RPC retries after a transient failure, by op.",
+    ("op",))
 
 
 class WireError(RuntimeError):
@@ -48,15 +58,40 @@ def send_frame(sock: socket.socket, secret: str, obj: Any) -> None:
         "payload": payload.decode(),
         "sig": _secret.sign(secret, payload),
     }).encode()
+    # Injection seam: "drop" swallows the frame (the peer sees a
+    # timeout or EOF mid-frame — what a lost packet looks like from
+    # the app layer); "corrupt" flips a payload byte so the receiver's
+    # HMAC check rejects it; "error"/"delay"/"crash" act inside fire.
+    act = _faults.fire("wire.send", exc=OSError)
+    if act == "drop":
+        return
+    if act == "corrupt":
+        frame = bytes([frame[len(frame) // 2] ^ 0xFF]).join(
+            (frame[: len(frame) // 2], frame[len(frame) // 2 + 1:]))
     sock.sendall(_LEN.pack(len(frame)) + frame)
 
 
 def recv_frame(sock: socket.socket, secret: str) -> Any:
+    act = _faults.fire("wire.recv", exc=WireError)
+    if act == "drop":
+        raise WireError("injected fault: frame dropped")
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     if n > MAX_FRAME:
         raise WireError(f"frame too large ({n} bytes)")
-    msg = json.loads(_recv_exact(sock, n).decode())
+    raw = _recv_exact(sock, n)
+    # A garbled frame (corruption, a non-protocol peer) must surface
+    # as WireError — the one class every handler/retry path catches —
+    # not as a raw UnicodeDecodeError/JSONDecodeError killing the
+    # server's handler thread (found by the wire.send corrupt fault).
+    try:
+        msg = json.loads(raw.decode())
+    except (UnicodeDecodeError, ValueError) as e:
+        raise WireError(f"undecodable frame: {e}")
+    if not isinstance(msg, dict):
+        raise WireError("malformed frame (not an object)")
     payload = msg.get("payload", "")
+    if not isinstance(payload, str):
+        raise WireError("malformed frame (non-string payload)")
     if not _secret.verify(secret, payload.encode(), msg.get("sig", "")):
         raise WireError("bad signature")
     return json.loads(payload) if payload else None
@@ -118,8 +153,15 @@ class BasicService:
             except WireError as e:
                 hlog.warning("%s service: rejected request from %s: %s",
                              self.name, peer[0], e)
+                # "denied" is reserved for auth mismatch (a bad secret
+                # does not heal — the client must fail fast, never
+                # retry). A garbled/truncated frame is transient wire
+                # damage and gets "bad_frame", which the client maps
+                # back to a retryable WireError.
+                kind = ("denied" if "signature" in str(e)
+                        else "bad_frame")
                 try:
-                    send_frame(conn, self._secret, {"error": "denied"})
+                    send_frame(conn, self._secret, {"error": kind})
                 except OSError:
                     pass
                 return
@@ -161,8 +203,20 @@ class BasicService:
             pass
 
 
+def retry_backoff(attempt: int, base: float = 0.2,
+                  cap: float = 5.0) -> float:
+    """Jittered exponential backoff delay for retry `attempt` (0-based):
+    base * 2^attempt, capped, scaled by a uniform [0.5, 1.5) jitter so
+    a gang of workers retrying the same dead endpoint does not
+    re-stampede it in lockstep."""
+    return min(base * (2 ** attempt), cap) * random.uniform(0.5, 1.5)
+
+
 class BasicClient:
-    """One-shot request/response client for a BasicService."""
+    """Request/response client for a BasicService. One-shot by
+    default; `retries`/`backoff` turn a transient connect/wire failure
+    into a jittered-exponential-backoff retry loop (an authentication
+    denial is never retried — a bad secret does not heal)."""
 
     def __init__(self, addr: str, port: int, secret: str,
                  timeout: float = 10.0):
@@ -170,17 +224,43 @@ class BasicClient:
         self._secret = secret
         self._timeout = timeout
 
-    def request(self, obj: dict) -> Any:
-        with socket.create_connection(self._addr,
-                                      timeout=self._timeout) as s:
-            send_frame(s, self._secret, obj)
-            reply = recv_frame(s, self._secret)
-        if isinstance(reply, dict) and reply.get("error") == "denied":
-            raise WireError("request denied (bad signature)")
-        return reply
+    def request(self, obj: dict, retries: int = 0,
+                backoff: Optional[float] = None) -> Any:
+        if backoff is None:
+            backoff = float(os.environ.get(
+                "HOROVOD_CONTROL_RETRY_BACKOFF", "0.2") or 0.2)
+        attempt = 0
+        while True:
+            try:
+                with socket.create_connection(
+                        self._addr, timeout=self._timeout) as s:
+                    send_frame(s, self._secret, obj)
+                    reply = recv_frame(s, self._secret)
+                if isinstance(reply, dict) and \
+                        reply.get("error") == "denied":
+                    raise WireError("request denied (bad signature)")
+                if isinstance(reply, dict) and \
+                        reply.get("error") == "bad_frame":
+                    # The peer rejected our frame as garbled —
+                    # transient wire damage, retryable (unlike a
+                    # denial, which no retry can fix).
+                    raise WireError("peer rejected frame as garbled")
+                return reply
+            except (OSError, WireError) as e:
+                if isinstance(e, WireError) and "denied" in str(e):
+                    raise
+                if attempt >= retries:
+                    raise
+                _m_retries.labels(op="request").inc()
+                hlog.debug("client: retrying %s:%d after %s "
+                           "(attempt %d/%d)", self._addr[0],
+                           self._addr[1], e, attempt + 1, retries)
+                time.sleep(retry_backoff(attempt, backoff))
+                attempt += 1
 
-    def try_request(self, obj: dict) -> Optional[Any]:
+    def try_request(self, obj: dict, retries: int = 0,
+                    backoff: Optional[float] = None) -> Optional[Any]:
         try:
-            return self.request(obj)
+            return self.request(obj, retries=retries, backoff=backoff)
         except (OSError, WireError):
             return None

@@ -55,6 +55,11 @@ def pytest_configure(config):
         "markers", "integration: spawns real subprocesses")
     config.addinivalue_line(
         "markers",
+        "slow: long randomized soaks, excluded from tier-1 "
+        "(`pytest -m 'not slow'`); the fast fixed-seed chaos tests "
+        "stay in tier-1 so the fault seams cannot silently rot")
+    config.addinivalue_line(
+        "markers",
         "smoke: fast cross-subsystem tier (`pytest -m smoke`, ~2-3 "
         "min on the 1-core CI host) — one or two representatives per "
         "subsystem, for drivers that cannot afford the full suite")

@@ -87,6 +87,15 @@ def main():
     train(state)
     log_line(f"done world {hvd.size()} rank {hvd.rank()} "
              f"w0 {float(state.params['w'][0]):.1f}")
+    # Chaos-test accounting: how many injected faults THIS incarnation
+    # fired and how many elastic resets it survived (processes killed
+    # mid-schedule obviously don't reach this line — their fires show
+    # up in the driver-captured "faults: firing" log lines instead).
+    snap = hvd.metrics()
+    fired = sum((snap.get("hvd_faults_fired_total") or {}).values())
+    resets = (snap.get("hvd_elastic_resets_total") or {}).get((), 0)
+    log_line(f"stats rank {hvd.rank()} faults {int(fired)} "
+             f"resets {int(resets)}")
     hvd.shutdown()
 
 
