@@ -54,11 +54,45 @@ from .optim.functions import (  # noqa: F401
 from . import elastic  # noqa: F401
 from . import faults  # noqa: F401
 from . import callbacks  # noqa: F401
-from . import flax  # noqa: F401
-from .sync_batch_norm import SyncBatchNorm, to_sync_batch_norm  # noqa: F401
+from . import numerics  # noqa: F401
+from .numerics import (  # noqa: F401
+    DistributedLossScaler, guard_non_finite, check_replica_divergence,
+)
+from .common.exceptions import (  # noqa: F401
+    HorovodInternalError, ReplicaDivergenceError,
+)
 from . import metrics as _metrics_module
 
 __version__ = "0.1.0"
+
+# Everything that needs the external flax package loads lazily
+# (module-level __getattr__, PEP 562): flax is an OPT-IN frontend
+# exactly like horovod_tpu.torch — plain-JAX installs must not pay
+# (or break on) the flax import at `import horovod_tpu` time. That
+# covers hvd.flax itself AND the linen-based SyncBatchNorm exports,
+# whose module imports flax.linen at its top.
+_LAZY_FLAX_ATTRS = {
+    "flax": (".flax", None),
+    "SyncBatchNorm": (".sync_batch_norm", "SyncBatchNorm"),
+    "to_sync_batch_norm": (".sync_batch_norm", "to_sync_batch_norm"),
+}
+
+
+def __getattr__(name: str):
+    target = _LAZY_FLAX_ATTRS.get(name)
+    if target is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    mod = importlib.import_module(target[0], __name__)
+    value = mod if target[1] is None else getattr(mod, target[1])
+    globals()[name] = value   # cache: next lookup skips __getattr__
+    return value
+
+
+def __dir__():
+    # keep tab completion / introspection seeing the lazy exports
+    return sorted(set(globals()) | set(_LAZY_FLAX_ATTRS))
 
 
 def metrics() -> dict:

@@ -199,6 +199,26 @@ class LearningRateScheduleCallback(Callback):
         ctx.lr_scale *= self._mult(epoch)
 
 
+class ReplicaConsistencyCallback(Callback):
+    """Replica-divergence (SDC) sentinel for callback-driven loops:
+    every `every_n_epochs`, hash `ctx.params` to a 64-bit digest,
+    allgather the digests, and raise `ReplicaDivergenceError` naming
+    the divergent ranks on disagreement (see
+    numerics.check_replica_divergence — elastic loops get the same
+    check per-commit via `HOROVOD_NUMERICS_CHECK_EVERY` instead)."""
+
+    def __init__(self, every_n_epochs: int = 1):
+        self.every_n_epochs = max(int(every_n_epochs), 1)
+
+    def on_epoch_end(self, epoch: int, metrics: Dict[str, Any],
+                     ctx: CallbackContext) -> Dict[str, Any]:
+        if ctx.params is not None and \
+                (epoch + 1) % self.every_n_epochs == 0:
+            from .numerics import check_replica_divergence
+            check_replica_divergence(ctx.params)
+        return metrics
+
+
 # ---------------------------------------------------------------------------
 # Pure-optax schedule helpers (the jit-friendly flavor)
 # ---------------------------------------------------------------------------

@@ -223,14 +223,44 @@ KNOBS: List[Knob] = [
          "Discovery circuit breaker: consecutive discovery-script "
          "failures are served from the last-known-good host list for "
          "up to this many seconds before failures propagate again."),
+    # -- numerics (numerical integrity) --------------------------------------
+    Knob("HOROVOD_NUMERICS_GUARD", _parse_bool, False,
+         "Coordinated skip-step guard (numerics.py): each rank's "
+         "scalar gradient finite-flag rides the existing reduction "
+         "(min-reduce semantics — an extra fused leaf eagerly, a pmin "
+         "in-jit), and guard_non_finite() zeroes the update on EVERY "
+         "rank when any rank saw a non-finite gradient. Off by "
+         "default; when off guard_non_finite() returns the inner "
+         "transformation unchanged (identical HLO, zero overhead)."),
+    Knob("HOROVOD_NUMERICS_MAX_CONSECUTIVE_SKIPS", int, 0,
+         "Escalate to HorovodInternalError after this many "
+         "CONSECUTIVE coordinated skip-steps, so hvd.elastic.run "
+         "restores the last commit instead of spinning on poisoned "
+         "inputs (eager loops raise from the guard; jitted loops "
+         "escalate at the elastic commit boundary or via "
+         "numerics.check_escalation). 0 disables escalation."),
+    Knob("HOROVOD_NUMERICS_CHECK_EVERY", int, 0,
+         "Replica-divergence (SDC) sentinel cadence: every N elastic "
+         "commits, hash the replicated parameters to a 64-bit digest, "
+         "allgather the digests (8 bytes/rank), and raise "
+         "ReplicaDivergenceError naming the divergent ranks on "
+         "disagreement — silent data corruption becomes a clean, "
+         "restorable failure. 0 disables."),
+    Knob("HOROVOD_NUMERICS_INIT_SCALE", float, 65536.0,
+         "Initial dynamic loss scale for hvd.DistributedLossScaler "
+         "(2^16, torch GradScaler's default)."),
+    Knob("HOROVOD_NUMERICS_GROWTH_INTERVAL", int, 2000,
+         "Clean (finite) steps between loss-scale growth attempts in "
+         "hvd.DistributedLossScaler (GradScaler's growth_interval)."),
     # -- fault injection (chaos testing) -------------------------------------
     Knob("HOROVOD_FAULTS", str, "",
          "Deterministic fault-injection spec (faults.py): rules "
          "'point:action[:k=v,...]' joined by ';', e.g. "
          "'wire.send:drop:p=0.05;elastic.step:crash:at=40'. Points: "
          "wire.send, wire.recv, rendezvous.http, discovery.poll, "
-         "elastic.step, dispatch.entry. Actions: drop, delay, "
-         "corrupt, error, crash, hang. Empty = every injection point "
+         "elastic.step, dispatch.entry, numerics.grad, "
+         "numerics.param. Actions: drop, delay, corrupt, error, "
+         "crash, hang, nan, inf, flip. Empty = every injection point "
          "compiles to a no-op."),
     Knob("HOROVOD_FAULTS_SEED", int, 0,
          "Seed for the fault-injection schedule; each rule draws from "
@@ -343,6 +373,12 @@ class Config:
         "blacklist_window": "HOROVOD_ELASTIC_BLACKLIST_WINDOW",
         "blacklist_window_max": "HOROVOD_ELASTIC_BLACKLIST_WINDOW_MAX",
         "discovery_staleness_window": "HOROVOD_DISCOVERY_STALENESS_WINDOW",
+        "numerics_guard": "HOROVOD_NUMERICS_GUARD",
+        "numerics_max_consecutive_skips":
+            "HOROVOD_NUMERICS_MAX_CONSECUTIVE_SKIPS",
+        "numerics_check_every": "HOROVOD_NUMERICS_CHECK_EVERY",
+        "numerics_init_scale": "HOROVOD_NUMERICS_INIT_SCALE",
+        "numerics_growth_interval": "HOROVOD_NUMERICS_GROWTH_INTERVAL",
         "faults": "HOROVOD_FAULTS",
         "faults_seed": "HOROVOD_FAULTS_SEED",
         "dynamic_process_sets": "HOROVOD_DYNAMIC_PROCESS_SETS",

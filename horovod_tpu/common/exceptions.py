@@ -14,3 +14,17 @@ worker — the graceful half of the recovery protocol (SURVEY.md §5.3).
 class HorovodInternalError(Exception):
     """A collective failed because the control plane went away;
     elastic training recovers by restore + re-init."""
+
+
+class ReplicaDivergenceError(HorovodInternalError):
+    """Replicated parameters disagree across ranks (silent data
+    corruption, or a nondeterministic update leaking into replicated
+    state). Subclasses HorovodInternalError ON PURPOSE: the elastic
+    retry loop treats divergence like any other restorable failure —
+    restore the last commit, re-init, and rank-0 sync re-converges the
+    replicas (numerics.check_replica_divergence raises it with the
+    divergent ranks named)."""
+
+    def __init__(self, message: str, divergent_ranks=()):
+        super().__init__(message)
+        self.divergent_ranks = tuple(divergent_ranks)

@@ -97,6 +97,14 @@ class State:
         # (rate-limited inside), so a worker stuck BETWEEN the pacer's
         # beats still advertises forward progress at every commit.
         _worker.maybe_heartbeat()
+        # Numerical-integrity hook BEFORE save: the numerics.param
+        # chaos seam flips a bit, the replica-divergence sentinel runs
+        # its periodic digest check, and guarded jitted loops escalate
+        # consecutive skip-steps — each raising (HorovodInternalError
+        # family) before the bad state can be committed, so restore
+        # rolls back to the last CLEAN commit.
+        from .. import numerics as _numerics
+        _numerics.on_commit(self)
         self.save()
         self.check_host_updates()
 

@@ -27,8 +27,10 @@ with params "k=v" separated by ",". Params:
 
 Actions: "delay" (sleep, applied inside fire), "error" (raise the
 seam's exception class), "crash" (os._exit(43)), "drop" / "corrupt" /
-"hang" (returned to the seam, which implements the data-plane effect —
-a dropped wire frame, a flipped byte, a parked worker). Each point
+"hang" / "nan" / "inf" / "flip" (returned to the seam, which
+implements the data-plane effect — a dropped wire frame, a flipped
+byte, a parked worker, a poisoned gradient element, a bit-flipped
+parameter). Each point
 only accepts the actions its seam implements (see POINTS); the parser
 rejects the rest so a spec can never log fires that inject nothing.
 
@@ -88,6 +90,15 @@ POINTS: Dict[str, frozenset] = {
     "elastic.step": frozenset({"delay", "error", "crash", "hang"}),
     # ops/dispatch.py collective entry.
     "dispatch.entry": frozenset({"delay", "error", "crash"}),
+    # numerics.py maybe_corrupt_grads (reduction entry, eager paths):
+    # "nan"/"inf" poison one element of a LOCAL gradient leaf, so the
+    # coordinated skip-step machinery is what must catch it.
+    "numerics.grad": frozenset({"nan", "inf", "delay", "error",
+                                "crash"}),
+    # numerics.py maybe_flip_param (elastic commit boundary): "flip"
+    # flips one parameter bit — simulated silent data corruption for
+    # the replica-divergence sentinel to detect.
+    "numerics.param": frozenset({"flip", "delay", "error", "crash"}),
 }
 
 ACTIONS = frozenset().union(*POINTS.values())

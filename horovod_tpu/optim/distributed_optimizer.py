@@ -22,6 +22,11 @@ LocalGradientAggregationHelper), re-designed for JAX/optax:
 * `backward_passes_per_step=k` reproduces local gradient aggregation:
   gradients accumulate locally for k calls, the reduction happens on
   the k-th, and intermediate calls return zero updates.
+* With `HOROVOD_NUMERICS_GUARD=1` each rank's scalar finite-flag
+  rides the reduction (an extra fused leaf on the eager grouped
+  allreduce, a pmin on the axis_name path) and a veto is imprinted
+  onto the reduced gradients, so a `numerics.guard_non_finite`
+  wrapper skips the step IDENTICALLY on every rank (numerics.py).
 """
 
 from __future__ import annotations
@@ -34,10 +39,11 @@ import jax.numpy as jnp
 from jax import lax
 import optax
 
+from .. import numerics as _numerics
 from ..ops import collective_ops as C
 from ..ops import sparse as S
 from ..ops.compression import Compression, NoneCompressor
-from ..ops.dispatch import AVERAGE, SUM, ADASUM
+from ..ops.dispatch import AVERAGE, SUM, ADASUM, MIN
 from ..ops.process_set import ProcessSet
 
 
@@ -191,6 +197,14 @@ def _eager_reduce_mixed(leaves, treedef, sp_idx, eff_op, compression,
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def _flag_min_eager(flag, process_set):
+    """Coordinated finite-flag for reductions that cannot carry an
+    extra fused leaf (Adasum folds, mixed sparse trees): one tiny
+    negotiated Min allreduce of the f32 flag."""
+    return C.allreduce(flag, op=MIN, name="numerics.flag",
+                       process_set=process_set) > 0.5
+
+
 def _split_round_robin(items, n):
     buckets = [[] for _ in range(min(n, len(items)))]
     for i, it in enumerate(items):
@@ -223,9 +237,25 @@ def DistributedGradientTransformation(
         raise ValueError("backward_passes_per_step must be >= 1")
 
     def reduce_grads(grads):
+        guard = _numerics.guard_enabled()
         leaves, treedef = jax.tree_util.tree_flatten(
             grads, is_leaf=S.is_sparse)
         sp_idx = [i for i, l in enumerate(leaves) if S.is_sparse(l)]
+        # numerics.grad chaos seam — UNCONDITIONAL (gated only on an
+        # armed plan inside), so an armed spec always injects and
+        # logs, guard on or off: injecting with the guard OFF is the
+        # negative control that shows the poison propagating.
+        corrupted = _numerics.maybe_corrupt_grads(leaves)
+        if corrupted is not leaves:
+            leaves = corrupted
+            grads = jax.tree_util.tree_unflatten(treedef, leaves)
+        flag = None
+        if guard:
+            # Coordinated skip-step (numerics.py): the scalar finite-
+            # flag over the PRE-reduction gradients; the min-reduce
+            # ride below is what carries the veto.
+            flag = _numerics.local_finite_flag(
+                [l.data if S.is_sparse(l) else l for l in leaves])
         if sp_idx and sparse_as_dense:
             # reference: optimizer.py sparse_as_dense — densify before
             # the ordinary dense reduction.
@@ -243,7 +273,13 @@ def DistributedGradientTransformation(
             if op == ADASUM and n is None:
                 raise ValueError("op=Adasum with axis_name requires "
                                  "size_hint=<axis size>")
-            return _axis_reduce(grads, axis_name, op, compression, n)
+            out = _axis_reduce(grads, axis_name, op, compression, n)
+            if guard:
+                # In-jit ride: a pmin alongside the data collectives —
+                # same XLA program, no extra launch.
+                ok = lax.pmin(flag, axis_name) > 0.5
+                out = _numerics.imprint_non_finite(out, ok)
+            return out
         prescale, postscale = 1.0, 1.0
         eff_op = op
         if op == AVERAGE and gradient_predivide_factor != 1.0:
@@ -257,13 +293,47 @@ def DistributedGradientTransformation(
             postscale = gradient_predivide_factor / n
             eff_op = SUM
         if sp_idx:
-            return _eager_reduce_mixed(leaves, treedef, sp_idx, eff_op,
-                                       compression, process_set,
-                                       num_groups, groups, prescale,
-                                       postscale)
-        return jax.tree_util.tree_unflatten(treedef, _eager_reduce(
+            out = _eager_reduce_mixed(leaves, treedef, sp_idx, eff_op,
+                                      compression, process_set,
+                                      num_groups, groups, prescale,
+                                      postscale)
+            if guard:
+                out = _numerics.imprint_non_finite(
+                    out, _flag_min_eager(flag, process_set))
+            return out
+        if guard and leaves and op in (AVERAGE, SUM) \
+                and compression is NoneCompressor:
+            # Eager fused ride: the flag is ONE extra f32 leaf in the
+            # same grouped allreduce (it joins the trailing fusion
+            # chunk), so the veto costs no extra launch. Under AVERAGE
+            # (incl. the predivide prescale/postscale rewrite, which
+            # nets out to the mean) the reduced flag is the mean of
+            # the per-rank 0/1 votes — 1.0 iff everyone voted finite;
+            # under SUM it is the finite-voter count. UNCOMPRESSED
+            # groups only: a lossy wire dtype accumulates the vote
+            # count in fp16/bf16, where n-1 rounds to n past a few
+            # hundred ranks and a single veto would be rounded away —
+            # compressed reductions take the exact Min ride below.
+            import horovod_tpu as hvd
+            n = process_set.size if process_set is not None \
+                else hvd.size()
+            reduced = _eager_reduce(
+                leaves + [flag], eff_op, compression, process_set,
+                num_groups, groups, prescale, postscale)
+            rflag = reduced.pop()
+            ok = (rflag > 1.0 - 0.5 / n) if op == AVERAGE \
+                else (rflag > n - 0.5)
+            return _numerics.imprint_non_finite(
+                jax.tree_util.tree_unflatten(treedef, reduced), ok)
+        out = jax.tree_util.tree_unflatten(treedef, _eager_reduce(
             leaves, eff_op, compression, process_set, num_groups,
             groups, prescale, postscale))
+        if guard:
+            # Adasum (and any exotic op): the flag cannot fold into
+            # the data reduction — one tiny Min allreduce instead.
+            out = _numerics.imprint_non_finite(
+                out, _flag_min_eager(flag, process_set))
+        return out
 
     def init_fn(params):
         inner_state = inner.init(params)

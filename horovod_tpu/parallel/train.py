@@ -29,6 +29,7 @@ from jax import lax
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import numerics as _numerics
 from ..common.compat import GRADS_PRE_SUMMED, shard_map
 from .mesh import FSDP_AXIS, batch_axes
 from .sharding import Rules, replicated
@@ -213,16 +214,62 @@ def build_train_step(
 
         return jax.tree.map(one, grads, spec_tree)
 
+    # Coordinated skip-step (numerics.py): decided once at build time
+    # so a disabled guard changes NOTHING in the traced program (the
+    # HLO-identity acceptance test pins this).
+    guard = _numerics.guard_enabled()
+    n_devices = 1
+    for a in mesh.shape:
+        n_devices *= mesh.shape[a]
+
+    def _unanimity(flag):
+        """Coordinated vote: psum the 0/1 finite-flag over EVERY mesh
+        axis and demand all devices voted finite — the min-reduce
+        riding the same XLA program as the data psums. A NaN confined
+        to ONE shard of a model-sharded parameter yields a flag that
+        differs across that axis, so a per-device decision would step
+        some replicas and skip others (silently diverging replicated
+        params); unanimity is the only safe decision. On the VMA leg
+        the flag's varying-type is inherited from the gradient leaves,
+        and psum over an axis the flag is unvarying on is rejected by
+        the typing — lift the missing axes with lax.pvary first."""
+        axis_names = tuple(mesh.shape.keys())
+        if GRADS_PRE_SUMMED and hasattr(lax, "pvary"):
+            try:
+                vma = frozenset(getattr(getattr(flag, "aval", None),
+                                        "vma", ()) or ())
+            except Exception:  # pragma: no cover - typing introspection
+                vma = frozenset()
+            missing = tuple(a for a in axis_names if a not in vma)
+            if missing:
+                flag = lax.pvary(flag, missing)
+        cnt = _psum_axes(flag, axis_names)
+        return cnt > n_devices - 0.5
+
     def reduce_grads(grads):
+        ok = None
+        if guard:
+            # Local finite-flag over the incoming gradients, then the
+            # explicit all-axes unanimity vote (both legs: on the VMA
+            # leg the automatic psums only folded each leaf's
+            # REPLICATED axes, which is not device-global for sharded
+            # leaves).
+            flag = _numerics.local_finite_flag(
+                jax.tree_util.tree_leaves(grads))
+            ok = _unanimity(flag)
         if not GRADS_PRE_SUMMED:
             grads = _sum_missing_axes(grads)
         if grad_reducer is not None:
-            return grad_reducer(grads)
-        if n_batch == 1:
-            return grads
-        inv = 1.0 / n_batch
-        return jax.tree.map(
-            lambda g: g * jnp.asarray(inv, g.dtype), grads)
+            out = grad_reducer(grads)
+        elif n_batch == 1:
+            out = grads
+        else:
+            inv = 1.0 / n_batch
+            out = jax.tree.map(
+                lambda g: g * jnp.asarray(inv, g.dtype), grads)
+        if guard:
+            out = _numerics.imprint_non_finite(out, ok)
+        return out
 
     # ZeRO-3 leg of the explicit path: gather fsdp-sharded params
     # inside the differentiated region (transpose = grad scatter).

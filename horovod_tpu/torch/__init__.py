@@ -92,8 +92,13 @@ rocm_built = _hvd.rocm_built
 # tensor bridging
 # ---------------------------------------------------------------------------
 
-_warned_x64 = False
+# (dtype-name, op-name) pairs already warned about 32-bit reduction
+# precision — per-dtype-per-op, so an int64 allreduce AND a float64
+# broadcast each get their own (single) warning instead of one global
+# flag silencing everything after the first sighting.
+_warned_64bit = set()
 _dlpack_ok = None
+_INT32_MAX = 2 ** 31 - 1
 
 
 def _dlpack_usable() -> bool:
@@ -109,8 +114,26 @@ def _dlpack_usable() -> bool:
     return _dlpack_ok
 
 
-def _to_jax(t: torch.Tensor):
-    global _warned_x64
+def _sum_headroom(op, average=None, process_set=None) -> int:
+    """int32 headroom multiplier for summing reductions: an in-range
+    int64 input can still WRAP during an int32 Sum, so the submit
+    check scales by the reducing-set size (the process set's when one
+    is given, else the world). Product overflow stays undetectable
+    cheaply; the precision warning covers that residual. NOTE the
+    whole range check is rank-local by design (the ADVICE-requested
+    loud error over silent truncation): when ranks hold divergent
+    values, the raising rank's peers stall in negotiation until the
+    stall inspector names the missing rank — still strictly better
+    than every rank silently computing wrapped garbage."""
+    if (op == Sum or average is False) and _hvd.is_initialized():
+        if process_set is not None:
+            return max(process_set.size, 1)
+        return max(_hvd.size(), 1)
+    return 1
+
+
+def _to_jax(t: torch.Tensor, op: str = "op", check_range: bool = True,
+            sum_headroom: int = 1):
     if not isinstance(t, torch.Tensor):
         raise TypeError(f"expected a torch.Tensor, got {type(t).__name__}")
     if t.device.type != "cpu":
@@ -120,13 +143,31 @@ def _to_jax(t: torch.Tensor):
             "(docs/migrating_from_horovod.md)")
     t = t.detach()
     if (t.dtype in (torch.int64, torch.float64)
-            and not jax.config.jax_enable_x64 and not _warned_x64):
-        _warned_x64 = True
-        from horovod_tpu.common.logging import logger
-        logger.warning(
-            "64-bit torch tensors reduce in 32-bit precision unless "
-            "JAX_ENABLE_X64=1 is set (the torch-side dtype is "
-            "preserved on return)")
+            and not jax.config.jax_enable_x64):
+        if t.dtype == torch.int64 and t.numel() and check_range:
+            # Cheap max/min check at submit: int64 values outside the
+            # int32 range would silently wrap on the 32-bit bridge
+            # (step counters, sample counts in broadcast state_dicts)
+            # — that is data corruption, not precision loss, so raise.
+            lo, hi = torch.aminmax(t)   # both bounds in ONE pass
+            if (int(hi) * sum_headroom > _INT32_MAX
+                    or int(lo) * sum_headroom < -_INT32_MAX - 1):
+                need = (" after a Sum over all members"
+                        if sum_headroom > 1 else "")
+                raise ValueError(
+                    f"int64 tensor submitted to {op} holds values "
+                    f"outside the int32 range{need} and "
+                    "JAX_ENABLE_X64 is unset — the 32-bit bridge "
+                    "would silently wrap them; set JAX_ENABLE_X64=1 "
+                    "or cast explicitly before the collective")
+        key = (str(t.dtype), op)
+        if key not in _warned_64bit:
+            _warned_64bit.add(key)
+            from horovod_tpu.common.logging import logger
+            logger.warning(
+                "%s tensor in %s reduces in 32-bit precision unless "
+                "JAX_ENABLE_X64=1 is set (the torch-side dtype is "
+                "preserved on return)", t.dtype, op)
     if _dlpack_usable():
         # Zero-copy view of the torch buffer (measured ~0 vs one
         # memcpy per submit; covers bf16 with no f32 round-trip).
@@ -273,7 +314,10 @@ def allreduce_async(tensor, average=None, name=None, op=None,
                     prescale_factor=1.0, postscale_factor=1.0,
                     compression=Compression.none,
                     process_set=None) -> int:
-    h = _C.allreduce_async(_to_jax(tensor), average=average, name=name,
+    h = _C.allreduce_async(_to_jax(tensor, "allreduce",
+                               sum_headroom=_sum_headroom(
+                                   op, average, process_set)),
+                       average=average, name=name,
                            op=op, prescale_factor=prescale_factor,
                            postscale_factor=postscale_factor,
                            compression=compression,
@@ -297,7 +341,10 @@ def allreduce_async_(tensor, average=None, name=None, op=None,
                      process_set=None) -> int:
     """In-place variant: on synchronize, the result is copied back
     into `tensor` (reference: allreduce_async_)."""
-    h = _C.allreduce_async(_to_jax(tensor), average=average, name=name,
+    h = _C.allreduce_async(_to_jax(tensor, "allreduce",
+                               sum_headroom=_sum_headroom(
+                                   op, average, process_set)),
+                       average=average, name=name,
                            op=op, prescale_factor=prescale_factor,
                            postscale_factor=postscale_factor,
                            compression=compression,
@@ -321,7 +368,9 @@ def grouped_allreduce_async(tensors: Sequence[torch.Tensor],
                             compression=Compression.none,
                             process_set=None) -> int:
     h = _C.grouped_allreduce_async(
-        [_to_jax(t) for t in tensors], average=average, name=name,
+        [_to_jax(t, "grouped_allreduce",
+                 sum_headroom=_sum_headroom(op, average, process_set))
+         for t in tensors], average=average, name=name,
         op=op, prescale_factor=prescale_factor,
         postscale_factor=postscale_factor, compression=compression,
         process_set=process_set)
@@ -342,7 +391,8 @@ def grouped_allgather_async(tensors: Sequence[torch.Tensor],
                             name=None, process_set=None):
     """Returns a composite handle (accepted by synchronize/poll, like
     the integer handles)."""
-    h = _C.grouped_allgather_async([_to_jax(t) for t in tensors],
+    h = _C.grouped_allgather_async(
+        [_to_jax(t, "grouped_allgather") for t in tensors],
                                    name=name, process_set=process_set)
     return _remember(h, ("group", [t.dtype for t in tensors]))
 
@@ -360,7 +410,10 @@ def grouped_reducescatter_async(tensors: Sequence[torch.Tensor],
     """Returns a composite handle (accepted by synchronize/poll, like
     the integer handles)."""
     h = _C.grouped_reducescatter_async(
-        [_to_jax(t) for t in tensors], op=op, name=name,
+        [_to_jax(t, "grouped_reducescatter",
+                 sum_headroom=_sum_headroom(
+                     op, process_set=process_set)) for t in tensors],
+        op=op, name=name,
         prescale_factor=prescale_factor,
         postscale_factor=postscale_factor, process_set=process_set)
     return _remember(h, ("group", [t.dtype for t in tensors]))
@@ -376,7 +429,7 @@ def grouped_reducescatter(tensors, op=None, name=None,
 
 
 def allgather_async(tensor, name=None, process_set=None) -> int:
-    h = _C.allgather_async(_to_jax(tensor), name=name,
+    h = _C.allgather_async(_to_jax(tensor, "allgather"), name=name,
                            process_set=process_set)
     return _remember(h, ("one", tensor.dtype))
 
@@ -387,7 +440,13 @@ def allgather(tensor, name=None, process_set=None):
 
 
 def broadcast_async(tensor, root_rank, name=None, process_set=None) -> int:
-    h = _C.broadcast_async(_to_jax(tensor), root_rank=root_rank,
+    # check_range only on the root: a non-root's input buffer is about
+    # to be OVERWRITTEN by root's payload, so its (possibly stale,
+    # out-of-range) values must not raise — a per-rank value-dependent
+    # raise mid-collective would stall the submitting peers.
+    h = _C.broadcast_async(_to_jax(tensor, "broadcast",
+                               check_range=_hvd.rank() == root_rank),
+                           root_rank=root_rank,
                            name=name, process_set=process_set)
     return _remember(h, ("one", tensor.dtype))
 
@@ -400,7 +459,9 @@ def broadcast(tensor, root_rank, name=None, process_set=None):
 
 def broadcast_async_(tensor, root_rank, name=None,
                      process_set=None) -> int:
-    h = _C.broadcast_async(_to_jax(tensor), root_rank=root_rank,
+    h = _C.broadcast_async(_to_jax(tensor, "broadcast",
+                               check_range=_hvd.rank() == root_rank),
+                           root_rank=root_rank,
                            name=name, process_set=process_set)
     return _remember(h, ("inplace", tensor))
 
@@ -415,7 +476,8 @@ def alltoall_async(tensor, splits=None, name=None,
                    process_set=None) -> int:
     if splits is not None and isinstance(splits, torch.Tensor):
         splits = [int(s) for s in splits]
-    h = _C.alltoall_async(_to_jax(tensor), splits=splits, name=name,
+    h = _C.alltoall_async(_to_jax(tensor, "alltoall"), splits=splits,
+                          name=name,
                           process_set=process_set)
     return _remember(h, ("alltoall", tensor.dtype, splits is not None))
 
@@ -429,7 +491,10 @@ def reducescatter_async(tensor, op=None, name=None,
                         prescale_factor: float = 1.0,
                         postscale_factor: float = 1.0,
                         process_set=None) -> int:
-    h = _C.reducescatter_async(_to_jax(tensor), op=op, name=name,
+    h = _C.reducescatter_async(_to_jax(tensor, "reducescatter",
+                                   sum_headroom=_sum_headroom(
+                                       op, process_set=process_set)),
+                               op=op, name=name,
                                prescale_factor=prescale_factor,
                                postscale_factor=postscale_factor,
                                process_set=process_set)
@@ -456,7 +521,7 @@ def sparse_allreduce(tensor, average=None, name=None, op=None,
     t = tensor.coalesce()
     vals = t.values()
     bcoo = jsparse.BCOO(
-        (_to_jax(vals),
+        (_to_jax(vals, "sparse_allreduce"),
          jnp.asarray(np.asarray(t.indices().t().contiguous()))),
         shape=tuple(t.shape))
     out = _hvd.sparse_allreduce(bcoo, average=average, name=name,
@@ -511,8 +576,6 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
     checkpoint with materialized Adam state, workers fresh with empty
     state); ranks never submit divergent collective sets, so no
     negotiation deadlock."""
-    if isinstance(optimizer, DistributedOptimizer):
-        optimizer = optimizer._opt
     sd = optimizer.state_dict()
     local: Dict[tuple, torch.Tensor] = {}
 
@@ -561,11 +624,16 @@ def broadcast_optimizer_state(optimizer: torch.optim.Optimizer,
 # DistributedOptimizer (reference: horovod/torch/optimizer.py)
 # ---------------------------------------------------------------------------
 
-class DistributedOptimizer:
-    """Wraps a torch.optim.Optimizer with hook-based gradient
-    averaging (reference: _DistributedOptimizer — per-parameter
-    post-accumulate hooks submit allreduce_async_ in reverse layer
-    order; step() synchronizes then applies).
+class _DistributedOptimizer:
+    """Hook/step/synchronize mixin the DistributedOptimizer factory
+    composes IN FRONT of the wrapped optimizer's own class (reference:
+    horovod/torch/optimizer.py builds `type(opt.__class__.__name__,
+    (opt.__class__,), dict(_DistributedOptimizer.__dict__))`). The
+    instance therefore IS a torch.optim.Optimizer of the original
+    flavor: `isinstance(opt, torch.optim.Optimizer)` passes, so
+    torch.optim.lr_scheduler.*, torch.amp.GradScaler and every other
+    isinstance-gated integration work on the wrapped optimizer — the
+    'only the import line differs' drop-in claim, kept honest.
 
     The async submissions enter the negotiated engine as soon as each
     gradient materializes, so negotiation/fusion overlaps the rest of
@@ -577,13 +645,12 @@ class DistributedOptimizer:
     (same-wire-dtype entries agreed in one cycle execute as one
     launch; raise HOROVOD_BATCH_QUIESCENCE to widen the cut)."""
 
-    def __init__(self, optimizer: torch.optim.Optimizer,
-                 named_parameters=None,
-                 compression=Compression.none,
-                 backward_passes_per_step: int = 1,
-                 op=None, gradient_predivide_factor: float = 1.0,
-                 process_set=None, sparse_as_dense: bool = False):
-        self._opt = optimizer
+    def _hvd_init(self, named_parameters=None,
+                  compression=Compression.none,
+                  backward_passes_per_step: int = 1,
+                  op=None, gradient_predivide_factor: float = 1.0,
+                  process_set=None, sparse_as_dense: bool = False):
+        optimizer = self
         self._compression = compression
         self._op = Average if op is None else op
         self._pset = process_set
@@ -620,28 +687,16 @@ class DistributedOptimizer:
             p.register_post_accumulate_grad_hook(self._hook)
             for _, p in named if p.requires_grad]
 
-    # -- reference surface delegation ------------------------------------
-    @property
-    def param_groups(self):
-        return self._opt.param_groups
-
-    @property
-    def state(self):
-        return self._opt.state
-
-    def state_dict(self):
-        return self._opt.state_dict()
-
-    def load_state_dict(self, sd):
-        return self._opt.load_state_dict(sd)
-
+    # -- reference surface (param_groups / state / state_dict /
+    #    load_state_dict are INHERITED from the real optimizer class
+    #    now — no delegation layer) -----------------------------------
     def zero_grad(self, set_to_none: bool = True):
         if self._handles:
             raise RuntimeError(
                 "zero_grad() with allreduce submissions in flight; "
                 "call step() (or synchronize()) first, as in the "
                 "reference")
-        return self._opt.zero_grad(set_to_none=set_to_none)
+        return super().zero_grad(set_to_none=set_to_none)
 
     # -- the hook path ----------------------------------------------------
     def _hook(self, p: torch.Tensor) -> None:
@@ -700,4 +755,44 @@ class DistributedOptimizer:
     def step(self, closure=None):
         if not self._skip:
             self.synchronize()
-        return self._opt.step(closure)
+        return super().step(closure)
+
+
+def DistributedOptimizer(optimizer: torch.optim.Optimizer,
+                         named_parameters=None,
+                         compression=Compression.none,
+                         backward_passes_per_step: int = 1,
+                         op=None,
+                         gradient_predivide_factor: float = 1.0,
+                         process_set=None,
+                         sparse_as_dense: bool = False):
+    """Make `optimizer` distributed: hook-based gradient averaging
+    with negotiated-engine overlap (reference:
+    horovod/torch/optimizer.py DistributedOptimizer).
+
+    Implemented as a DYNAMIC SUBCLASS of the wrapped optimizer's own
+    class (the reference's pattern): the returned object — the same
+    instance, re-classed in place so all state/refs stay valid — is a
+    genuine `torch.optim.Optimizer` of the original flavor. That is
+    what unblocks `torch.optim.lr_scheduler.*` (whose __init__ raises
+    TypeError for non-Optimizers) and `torch.amp.GradScaler`, whose
+    documented interop pattern is:
+
+        scaler.scale(loss).backward()
+        optimizer.synchronize()            # allreduce the grads
+        scaler.unscale_(optimizer)         # found_inf over REDUCED
+        with optimizer.skip_synchronize(): # grads => same decision
+            scaler.step(optimizer)         # on every rank
+        scaler.update()                    # coordinated for free
+    """
+    if isinstance(optimizer, _DistributedOptimizer):
+        raise ValueError("optimizer is already a DistributedOptimizer")
+    cls = type("Distributed" + optimizer.__class__.__name__,
+               (_DistributedOptimizer, optimizer.__class__), {})
+    optimizer.__class__ = cls
+    optimizer._hvd_init(
+        named_parameters=named_parameters, compression=compression,
+        backward_passes_per_step=backward_passes_per_step, op=op,
+        gradient_predivide_factor=gradient_predivide_factor,
+        process_set=process_set, sparse_as_dense=sparse_as_dense)
+    return optimizer

@@ -88,7 +88,7 @@ class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
 
     def __init__(self, num_features, eps=1e-5, momentum=0.1,
                  affine=True, track_running_stats=True,
-                 process_set=None):
+                 process_set=None, name=None):
         super().__init__(num_features, eps=eps, momentum=momentum,
                          affine=affine,
                          track_running_stats=track_running_stats)
@@ -101,7 +101,16 @@ class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
         # counter would have advanced past a fresh joiner's. In-flight
         # name uniqueness holds anyway because the grouped reduce
         # blocks until delivery).
-        self._bn_uid = f"sync_bn.{next(self._uid_counter)}"
+        #
+        # An explicit `name=` decouples pairing from construction
+        # ORDER (a rank that built an extra throwaway model no longer
+        # shifts every later ordinal), and the channel count is folded
+        # into the name either way so the most common rank-divergent
+        # construction — same ordinal, different width — negotiates as
+        # DIFFERENT collectives and fails fast (stall/name mismatch)
+        # instead of silently pairing mismatched statistics.
+        base = name if name else f"sync_bn.{next(self._uid_counter)}"
+        self._bn_uid = f"{base}.c{num_features}"
 
     _uid_counter = itertools.count()
 
@@ -139,16 +148,24 @@ class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
         return y
 
     @classmethod
-    def convert_sync_batchnorm(cls, module, process_set=None):
+    def convert_sync_batchnorm(cls, module, process_set=None,
+                               name_prefix=None):
         """Recursively replace BatchNorm layers (reference analog:
-        torch.nn.SyncBatchNorm.convert_sync_batchnorm)."""
+        torch.nn.SyncBatchNorm.convert_sync_batchnorm).
+
+        `name_prefix` opts in to module-path-derived collective names
+        ("<prefix>.<attr-path>"): pairing then depends only on the
+        model's structure, never on how many OTHER modules a rank
+        happened to construct first — the fail-fast story for
+        conditional / rank-divergent construction histories. Omitted,
+        names keep the construction-ordinal scheme (back-compat)."""
         out = module
         if isinstance(module, torch.nn.modules.batchnorm._BatchNorm) \
                 and not isinstance(module, cls):
             out = cls(module.num_features, eps=module.eps,
                       momentum=module.momentum, affine=module.affine,
                       track_running_stats=module.track_running_stats,
-                      process_set=process_set)
+                      process_set=process_set, name=name_prefix)
             if module.affine:
                 with torch.no_grad():
                     out.weight.copy_(module.weight)
@@ -159,6 +176,9 @@ class SyncBatchNorm(torch.nn.modules.batchnorm._BatchNorm):
                 out.num_batches_tracked.copy_(
                     module.num_batches_tracked)
         for child_name, child in module.named_children():
+            child_prefix = (f"{name_prefix}.{child_name}"
+                            if name_prefix else None)
             setattr(out, child_name,
-                    cls.convert_sync_batchnorm(child, process_set))
+                    cls.convert_sync_batchnorm(child, process_set,
+                                               name_prefix=child_prefix))
         return out

@@ -351,11 +351,193 @@ class TestTorchElastic:
         assert state.epoch == 3
 
 
+class TestDynamicSubclass:
+    """The DistributedOptimizer factory builds a dynamic subclass of
+    the wrapped optimizer's class (the reference's pattern), so every
+    isinstance-gated torch integration works on the wrapper."""
+
+    def _opt(self, model, **kw):
+        return hvd.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(), **kw)
+
+    def test_isinstance_and_class_name(self, hvd_init):
+        model = torch.nn.Linear(4, 1, bias=False)
+        opt = self._opt(model)
+        assert isinstance(opt, torch.optim.Optimizer)
+        assert isinstance(opt, torch.optim.SGD)
+        assert type(opt).__name__ == "DistributedSGD"
+
+    def test_double_wrap_rejected(self, hvd_init):
+        model = torch.nn.Linear(4, 1, bias=False)
+        opt = self._opt(model)
+        with pytest.raises(ValueError, match="already"):
+            hvd.DistributedOptimizer(opt)
+
+    def test_lr_scheduler_works(self, hvd_init):
+        """The headline unblocked integration: lr_scheduler.__init__
+        raises TypeError for non-Optimizers, so this line is the
+        isinstance contract, end to end."""
+        model = torch.nn.Linear(4, 1, bias=False)
+        opt = self._opt(model)
+        sched = torch.optim.lr_scheduler.StepLR(opt, step_size=1,
+                                                gamma=0.5)
+        model(torch.randn(8, 4)).pow(2).mean().backward()
+        opt.step()
+        sched.step()
+        assert opt.param_groups[0]["lr"] == pytest.approx(0.05)
+
+    def _scaler(self, init_scale):
+        try:
+            sc = torch.amp.GradScaler("cpu", init_scale=init_scale,
+                                      enabled=True)
+        except (RuntimeError, TypeError) as e:  # pragma: no cover
+            pytest.skip(f"no CPU GradScaler in this torch: {e}")
+        if not sc.is_enabled():  # pragma: no cover
+            pytest.skip("CPU GradScaler disabled in this torch")
+        return sc
+
+    def test_gradscaler_interop_applies_when_finite(self, hvd_init):
+        """The documented AMP pattern (reference:
+        horovod/torch/optimizer.py GradScaler docs): scale ->
+        backward -> synchronize -> unscale_ -> skip_synchronize +
+        scaler.step -> update. found_inf runs over the REDUCED grads,
+        so every rank reaches the same decision."""
+        torch.manual_seed(11)
+        model = torch.nn.Linear(4, 1, bias=False)
+        opt = self._opt(model)
+        scaler = self._scaler(1024.0)
+        loss = model(torch.randn(8, 4)).pow(2).mean()
+        scaler.scale(loss).backward()
+        opt.synchronize()
+        scaler.unscale_(opt)
+        before = model.weight.detach().clone()
+        with opt.skip_synchronize():
+            scaler.step(opt)
+        scaler.update()
+        assert not torch.equal(before, model.weight)
+        assert scaler.get_scale() == 1024.0   # clean step: no backoff
+
+    def test_gradscaler_overflow_skips_and_backs_off(self, hvd_init):
+        torch.manual_seed(12)
+        model = torch.nn.Linear(4, 1, bias=False)
+        opt = self._opt(model)
+        scaler = self._scaler(1024.0)
+        loss = model(torch.randn(8, 4)).pow(2).mean()
+        scaler.scale(loss).backward()
+        opt.synchronize()
+        for p in model.parameters():
+            p.grad.fill_(float("inf"))   # post-reduction overflow
+        scaler.unscale_(opt)
+        before = model.weight.detach().clone()
+        with opt.skip_synchronize():
+            scaler.step(opt)
+        scaler.update()
+        assert torch.equal(before, model.weight)   # step skipped
+        assert scaler.get_scale() == 512.0         # backoff 0.5x
+
+
+class Test64BitBridge:
+    """int64/float64 on the 32-bit numpy bridge: per-dtype-per-op
+    warnings, and a hard error when int64 VALUES cannot round-trip
+    through int32 (truncation is corruption, not precision loss)."""
+
+    @pytest.fixture()
+    def x64_off(self):
+        import jax
+        prev = jax.config.jax_enable_x64
+        jax.config.update("jax_enable_x64", False)
+        from horovod_tpu import torch as hvt
+        hvt._warned_64bit.clear()
+        yield
+        jax.config.update("jax_enable_x64", prev)
+
+    def test_int64_out_of_range_raises(self, hvd_init, x64_off):
+        with pytest.raises(ValueError, match="int32 range"):
+            hvd.allreduce(torch.tensor([2 ** 40]), op=hvd.Sum,
+                          name="big64")
+        with pytest.raises(ValueError, match="int32 range"):
+            hvd.broadcast(torch.tensor([-2 ** 33]), root_rank=0,
+                          name="neg64")
+
+    def test_int64_sum_headroom_catches_reduction_wrap(self, hvd_init,
+                                                       x64_off):
+        """In-range int64 inputs can still WRAP during an int32 Sum;
+        the submit check scales the bound by the reducing-set size."""
+        from horovod_tpu import torch as hvt
+        t = torch.tensor([2 ** 30])   # fits int32 locally
+        hvt._to_jax(t, "allreduce", sum_headroom=1)   # local ok
+        with pytest.raises(ValueError, match="Sum over all members"):
+            hvt._to_jax(t, "allreduce", sum_headroom=4)
+        # world size 1: headroom collapses to 1 for Sum and avg=False
+        assert hvt._sum_headroom(hvd.Sum) == 1
+        assert hvt._sum_headroom(None, average=False) == 1
+        assert hvt._sum_headroom(None) == 1
+
+    def test_int64_in_range_still_reduces(self, hvd_init, x64_off):
+        out = hvd.allreduce(torch.tensor([5, -7]), op=hvd.Sum,
+                            name="small64")
+        assert out.dtype == torch.int64
+        np.testing.assert_array_equal(out.numpy(), [5, -7])
+
+    def test_warning_is_per_dtype_per_op(self, hvd_init, x64_off):
+        from horovod_tpu import torch as hvt
+        hvd.allreduce(torch.tensor([1]), op=hvd.Sum, name="w1")
+        hvd.allreduce(torch.tensor([2]), op=hvd.Sum, name="w2")
+        assert ("torch.int64", "allreduce") in hvt._warned_64bit
+        assert len([k for k in hvt._warned_64bit
+                    if k[0] == "torch.int64"]) == 1
+        hvd.broadcast(torch.tensor([3]), root_rank=0, name="w3")
+        assert ("torch.int64", "broadcast") in hvt._warned_64bit
+        hvd.allreduce(torch.tensor([1.0], dtype=torch.float64),
+                      name="w4")
+        assert ("torch.float64", "allreduce") in hvt._warned_64bit
+
+
+class TestSyncBatchNormNames:
+    def test_explicit_name_and_channel_fold(self, hvd_init):
+        bn = hvd.SyncBatchNorm(6, name="encoder.bn1")
+        assert bn._bn_uid == "encoder.bn1.c6"
+        # ordinal fallback still folds the channel count, so same-
+        # ordinal-different-width construction cannot silently pair
+        auto = hvd.SyncBatchNorm(3)
+        assert auto._bn_uid.startswith("sync_bn.")
+        assert auto._bn_uid.endswith(".c3")
+
+    def test_convert_uses_module_paths_with_prefix(self, hvd_init):
+        model = torch.nn.Sequential(
+            torch.nn.Conv2d(2, 4, 1), torch.nn.BatchNorm2d(4),
+            torch.nn.Sequential(torch.nn.BatchNorm2d(4)))
+        conv = hvd.SyncBatchNorm.convert_sync_batchnorm(
+            model, name_prefix="net")
+        assert conv[1]._bn_uid == "net.1.c4"
+        assert conv[2][0]._bn_uid == "net.2.0.c4"
+        # without a prefix: back-compat construction ordinals
+        model2 = torch.nn.Sequential(torch.nn.BatchNorm2d(4))
+        conv2 = hvd.SyncBatchNorm.convert_sync_batchnorm(model2)
+        assert conv2[0]._bn_uid.startswith("sync_bn.")
+
+    def test_converted_model_still_trains(self, hvd_init):
+        torch.manual_seed(13)
+        model = torch.nn.Sequential(
+            torch.nn.Conv2d(2, 4, 1), torch.nn.BatchNorm2d(4))
+        conv = hvd.SyncBatchNorm.convert_sync_batchnorm(
+            model, name_prefix="m")
+        y = conv(torch.randn(3, 2, 5, 5))
+        y.pow(2).mean().backward()
+        assert conv[1].weight.grad is not None
+
+
 @pytest.mark.integration
 class TestTorchRealLaunch:
     def test_two_process_torch_frontend(self):
         from tests.test_runner import run_launcher
         r = run_launcher(2, os.path.join("tests", "mp_worker_torch.py"),
                          timeout=360)
+        if r.returncode != 0 and "Multiprocess computations aren't " \
+                "implemented" in (r.stdout + r.stderr):
+            # same capability gate as test_chaos.py / test_numerics.py
+            pytest.skip("this jaxlib's CPU backend cannot run "
+                        "cross-process collectives")
         assert r.returncode == 0, r.stdout + r.stderr
         assert r.stdout.count("TORCH FRONTEND ALL OK") == 2, r.stdout
