@@ -1,0 +1,98 @@
+"""hvdlint — framework-aware static analysis for horovod_tpu.
+
+`python -m horovod_tpu.analysis horovod_tpu/` runs AST-based passes
+that make the framework's two worst runtime failure classes — rank-
+divergent collective schedules and control-plane lock races — plus
+registry drift and jit-trace impurity fail CI before they reach a pod:
+
+  HVD001  SPMD-divergence: collectives under rank-conditional control
+          flow (the `if rank()==0: allreduce(...)` deadlock shape).
+  HVD002  registry enforcement: HOROVOD_* environ reads outside the
+          Knob registry, declared-but-unused knobs, metric names not
+          registered at exactly one site.
+  HVD003  lock discipline: blocking operations inside `with <lock>`
+          bodies; cross-module lock-acquisition-order inversions.
+  HVD004  trace purity: python side-effects inside jit/shard_map/
+          pmap-traced functions.
+
+Per-rule suppression: `# hvdlint: disable=HVD00x (reason)` on the
+flagged line (or `disable-next=` on the line above, `disable-file=`
+anywhere). A committed baseline file (`hvdlint-baseline.json`) filters
+known findings so only NEW ones fail. The analyzer is pure AST — it
+never imports or executes the code under analysis — and its reports
+are byte-deterministic.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterable, List, Optional
+
+from .model import Finding, Project, collect_files
+from .rules import ALL_RULES, RULES_BY_ID
+
+
+class AnalysisResult:
+    """Outcome of one run: kept findings plus suppression/baseline
+    accounting."""
+
+    def __init__(self, findings: List[Finding], suppressed: int,
+                 baselined: int, elapsed_s: float,
+                 parse_errors: List[str], file_count: int = 0):
+        self.findings = findings
+        self.suppressed = suppressed
+        self.baselined = baselined
+        self.elapsed_s = elapsed_s
+        self.parse_errors = parse_errors
+        self.file_count = file_count
+
+    @property
+    def clean(self) -> bool:
+        return not self.findings and not self.parse_errors
+
+
+def run_analysis(paths: Iterable[str],
+                 select: Optional[Iterable[str]] = None,
+                 baseline: Optional[Dict[str, dict]] = None,
+                 cwd: Optional[str] = None) -> AnalysisResult:
+    """Analyze `paths` (files/dirs) with the selected rules (default:
+    all) and return kept findings, suppression-filtered and
+    baseline-filtered, deterministically sorted."""
+    t0 = time.perf_counter()
+    project = Project(collect_files(paths, cwd=cwd))
+    rule_ids = list(select) if select else sorted(RULES_BY_ID)
+    raw: List[Finding] = []
+    for rid in rule_ids:
+        cls = RULES_BY_ID.get(rid)
+        if cls is None:
+            raise ValueError(
+                f"unknown rule {rid!r}; known: {sorted(RULES_BY_ID)}")
+        raw.extend(cls().run(project))
+    by_rel = {sf.rel: sf for sf in project.files}
+    kept: List[Finding] = []
+    suppressed = 0
+    for f in raw:
+        sf = by_rel.get(f.path)
+        if sf is not None and sf.suppressions.covers(f.rule, f.line):
+            suppressed += 1
+        else:
+            kept.append(f)
+    baselined = 0
+    if baseline:
+        fresh = []
+        for f in kept:
+            if f.fingerprint in baseline:
+                baselined += 1
+            else:
+                fresh.append(f)
+        kept = fresh
+    kept.sort(key=Finding.sort_key)
+    errors = [f"{sf.rel}: {sf.error}" for sf in project.files
+              if sf.error]
+    return AnalysisResult(kept, suppressed, baselined,
+                          time.perf_counter() - t0, errors,
+                          file_count=len(project.files))
+
+
+__all__ = ["run_analysis", "AnalysisResult", "Finding", "ALL_RULES",
+           "RULES_BY_ID"]

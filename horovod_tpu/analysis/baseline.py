@@ -1,0 +1,56 @@
+"""Baseline engine: the committed debt ledger.
+
+A baseline file maps finding fingerprints (line/column-free, see
+`Finding.fingerprint`) to a human-readable record. Findings whose
+fingerprint appears in the baseline are filtered out, so the gate
+fails only on NEW findings — the linter can land on a big codebase the
+same day it is written and tighten over time by deleting entries.
+
+The file is JSON with sorted keys and a trailing newline, so
+`--write-baseline` is byte-stable and diffs review like code.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Tuple
+
+from .model import Finding
+
+BASELINE_VERSION = 1
+
+
+def render(findings: List[Finding]) -> str:
+    entries: Dict[str, Dict[str, str]] = {}
+    for f in sorted(findings, key=Finding.sort_key):
+        entries.setdefault(f.fingerprint, {
+            "rule": f.rule,
+            "path": f.path,
+            "context": f.context,
+            "message": f.message,
+        })
+    doc = {"version": BASELINE_VERSION, "findings": entries}
+    return json.dumps(doc, indent=2, sort_keys=True) + "\n"
+
+
+def parse(text: str) -> Dict[str, Dict[str, str]]:
+    doc = json.loads(text)
+    if doc.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"unsupported baseline version {doc.get('version')!r}")
+    return dict(doc.get("findings", {}))
+
+
+def load(path: str) -> Dict[str, Dict[str, str]]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return parse(fh.read())
+
+
+def split(findings: List[Finding],
+          baseline: Dict[str, Dict[str, str]]
+          ) -> Tuple[List[Finding], List[Finding]]:
+    """(new, baselined)."""
+    new, old = [], []
+    for f in findings:
+        (old if f.fingerprint in baseline else new).append(f)
+    return new, old

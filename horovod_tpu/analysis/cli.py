@@ -1,0 +1,107 @@
+"""hvdlint command line: `python -m horovod_tpu.analysis [paths...]`.
+
+Exit codes: 0 = clean (or every finding suppressed/baselined),
+1 = findings (or unparsable sources), 2 = usage/internal error —
+the contract scripts/lint.sh and CI consume.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from . import baseline as baseline_mod
+from . import run_analysis
+from .report import RENDERERS
+from .rules import ALL_RULES
+
+DEFAULT_BASELINE = "hvdlint-baseline.json"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.analysis",
+        description=("hvdlint: framework-aware static analysis for "
+                     "horovod_tpu (SPMD divergence, registry "
+                     "enforcement, lock discipline, trace purity)."))
+    p.add_argument("paths", nargs="*", default=["horovod_tpu"],
+                   help="files or directories to analyze "
+                        "(default: horovod_tpu)")
+    p.add_argument("-f", "--format", choices=sorted(RENDERERS),
+                   default="text", help="report format")
+    p.add_argument("--select", metavar="RULES",
+                   help="comma-separated rule ids to run "
+                        "(default: all)")
+    p.add_argument("--baseline", metavar="FILE",
+                   help=f"baseline file (default: {DEFAULT_BASELINE} "
+                        "in the current directory, when present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write all current findings to the baseline "
+                        "file and exit 0")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id}  {rule.summary}")
+        return 0
+
+    select = None
+    if args.select:
+        select = [r.strip() for r in args.select.split(",")
+                  if r.strip()]
+
+    # A gate that scans nothing must fail loudly, not report clean:
+    # a mistyped path (or a CI job run from the wrong cwd) would
+    # otherwise stay green forever.
+    for p in args.paths:
+        if not os.path.exists(p):
+            print(f"hvdlint: path does not exist: {p}",
+                  file=sys.stderr)
+            return 2
+
+    baseline_path = args.baseline or DEFAULT_BASELINE
+    baseline = None
+    if not args.no_baseline and not args.write_baseline:
+        if os.path.exists(baseline_path):
+            try:
+                baseline = baseline_mod.load(baseline_path)
+            except (OSError, ValueError) as e:
+                print(f"hvdlint: bad baseline {baseline_path}: {e}",
+                      file=sys.stderr)
+                return 2
+
+    try:
+        result = run_analysis(args.paths, select=select,
+                              baseline=baseline)
+    except ValueError as e:
+        print(f"hvdlint: {e}", file=sys.stderr)
+        return 2
+    if result.file_count == 0:
+        print("hvdlint: no python files found under "
+              f"{', '.join(args.paths)}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        with open(baseline_path, "w", encoding="utf-8") as fh:
+            fh.write(baseline_mod.render(result.findings))
+        print(f"hvdlint: wrote {len(result.findings)} finding(s) to "
+              f"{baseline_path}")
+        return 0
+
+    out = RENDERERS[args.format](
+        result.findings, suppressed=result.suppressed,
+        baselined=result.baselined)
+    sys.stdout.write(out)
+    for err in result.parse_errors:
+        print(f"hvdlint: {err}", file=sys.stderr)
+    return 1 if (result.findings or result.parse_errors) else 0

@@ -1,0 +1,313 @@
+"""Source model for hvdlint: parsed files, scopes, suppressions.
+
+Pure-AST by design — the analyzer never imports the code under
+analysis (no jax, no side effects, works on a checkout with missing
+extras). Everything downstream (rules, baseline, report) consumes the
+`Project`/`SourceFile`/`Finding` types defined here.
+
+Suppressions are flake8-noqa-style trailing comments, parsed with
+`tokenize` so string literals containing the marker never count:
+
+    do_thing()  # hvdlint: disable=HVD002 (launch plumbing: per-process)
+    # hvdlint: disable-next=HVD001 (subset collective on a process set)
+    collective_on_subset()
+
+A parenthesized free-text reason is encouraged and kept in the token
+stream for reviewers; the parser only consumes the rule list.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+RULE_IDS = ("HVD001", "HVD002", "HVD003", "HVD004")
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*hvdlint:\s*(disable|disable-next|disable-file)\s*="
+    r"\s*([A-Z0-9,\s]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic, anchored to a source location."""
+
+    rule: str
+    path: str          # posix, relative to the analysis cwd when under it
+    line: int
+    col: int
+    message: str
+    context: str       # enclosing function qualname, or "<module>"
+
+    @property
+    def fingerprint(self) -> str:
+        """Location-drift-tolerant identity for the baseline: line and
+        column are excluded, digits in the message are normalized so a
+        shifted anchor line quoted inside the text does not churn the
+        baseline."""
+        norm = re.sub(r"\d+", "N", self.message)
+        raw = "|".join((self.rule, self.path, self.context, norm))
+        return hashlib.sha256(raw.encode()).hexdigest()[:16]
+
+    def sort_key(self) -> Tuple:
+        return (self.path, self.line, self.col, self.rule, self.message)
+
+
+def attr_chain(node: ast.AST) -> str:
+    """Dotted text of a Name/Attribute chain ('jax.jit', 'self._lock');
+    '' for anything that is not a plain chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(call: ast.Call) -> str:
+    """Last segment of the called name ('allreduce' for
+    hvd.allreduce(...)), '' for computed callees."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+class Suppressions:
+    """Per-file suppression table: line -> set of rule ids (or the
+    wildcard 'ALL'); `disable-file` suppresses a rule everywhere."""
+
+    def __init__(self):
+        self.by_line: Dict[int, Set[str]] = {}
+        self.file_wide: Set[str] = set()
+
+    @classmethod
+    def parse(cls, source: str) -> "Suppressions":
+        sup = cls()
+        lines = source.splitlines()
+
+        def next_code_line(after: int) -> int:
+            """First 1-based line past `after` that is not blank or
+            comment-only, so a `disable-next` reason may wrap over
+            several comment lines."""
+            i = after  # 0-based index of the line after `after`
+            while i < len(lines):
+                stripped = lines[i].strip()
+                if stripped and not stripped.startswith("#"):
+                    return i + 1
+                i += 1
+            return after + 1
+
+        try:
+            tokens = tokenize.generate_tokens(
+                io.StringIO(source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if not m:
+                    continue
+                kind = m.group(1)
+                rules = {r.strip() for r in m.group(2).split(",")
+                         if r.strip()}
+                if kind == "disable-file":
+                    sup.file_wide |= rules
+                else:
+                    line = (next_code_line(tok.start[0])
+                            if kind == "disable-next"
+                            else tok.start[0])
+                    sup.by_line.setdefault(line, set()).update(rules)
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            pass  # an unparsable file already fails elsewhere
+        return sup
+
+    def covers(self, rule: str, line: int) -> bool:
+        if rule in self.file_wide or "ALL" in self.file_wide:
+            return True
+        rules = self.by_line.get(line)
+        return bool(rules) and (rule in rules or "ALL" in rules)
+
+
+class SourceFile:
+    """One parsed python file plus derived lookup tables."""
+
+    def __init__(self, path: str, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.tree: Optional[ast.Module] = None
+        self.error: Optional[str] = None
+        try:
+            self.tree = ast.parse(source, filename=rel)
+        except SyntaxError as e:
+            self.error = f"syntax error: {e.msg} (line {e.lineno})"
+            self.suppressions = Suppressions()
+            return
+        self.suppressions = Suppressions.parse(source)
+        # Enclosing-function qualname per function node, plus parent
+        # links (ast has none natively).
+        self.qualname: Dict[ast.AST, str] = {}
+        self.parent: Dict[ast.AST, ast.AST] = {}
+        self._annotate(self.tree, prefix="")
+
+    def _annotate(self, node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            self.parent[child] = node
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                q = f"{prefix}{child.name}"
+                self.qualname[child] = q
+                self._annotate(child, prefix=q + ".")
+            elif isinstance(child, ast.ClassDef):
+                self._annotate(child, prefix=f"{prefix}{child.name}.")
+            else:
+                self._annotate(child, prefix=prefix)
+
+    def context_of(self, node: ast.AST) -> str:
+        """Qualname of the innermost function containing `node`."""
+        cur = node
+        while cur is not None:
+            if cur in self.qualname:
+                return self.qualname[cur]
+            cur = self.parent.get(cur)
+        return "<module>"
+
+    def functions(self) -> Iterable[ast.AST]:
+        for node, _q in self.qualname.items():
+            yield node
+
+
+@dataclasses.dataclass
+class KnobDecl:
+    env: str
+    line: int
+
+
+class KnobRegistry:
+    """The `Knob` declarations and `_ATTR_MAP` of a config module,
+    extracted from its AST (never imported)."""
+
+    def __init__(self, rel: str):
+        self.rel = rel
+        self.knobs: List[KnobDecl] = []
+        self.attr_map: Dict[str, str] = {}
+
+    @property
+    def declared(self) -> Set[str]:
+        return {k.env for k in self.knobs}
+
+    @classmethod
+    def extract(cls, sf: SourceFile) -> Optional["KnobRegistry"]:
+        """Returns a registry if `sf` declares one (a KNOBS list of
+        Knob(...) calls), else None."""
+        if sf.tree is None:
+            return None
+        reg = cls(sf.rel)
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (node.targets
+                           if isinstance(node, ast.Assign)
+                           else [node.target])
+                if node.value is None:
+                    continue
+                for tgt in targets:
+                    name = tgt.id if isinstance(tgt, ast.Name) else (
+                        tgt.attr if isinstance(tgt, ast.Attribute)
+                        else "")
+                    if name == "KNOBS" and isinstance(node.value,
+                                                      ast.List):
+                        for elt in node.value.elts:
+                            if (isinstance(elt, ast.Call)
+                                    and call_name(elt) == "Knob"
+                                    and elt.args):
+                                env = str_const(elt.args[0])
+                                if env:
+                                    reg.knobs.append(
+                                        KnobDecl(env, elt.lineno))
+                    elif name == "_ATTR_MAP" and isinstance(
+                            node.value, ast.Dict):
+                        for k, v in zip(node.value.keys,
+                                        node.value.values):
+                            ks, vs = str_const(k), str_const(v)
+                            if ks and vs:
+                                reg.attr_map[ks] = vs
+        return reg if reg.knobs else None
+
+
+class Project:
+    """The full set of files under analysis plus cross-file tables the
+    whole-program rules (HVD002/HVD003) need."""
+
+    def __init__(self, files: List[SourceFile]):
+        self.files = sorted(files, key=lambda f: f.rel)
+        self.registry: Optional[KnobRegistry] = None
+        self.registry_file: Optional[SourceFile] = None
+        for sf in self.files:
+            reg = KnobRegistry.extract(sf)
+            if reg is not None:
+                self.registry = reg
+                self.registry_file = sf
+                break
+
+
+def _rel(path: str, cwd: str) -> str:
+    ap = os.path.abspath(path)
+    try:
+        r = os.path.relpath(ap, cwd)
+    except ValueError:  # different drive (windows)
+        return ap.replace(os.sep, "/")
+    if r.startswith(".."):
+        return ap.replace(os.sep, "/")
+    return r.replace(os.sep, "/")
+
+
+def collect_files(paths: Iterable[str],
+                  cwd: Optional[str] = None) -> List[SourceFile]:
+    """Expand files/directories into parsed SourceFiles, sorted by
+    relative path for deterministic reports."""
+    cwd = cwd or os.getcwd()
+    seen: Dict[str, None] = {}
+    out: List[SourceFile] = []
+    for p in paths:
+        ap = os.path.abspath(p)
+        if os.path.isdir(ap):
+            cands = []
+            for root, dirs, names in os.walk(ap):
+                dirs[:] = sorted(d for d in dirs
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        cands.append(os.path.join(root, n))
+        elif ap.endswith(".py"):
+            cands = [ap]
+        else:
+            cands = []
+        for c in cands:
+            if c in seen:
+                continue
+            seen[c] = None
+            try:
+                with open(c, "r", encoding="utf-8",
+                          errors="replace") as fh:
+                    src = fh.read()
+            except OSError:
+                continue
+            out.append(SourceFile(c, _rel(c, cwd), src))
+    return sorted(out, key=lambda f: f.rel)

@@ -1,0 +1,84 @@
+"""Report renderers: human text, machine JSON, GitHub annotations.
+
+All three are deterministic functions of the sorted finding list — no
+timestamps, no absolute paths, no environment — so two runs over the
+same tree emit byte-identical output (asserted by the test suite; CI
+diffing and caching both depend on it).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List
+
+from .model import Finding
+
+JSON_VERSION = 1
+
+
+def render_text(findings: List[Finding],
+                suppressed: int = 0,
+                baselined: int = 0) -> str:
+    lines = [
+        f"{f.path}:{f.line}:{f.col}: {f.rule} {f.message}"
+        for f in sorted(findings, key=Finding.sort_key)
+    ]
+    by_rule: Dict[str, int] = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    tail = ", ".join(f"{r}={n}" for r, n in sorted(by_rule.items()))
+    lines.append(
+        f"hvdlint: {len(findings)} finding(s)"
+        + (f" [{tail}]" if tail else "")
+        + (f", {suppressed} suppressed" if suppressed else "")
+        + (f", {baselined} baselined" if baselined else ""))
+    return "\n".join(lines) + "\n"
+
+
+def render_json(findings: List[Finding],
+                suppressed: int = 0,
+                baselined: int = 0) -> str:
+    doc = {
+        "version": JSON_VERSION,
+        "counts": {
+            "findings": len(findings),
+            "suppressed": suppressed,
+            "baselined": baselined,
+        },
+        "findings": [
+            {
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,
+                "col": f.col,
+                "context": f.context,
+                "message": f.message,
+                "fingerprint": f.fingerprint,
+            }
+            for f in sorted(findings, key=Finding.sort_key)
+        ],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True) + "\n"
+
+
+def _gh_escape(s: str) -> str:
+    return (s.replace("%", "%25").replace("\r", "%0D")
+            .replace("\n", "%0A"))
+
+
+def render_github(findings: List[Finding], **_kw) -> str:
+    """GitHub Actions workflow-command annotations: findings render as
+    inline PR errors with file:line anchors."""
+    lines = [
+        f"::error file={f.path},line={f.line},col={f.col},"
+        f"title=hvdlint {f.rule}::{_gh_escape(f.message)}"
+        for f in sorted(findings, key=Finding.sort_key)
+    ]
+    return ("\n".join(lines) + "\n") if lines else ""
+
+
+RENDERERS = {
+    "text": render_text,
+    "json": render_json,
+    "github": render_github,
+}

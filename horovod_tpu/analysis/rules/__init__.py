@@ -1,0 +1,37 @@
+"""hvdlint rule registry.
+
+Each rule is a class with a stable id (HVD001+), a one-line summary,
+and a `run(project) -> list[Finding]` entry point. Rules are pure
+functions of the `Project` source model — no imports of the code under
+analysis, no environment reads, no wall-clock — so two runs over the
+same tree produce byte-identical reports.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Type
+
+from ..model import Finding, Project
+
+
+class Rule:
+    id: str = ""
+    summary: str = ""
+
+    def run(self, project: Project) -> List[Finding]:
+        raise NotImplementedError
+
+
+from .spmd import SpmdDivergenceRule        # noqa: E402
+from .registry import RegistryRule          # noqa: E402
+from .locks import LockDisciplineRule       # noqa: E402
+from .trace import TracePurityRule          # noqa: E402
+
+ALL_RULES: List[Type[Rule]] = [
+    SpmdDivergenceRule,
+    RegistryRule,
+    LockDisciplineRule,
+    TracePurityRule,
+]
+
+RULES_BY_ID: Dict[str, Type[Rule]] = {r.id: r for r in ALL_RULES}

@@ -1,0 +1,300 @@
+"""HVD003 — lock discipline: blocking calls under locks and
+cross-module acquisition-order inversions.
+
+Part A (local): a blocking operation — socket recv/accept/sendall,
+`subprocess.*`, `time.sleep`, HTTP requests, collective submits,
+`Event.wait` — lexically inside a `with <lock>:` body serializes every
+other thread contending that lock behind a peer's network latency.
+The control-plane races PR2/PR3 chased at runtime all reduce to this
+shape. `Condition.wait` on the lock actually held is exempt (it
+releases), as is anything inside a nested `def` (deferred execution).
+
+Part B (global): every `with <lock>` nesting (lexical, plus one level
+of intra-module call indirection, plus calls into the metrics
+registry, which take the metrics locks) contributes held->acquired
+edges to one project-wide graph keyed by `file::Class.attr`. A pair of
+locks acquired in both orders anywhere in the tree is a deadlock
+waiting for the right interleaving — reported once per pair with both
+witness sites, the MUST-style shift-left for the TSAN stress binary.
+
+Lock recognition is lexical: a `with` over a bare Name/Attribute whose
+last segment is `lock`/`mu`/`mutex`/`cv`/`cond[ition]` (optionally
+prefixed, e.g. `_io_lock`). Name your locks like locks.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..model import Finding, Project, SourceFile, attr_chain, call_name
+from . import Rule
+from .spmd import COLLECTIVES
+
+_LOCK_SEG = re.compile(
+    r"^_{0,2}(?:[a-z0-9]+_)*(?:lock|mu|mutex|cv|cond|condition)$")
+
+# Blocking by fully-qualified-ish chain suffix.
+_BLOCKING_CHAINS = (
+    "time.sleep", "subprocess.run", "subprocess.call",
+    "subprocess.check_call", "subprocess.check_output",
+    "subprocess.Popen", "urllib.request.urlopen", "requests.get",
+    "requests.post", "requests.put", "requests.delete",
+    "requests.request", "select.select",
+)
+# Blocking by method name on any receiver (socket / http.client).
+_BLOCKING_METHODS = {
+    "recv", "recv_into", "recvfrom", "accept", "sendall", "sendto",
+    "getresponse", "connect",
+}
+_COLLECTIVE_SUBMITS = COLLECTIVES | {"synchronize"}
+
+
+def lock_name(expr: ast.AST) -> Optional[str]:
+    """Normalized chain when `expr` looks like a lock object."""
+    chain = attr_chain(expr)
+    if not chain:
+        return None
+    seg = chain.split(".")[-1]
+    if _LOCK_SEG.match(seg):
+        return chain
+    return None
+
+
+def _node_id(sf: SourceFile, with_node: ast.AST, chain: str) -> str:
+    """Project-wide lock identity: file::Class.attr for instance
+    locks, file::name for module globals."""
+    owner = ""
+    if chain.split(".")[0] in ("self", "cls"):
+        cur = with_node
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                owner = cur.name + "."
+                break
+            cur = sf.parent.get(cur)
+        chain = chain.split(".", 1)[1]
+    return f"{sf.rel}::{owner}{chain}"
+
+
+METRICS_NODE = "horovod_tpu/metrics.py::_Metric._lock"
+
+
+def _is_metrics_touch(call: ast.Call) -> bool:
+    """Calls that take the metrics locks internally (registry
+    registration or a series mutator)."""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return call_name(call) == "record_collective"
+    if f.attr in ("inc", "dec", "observe"):
+        return True
+    recv = attr_chain(f.value)
+    recv_l = recv.lower()
+    metric_ish = ("_m_" in recv_l or "metric" in recv_l
+                  or "gauge" in recv_l
+                  or recv.split(".")[-1] in ("_METRICS", "REGISTRY"))
+    if f.attr in ("counter", "gauge", "histogram", "snapshot",
+                  "generate_text", "labels", "set", "value"):
+        return metric_ish
+    return False
+
+
+def _blocking_reason(call: ast.Call,
+                     held_exprs: Set[str]) -> Optional[str]:
+    chain = attr_chain(call.func)
+    name = call_name(call)
+    for b in _BLOCKING_CHAINS:
+        if chain == b or chain.endswith("." + b):
+            return f"'{chain}'"
+    if chain == "sleep" or chain == "urlopen":
+        return f"'{chain}'"
+    if isinstance(call.func, ast.Attribute):
+        recv = attr_chain(call.func.value)
+        if name in _BLOCKING_METHODS:
+            return f"'{chain or name}'"
+        if name in ("wait", "wait_for") and recv not in held_exprs:
+            # Event.wait blocks without releasing the held lock;
+            # Condition.wait on the held lock itself releases it.
+            return f"'{chain}' (does not release the held lock)"
+        if name == "join":
+            seg = recv.split(".")[-1].lower()
+            if any(k in seg for k in ("thread", "proc", "worker",
+                                      "pump", "server")):
+                return f"'{chain}'"
+    if name in _COLLECTIVE_SUBMITS:
+        return f"collective '{name}()'"
+    return None
+
+
+class _Walker:
+    def __init__(self, rule: "LockDisciplineRule", sf: SourceFile,
+                 local_locks: Dict[str, List[Tuple[str, int]]]):
+        self.rule = rule
+        self.sf = sf
+        self.local_locks = local_locks
+
+    def _class_of(self, node: ast.AST) -> str:
+        cur = node
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            cur = self.sf.parent.get(cur)
+        return ""
+
+    def walk_function(self, fn: ast.AST) -> None:
+        self.walk_block(fn.body, held=[])
+
+    def walk_block(self, stmts: List[ast.stmt],
+                   held: List[Tuple[str, str, int]]) -> None:
+        """held: list of (node_id, source_chain, line)."""
+        for stmt in stmts:
+            self.walk_stmt(stmt, held)
+
+    def walk_stmt(self, stmt: ast.stmt,
+                  held: List[Tuple[str, str, int]]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # deferred execution: not under this lock
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            new_held = list(held)
+            for item in stmt.items:
+                self.scan_exprs(item.context_expr, new_held)
+                ln = lock_name(item.context_expr)
+                if ln:
+                    nid = _node_id(self.sf, stmt, ln)
+                    for h_id, _hc, _hl in new_held:
+                        self.rule.add_edge(h_id, nid, self.sf,
+                                           stmt.lineno)
+                    new_held.append((nid, ln, stmt.lineno))
+            self.walk_block(stmt.body, new_held)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self.scan_exprs(child, held)
+            elif isinstance(child, ast.stmt):
+                self.walk_stmt(child, held)
+
+    def scan_exprs(self, expr: ast.AST,
+                   held: List[Tuple[str, str, int]]) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Lambda):
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            if held:
+                reason = _blocking_reason(
+                    node, {hc for _hid, hc, _hl in held})
+                if reason:
+                    hid, _hc, hline = held[-1]
+                    self.rule.report(
+                        self.sf, node,
+                        f"blocking call {reason} while holding lock "
+                        f"'{hid}' (held since line {hline}); every "
+                        f"contender stalls behind this operation")
+                # cross-module: metrics locks
+                if _is_metrics_touch(node):
+                    for h_id, _hc, _hl in held:
+                        self.rule.add_edge(h_id, METRICS_NODE,
+                                           self.sf, node.lineno)
+                # one level of intra-module indirection
+                key = self._local_call_key(node)
+                if key and key in self.local_locks:
+                    for inner_id, _iline in self.local_locks[key]:
+                        for h_id, _hc, _hl in held:
+                            if h_id != inner_id:
+                                self.rule.add_edge(h_id, inner_id,
+                                                   self.sf,
+                                                   node.lineno)
+
+    def _local_call_key(self, call: ast.Call) -> Optional[str]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            return f.id
+        if (isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("self", "cls")):
+            cls = self._class_of(call)
+            return f"{cls}.{f.attr}" if cls else None
+        return None
+
+
+class LockDisciplineRule(Rule):
+    id = "HVD003"
+    summary = ("blocking operation inside a lock body, or lock-"
+               "acquisition-order inversion across modules")
+
+    def __init__(self):
+        self.findings: List[Finding] = []
+        # (from, to) -> first witness (rel, line)
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    def report(self, sf: SourceFile, node: ast.AST,
+               message: str) -> None:
+        self.findings.append(Finding(
+            self.id, sf.rel, node.lineno, node.col_offset + 1,
+            message, sf.context_of(node)))
+
+    def add_edge(self, frm: str, to: str, sf: SourceFile,
+                 line: int) -> None:
+        if frm == to:
+            return
+        key = (frm, to)
+        if key not in self.edges or (sf.rel, line) < self.edges[key]:
+            self.edges[key] = (sf.rel, line)
+
+    @staticmethod
+    def _locks_acquired(fn: ast.AST,
+                        sf: SourceFile) -> List[Tuple[str, int]]:
+        """Lock node-ids a function acquires anywhere in its own body
+        (nested defs excluded) — the one-level indirection table."""
+        out: List[Tuple[str, int]] = []
+
+        def walk(stmts):
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    for item in stmt.items:
+                        ln = lock_name(item.context_expr)
+                        if ln:
+                            out.append((_node_id(sf, stmt, ln),
+                                        stmt.lineno))
+                walk([c for c in ast.iter_child_nodes(stmt)
+                      if isinstance(c, ast.stmt)])
+        walk(fn.body)
+        return out
+
+    def run(self, project: Project) -> List[Finding]:
+        self.findings = []
+        self.edges = {}
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            local_locks: Dict[str, List[Tuple[str, int]]] = {}
+            for fn, qual in sf.qualname.items():
+                acq = self._locks_acquired(fn, sf)
+                if acq:
+                    local_locks[qual] = acq
+            w = _Walker(self, sf, local_locks)
+            for fn in sf.qualname:
+                w.walk_function(fn)
+            w.walk_block(
+                [s for s in sf.tree.body
+                 if not isinstance(s, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef,
+                                       ast.ClassDef))], held=[])
+        # ---- inversions ------------------------------------------------
+        for (a, b) in sorted(self.edges):
+            if a < b and (b, a) in self.edges:
+                rel1, line1 = self.edges[(a, b)]
+                rel2, line2 = self.edges[(b, a)]
+                self.findings.append(Finding(
+                    self.id, rel1, line1, 1,
+                    f"lock-order inversion: '{a}' is taken before "
+                    f"'{b}' here, but '{b}' before '{a}' at "
+                    f"{rel2}:{line2}; the two orders deadlock under "
+                    f"the right interleaving",
+                    "<lock-graph>"))
+        return self.findings

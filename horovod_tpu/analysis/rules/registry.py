@@ -1,0 +1,174 @@
+"""HVD002 — registry enforcement: config knobs and metric names.
+
+Three invariants, all whole-program:
+
+1. Every `os.environ` / `os.getenv` read of a `HOROVOD_*` name outside
+   the declaring config module must go away: reads of DECLARED knobs
+   bypass the registry's typing/defaulting/`--help` enumeration (use
+   `common.config.env_value`), and reads of UNDECLARED names are knobs
+   the doctor and docs cannot see. Launch-plumbing reads that are
+   genuinely process-scoped carry explicit suppressions.
+2. Every declared `Knob` must have >= 1 use outside the config module
+   (its env name as a string constant — reads, child-env propagation —
+   or an `_ATTR_MAP` attribute access); a knob nothing reads is dead
+   config surface that silently lies in `hvdrun --help`.
+3. Every literal metric name passed to `<registry>.counter/gauge/
+   histogram` is registered at exactly ONE source site. Registration
+   is idempotent at runtime, so a second site "works" — until its doc
+   string, type, or label set drifts from the first; a lookup of a
+   never-registered literal name is a typo that returns None at 3am.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..model import (Finding, Project, SourceFile, attr_chain,
+                     call_name, str_const)
+from . import Rule
+
+ENV_PREFIX = "HOROVOD_"
+METRIC_REG_METHODS = ("counter", "gauge", "histogram")
+
+
+def env_read_key(node: ast.AST) -> Optional[Tuple[str, ast.AST]]:
+    """(env-name, anchor) when `node` reads an environment variable
+    with a literal key: os.environ[k], os.environ.get(k, ...),
+    os.getenv(k). Writes (Store/Del), .pop() and .setdefault() are
+    child-process plumbing, not reads."""
+    if isinstance(node, ast.Subscript):
+        if not isinstance(node.ctx, ast.Load):
+            return None
+        if attr_chain(node.value).split(".")[-1] != "environ":
+            return None
+        key = node.slice
+        if isinstance(key, ast.Index):  # py<3.9 compat trees
+            key = key.value
+        s = str_const(key)
+        return (s, node) if s else None
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "get":
+            if attr_chain(f.value).split(".")[-1] != "environ":
+                return None
+        elif call_name(node) == "getenv":
+            pass
+        else:
+            return None
+        if node.args:
+            s = str_const(node.args[0])
+            return (s, node) if s else None
+    return None
+
+
+def _registry_receiver(chain: str) -> bool:
+    last = chain.split(".")[-1] if chain else ""
+    low = chain.lower()
+    return ("registry" in low or last in ("_METRICS", "REGISTRY")
+            or low.endswith("metrics"))
+
+
+class RegistryRule(Rule):
+    id = "HVD002"
+    summary = ("HOROVOD_* env read bypassing the Knob registry, "
+               "unused knob, or metric name not registered exactly "
+               "once")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        reg = project.registry
+        declared: Set[str] = reg.declared if reg else set()
+        used: Set[str] = set()
+        # metric name -> sorted list of (rel, line, col, context)
+        metric_sites: Dict[str, List[Tuple[str, int, int, str]]] = {}
+        metric_lookups: List[Tuple[SourceFile, ast.AST, str]] = []
+
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            is_registry = reg is not None and sf.rel == reg.rel
+            for node in ast.walk(sf.tree):
+                # ---- metric registrations / lookups (all files) ----
+                if isinstance(node, ast.Call):
+                    f = node.func
+                    if (isinstance(f, ast.Attribute)
+                            and f.attr in METRIC_REG_METHODS
+                            and node.args):
+                        name = str_const(node.args[0])
+                        if name:
+                            metric_sites.setdefault(name, []).append(
+                                (sf.rel, node.lineno,
+                                 node.col_offset + 1,
+                                 sf.context_of(node)))
+                    elif (isinstance(f, ast.Attribute)
+                          and f.attr == "get"
+                          and _registry_receiver(attr_chain(f.value))
+                          and node.args):
+                        name = str_const(node.args[0])
+                        if name and name.startswith("hvd"):
+                            metric_lookups.append((sf, node, name))
+                if is_registry:
+                    continue
+                # ---- knob uses (string constants / attr accesses) --
+                s = str_const(node)
+                if s and s in declared:
+                    used.add(s)
+                if (isinstance(node, ast.Attribute)
+                        and isinstance(node.ctx, ast.Load) and reg
+                        and node.attr in reg.attr_map):
+                    used.add(reg.attr_map[node.attr])
+                # ---- direct env reads ------------------------------
+                er = env_read_key(node)
+                if er and er[0].startswith(ENV_PREFIX):
+                    env, anchor = er
+                    if env in declared:
+                        msg = (f"direct environ read of declared knob "
+                               f"'{env}' bypasses the config registry; "
+                               f"use common.config.env_value('{env}') "
+                               f"(typed, defaulted, doctor-visible)")
+                    elif reg is not None:
+                        msg = (f"environ read of undeclared "
+                               f"'{env}'; declare a Knob in "
+                               f"{reg.rel} so --help and the doctor "
+                               f"can enumerate it")
+                    else:
+                        msg = (f"environ read of '{env}' outside a "
+                               f"Knob registry")
+                    findings.append(Finding(
+                        self.id, sf.rel, anchor.lineno,
+                        anchor.col_offset + 1, msg,
+                        sf.context_of(anchor)))
+
+        # ---- declared-but-unused knobs ----------------------------------
+        if reg is not None and project.registry_file is not None:
+            rf = project.registry_file
+            for kd in reg.knobs:
+                if kd.env not in used:
+                    findings.append(Finding(
+                        self.id, rf.rel, kd.line, 1,
+                        f"knob '{kd.env}' is declared but never used "
+                        f"outside the registry; dead config surface "
+                        f"lies in hvdrun --help", "<module>"))
+
+        # ---- metric names registered exactly once -----------------------
+        for name in sorted(metric_sites):
+            sites = sorted(metric_sites[name])
+            if len(sites) > 1:
+                first = sites[0]
+                for rel, line, col, ctx in sites[1:]:
+                    findings.append(Finding(
+                        self.id, rel, line, col,
+                        f"metric '{name}' is also registered at "
+                        f"{first[0]}:{first[1]}; a name must be "
+                        f"registered at exactly one site or its "
+                        f"doc/type/labels can drift", ctx))
+        registered = set(metric_sites)
+        for sf, node, name in metric_lookups:
+            if name not in registered:
+                findings.append(Finding(
+                    self.id, sf.rel, node.lineno, node.col_offset + 1,
+                    f"metric '{name}' is looked up but never "
+                    f"registered anywhere in the scanned sources "
+                    f"(typo or dead lookup)", sf.context_of(node)))
+        return findings

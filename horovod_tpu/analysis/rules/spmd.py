@@ -1,0 +1,318 @@
+"""HVD001 — SPMD-divergence: collectives under rank-dependent control
+flow.
+
+The coordinator's core invariant (controller.cc; SURVEY.md §5.2) is
+that every member of a process set submits the same collective
+schedule. `if hvd.rank() == 0: hvd.allreduce(...)` violates it
+statically: rank 0 blocks in negotiation forever while every other
+rank never shows up — the classic SPMD deadlock that MUST-style MPI
+verifiers catch from source. This pass finds collective calls that are
+only reachable under control flow conditioned on `rank()` /
+`local_rank()` / `cross_rank()` / `size()`-family queries (directly,
+through a variable assigned from one, through an early
+`if rank() != 0: return` guard, or through one level of intra-module
+call indirection).
+
+`size()`-family conditions are included deliberately: while `size()`
+is uniform within one stable world, elastic resizes make "the world I
+saw at condition time" and "the world at submit time" different
+epochs, so a size-gated collective is still a schedule hazard worth an
+explicit suppression when intended (e.g. a single-process fast path).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..model import Finding, Project, SourceFile, attr_chain, call_name
+from . import Rule
+
+# Calls (by last name segment, zero positional args) whose result is
+# rank-dependent — the divergence atoms.
+RANK_ATOMS = {"rank", "local_rank", "cross_rank"}
+# Uniform within a stable world, but an epoch hazard under elastic.
+SIZE_ATOMS = {"size", "local_size", "cross_size"}
+TAINT_ATOMS = RANK_ATOMS | SIZE_ATOMS
+
+# Calls that submit to the collective schedule, by last name segment.
+COLLECTIVES = {
+    "allreduce", "allreduce_async",
+    "grouped_allreduce", "grouped_allreduce_async",
+    "allgather", "allgather_async",
+    "grouped_allgather", "grouped_allgather_async",
+    "reducescatter", "reducescatter_async",
+    "grouped_reducescatter", "grouped_reducescatter_async",
+    "broadcast", "broadcast_async",
+    "alltoall", "alltoall_async",
+    "barrier", "check_execution_order",
+    "broadcast_parameters", "broadcast_object",
+    "broadcast_optimizer_state", "broadcast_variables",
+}
+
+# `join` doubles as str.join/Thread.join; only these receivers (or a
+# bare call) make it the collective.
+JOIN_RECEIVERS = {"hvd", "horovod_tpu", "collective_ops", "basics"}
+
+# ops/collective_ops.py internals that ARE the submission path; a
+# rank-guarded call to one of these is as divergent as the public API.
+COLLECTIVE_OPS_INTERNALS = {"_run", "_controller_mixed_group", "submit"}
+
+
+def _is_collective(call: ast.Call, extras: Set[str]) -> Optional[str]:
+    name = call_name(call)
+    if not name:
+        return None
+    if name in COLLECTIVES or name in extras:
+        return name
+    if name == "join":
+        if isinstance(call.func, ast.Name):
+            return name
+        chain = attr_chain(call.func)
+        recv = chain.rsplit(".", 2)[-2] if "." in chain else ""
+        if recv in JOIN_RECEIVERS:
+            return name
+    return None
+
+
+def _terminates(stmts: List[ast.stmt]) -> bool:
+    """Whether a block unconditionally leaves the enclosing scope."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, (ast.Return, ast.Raise, ast.Continue,
+                         ast.Break)):
+        return True
+    if isinstance(last, ast.Expr) and isinstance(last.value, ast.Call):
+        chain = attr_chain(last.value.func)
+        return chain in ("sys.exit", "os._exit", "exit")
+    return False
+
+
+class _FunctionPass:
+    """Taint walk over one function (or module) body."""
+
+    def __init__(self, rule: "SpmdDivergenceRule", sf: SourceFile,
+                 extras: Set[str],
+                 local_coll: Dict[str, Tuple[int, str]],
+                 class_name: str):
+        self.rule = rule
+        self.sf = sf
+        self.extras = extras
+        self.local_coll = local_coll
+        self.class_name = class_name
+        self.tainted_vars: Set[str] = set()
+
+    # -- taint detection -----------------------------------------------------
+    def taint_of(self, expr: ast.AST) -> Optional[Tuple[str, int]]:
+        """(description, line) of the first rank-dependent atom in an
+        expression, else None."""
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                n = call_name(node)
+                if (n in TAINT_ATOMS and not node.args
+                        and not node.keywords):
+                    return (f"{n}()", node.lineno)
+            elif (isinstance(node, ast.Name)
+                  and isinstance(node.ctx, ast.Load)
+                  and node.id in self.tainted_vars):
+                return (node.id, node.lineno)
+        return None
+
+    # -- findings ------------------------------------------------------------
+    def _local_target(self, call: ast.Call) -> Optional[str]:
+        """Key into local_coll for a same-module call, if any."""
+        f = call.func
+        if isinstance(f, ast.Name):
+            return f.id
+        if (isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id in ("self", "cls") and self.class_name):
+            return f"{self.class_name}.{f.attr}"
+        return None
+
+    def _check_call(self, call: ast.Call,
+                    taints: List[Tuple[str, int]]) -> None:
+        if not taints:
+            return
+        atom, aline = taints[-1]
+        cname = _is_collective(call, self.extras)
+        if cname:
+            self.rule.report(
+                self.sf, call,
+                f"collective '{cname}()' is only reached under "
+                f"rank-dependent control flow (condition on {atom} at "
+                f"line {aline}); a divergent schedule deadlocks the "
+                f"process set")
+            return
+        key = self._local_target(call)
+        if key is not None and key in self.local_coll:
+            dline, dcoll = self.local_coll[key]
+            self.rule.report(
+                self.sf, call,
+                f"call to '{key}' (line {dline}) reaches collective "
+                f"'{dcoll}()' under rank-dependent control flow "
+                f"(condition on {atom} at line {aline}); a divergent "
+                f"schedule deadlocks the process set")
+
+    # -- expression walk (IfExp / BoolOp short-circuit aware) ---------------
+    def scan_expr(self, expr: ast.AST,
+                  taints: List[Tuple[str, int]]) -> None:
+        if isinstance(expr, ast.IfExp):
+            t = self.taint_of(expr.test)
+            self.scan_expr(expr.test, taints)
+            inner = taints + [t] if t else taints
+            self.scan_expr(expr.body, inner)
+            self.scan_expr(expr.orelse, inner)
+            return
+        if isinstance(expr, ast.BoolOp):
+            cur = list(taints)
+            for operand in expr.values:
+                self.scan_expr(operand, cur)
+                t = self.taint_of(operand)
+                if t:
+                    cur = cur + [t]
+            return
+        if isinstance(expr, ast.Call):
+            self._check_call(expr, taints)
+            for child in ast.iter_child_nodes(expr):
+                self.scan_expr(child, taints)
+            return
+        if isinstance(expr, ast.Lambda):
+            return  # deferred body; analyzed nowhere (call site unknown)
+        for child in ast.iter_child_nodes(expr):
+            self.scan_expr(child, taints)
+
+    # -- statement walk ------------------------------------------------------
+    def visit_block(self, stmts: List[ast.stmt],
+                    taints: List[Tuple[str, int]]) -> None:
+        taints = list(taints)
+        for stmt in stmts:
+            self.visit_stmt(stmt, taints)
+            # An `if <rank-cond>: return/raise` guard makes everything
+            # after it in this block rank-conditional.
+            if isinstance(stmt, ast.If):
+                t = self.taint_of(stmt.test)
+                if t and (_terminates(stmt.body)
+                          or _terminates(stmt.orelse)):
+                    taints.append(t)
+
+    def visit_stmt(self, stmt: ast.stmt,
+                   taints: List[Tuple[str, int]]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # analyzed as their own scopes
+        if isinstance(stmt, ast.If):
+            t = self.taint_of(stmt.test)
+            self.scan_expr(stmt.test, taints)
+            inner = taints + [t] if t else taints
+            self.visit_block(stmt.body, inner)
+            self.visit_block(stmt.orelse, inner)
+            return
+        if isinstance(stmt, ast.While):
+            t = self.taint_of(stmt.test)
+            self.scan_expr(stmt.test, taints)
+            self.visit_block(stmt.body, taints + [t] if t else taints)
+            self.visit_block(stmt.orelse, taints)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            value = stmt.value
+            if value is not None:
+                self.scan_expr(value, taints)
+                if self.taint_of(value):
+                    targets = (stmt.targets
+                               if isinstance(stmt, ast.Assign)
+                               else [stmt.target])
+                    for tgt in targets:
+                        if isinstance(tgt, ast.Name):
+                            self.tainted_vars.add(tgt.id)
+            return
+        if isinstance(stmt, ast.For):
+            self.scan_expr(stmt.iter, taints)
+            self.visit_block(stmt.body, taints)
+            self.visit_block(stmt.orelse, taints)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self.scan_expr(item.context_expr, taints)
+            self.visit_block(stmt.body, taints)
+            return
+        if isinstance(stmt, ast.Try):
+            self.visit_block(stmt.body, taints)
+            for h in stmt.handlers:
+                self.visit_block(h.body, taints)
+            self.visit_block(stmt.orelse, taints)
+            self.visit_block(stmt.finalbody, taints)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self.scan_expr(child, taints)
+            elif isinstance(child, ast.stmt):
+                self.visit_stmt(child, taints)
+
+
+class SpmdDivergenceRule(Rule):
+    id = "HVD001"
+    summary = ("collective call reachable only under rank-/size-"
+               "conditional control flow (SPMD deadlock)")
+
+    def __init__(self):
+        self.findings: List[Finding] = []
+        self._sf: Optional[SourceFile] = None
+
+    def report(self, sf: SourceFile, node: ast.AST,
+               message: str) -> None:
+        self.findings.append(Finding(
+            self.id, sf.rel, node.lineno, node.col_offset + 1,
+            message, sf.context_of(node)))
+
+    # -- per-module local collective map ------------------------------------
+    @staticmethod
+    def _direct_collectives(fn: ast.AST,
+                            extras: Set[str]) -> Optional[str]:
+        """Name of the first collective called directly (outside
+        nested defs) in `fn`'s body, else None."""
+        def walk(stmts):
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                for node in ast.walk(stmt):
+                    if isinstance(node, ast.Call):
+                        c = _is_collective(node, extras)
+                        if c:
+                            return c
+            return None
+        return walk(fn.body)
+
+    def run(self, project: Project) -> List[Finding]:
+        self.findings = []
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            extras = (COLLECTIVE_OPS_INTERNALS
+                      if sf.rel.endswith("ops/collective_ops.py")
+                      else set())
+            # one level of intra-module indirection: name -> (line,
+            # collective) for functions that directly submit.
+            local_coll: Dict[str, Tuple[int, str]] = {}
+            for fn, qual in sf.qualname.items():
+                c = self._direct_collectives(fn, extras)
+                if c:
+                    # the qualname doubles as the lookup key: bare
+                    # name for module functions, Class.name for
+                    # methods (resolved from self.x() call sites)
+                    local_coll[qual] = (fn.lineno, c)
+            # walk each function scope, then the module scope
+            for fn, qual in sf.qualname.items():
+                cls = qual.rsplit(".", 1)[0] if "." in qual else ""
+                fp = _FunctionPass(self, sf, extras, local_coll, cls)
+                fp.visit_block(fn.body, [])
+            fp = _FunctionPass(self, sf, extras, local_coll, "")
+            fp.visit_block(
+                [s for s in sf.tree.body
+                 if not isinstance(s, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef,
+                                       ast.ClassDef))], [])
+        return self.findings

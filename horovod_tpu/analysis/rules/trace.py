@@ -1,0 +1,161 @@
+"""HVD004 — trace purity: Python side-effects inside jit/shard_map/
+pmap-traced functions.
+
+A traced function's Python body runs ONCE, at trace time; the compiled
+XLA program replays forever after. A `metrics.inc()`, `faults.fire()`,
+`os.environ` read, or `time.perf_counter()` inside one therefore
+silently freezes: the counter bumps once per compilation (not per
+step), the env read bakes the trace-time value into the program, and
+the timestamp measures compilation, not execution. These bugs pass
+every single-step test and corrupt every dashboard.
+
+Target discovery is lexical per module: `@jax.jit` / `@jit` /
+`@pmap`-style decorators (including `@partial(jax.jit, ...)`), and
+call-wrapping of a local function by name — `jax.jit(f)`,
+`shard_map(f, mesh=...)`, `pmap(f)`. Nested `def`s inside a traced
+function are scanned too (closures trace with their parent).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from ..model import Finding, Project, attr_chain, call_name
+from ..model import str_const as model_str_const
+from . import Rule
+from .registry import env_read_key
+
+_JIT_CHAINS = {
+    "jax.jit", "jit", "jax.pmap", "pmap", "shard_map", "pjit",
+    "jax.experimental.shard_map.shard_map",
+    "jax.experimental.pjit.pjit",
+}
+_PARTIAL_CHAINS = {"partial", "functools.partial"}
+
+_WALLCLOCK = {
+    "time.time", "time.time_ns", "time.monotonic",
+    "time.monotonic_ns", "time.perf_counter", "time.perf_counter_ns",
+    "datetime.now", "datetime.utcnow", "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+}
+
+
+def _jit_decorated(fn: ast.AST) -> bool:
+    for dec in fn.decorator_list:
+        chain = attr_chain(dec)
+        if chain in _JIT_CHAINS:
+            return True
+        if isinstance(dec, ast.Call):
+            fchain = attr_chain(dec.func)
+            if fchain in _JIT_CHAINS:
+                return True
+            if fchain in _PARTIAL_CHAINS and dec.args:
+                if attr_chain(dec.args[0]) in _JIT_CHAINS:
+                    return True
+    return False
+
+
+def _metric_mutation(call: ast.Call) -> str:
+    f = call.func
+    if call_name(call) == "record_collective":
+        return "record_collective()"
+    if not isinstance(f, ast.Attribute):
+        return ""
+    if f.attr in ("inc", "dec", "observe"):
+        return f"{attr_chain(f) or f.attr}()"
+    if f.attr == "set":
+        recv = attr_chain(f.value).lower()
+        if ("_m_" in recv or "metric" in recv or "gauge" in recv
+                or recv.split(".")[-1] in ("_metrics", "registry")):
+            return f"{attr_chain(f)}()"
+    return ""
+
+
+def _side_effect(node: ast.AST) -> str:
+    """Human-readable description when `node` is a trace-impure
+    operation, else ''."""
+    er = env_read_key(node)
+    if er:
+        return f"os.environ read of '{er[0]}'"
+    if not isinstance(node, ast.Call):
+        return ""
+    chain = attr_chain(node.func)
+    if chain in _WALLCLOCK:
+        return f"wall-clock call '{chain}()'"
+    m = _metric_mutation(node)
+    if m:
+        return f"metrics mutation '{m}'"
+    if call_name(node) == "fire" and "fault" in chain.lower():
+        return f"fault-injection seam '{chain}()'"
+    # The registry-routed point read mandated by HVD002 is just as
+    # trace-impure as the raw os.environ form it replaces.
+    if call_name(node) == "env_value":
+        name = (model_str_const(node.args[0])
+                if node.args else None)
+        return (f"config.env_value read of '{name}'" if name
+                else "config.env_value read")
+    return ""
+
+
+class TracePurityRule(Rule):
+    id = "HVD004"
+    summary = ("python side-effect (metrics/faults/environ/wall-"
+               "clock) inside a jit/shard_map/pmap-traced function")
+
+    def run(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            defs_by_name: Dict[str, List[ast.AST]] = {}
+            for fn in sf.qualname:
+                defs_by_name.setdefault(fn.name, []).append(fn)
+            targets: Dict[ast.AST, str] = {}  # fn -> how it is traced
+            for fn in sf.qualname:
+                if _jit_decorated(fn):
+                    targets[fn] = "decorator"
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fchain = attr_chain(node.func)
+                if fchain not in _JIT_CHAINS or not node.args:
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Name):
+                    for fn in defs_by_name.get(arg.id, ()):
+                        targets.setdefault(
+                            fn, f"{fchain}() at line {node.lineno}")
+                elif isinstance(arg, ast.Lambda):
+                    targets.setdefault(
+                        arg, f"{fchain}() at line {node.lineno}")
+            # scan each traced body, nested defs included (closures
+            # trace with their parent), but don't double-report a
+            # nested def that is itself a target.
+            claimed: Set[ast.AST] = set(targets)
+            for fn in sorted(targets, key=lambda n: n.lineno):
+                how = targets[fn]
+                name = getattr(fn, "name", "<lambda>")
+                via = ("" if how == "decorator"
+                       else f" (traced via {how})")
+                body = fn.body if isinstance(fn.body, list) \
+                    else [ast.Expr(fn.body)]
+                stack: List[ast.AST] = list(body)
+                while stack:
+                    node = stack.pop()
+                    if node in claimed and node is not fn:
+                        # a nested def that is itself a trace target
+                        # gets its own pass; skip ONLY its subtree
+                        continue
+                    desc = _side_effect(node)
+                    if desc:
+                        findings.append(Finding(
+                            self.id, sf.rel, node.lineno,
+                            node.col_offset + 1,
+                            f"{desc} inside traced function "
+                            f"'{name}'{via}: runs once at trace "
+                            f"time, then never again in the "
+                            f"compiled program",
+                            sf.context_of(node)))
+                    stack.extend(ast.iter_child_nodes(node))
+        return findings

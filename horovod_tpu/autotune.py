@@ -21,8 +21,6 @@ Select with HOROVOD_AUTOTUNE_MODE.
 
 from __future__ import annotations
 
-import os
-import time
 from typing import List, Optional, Tuple
 
 import numpy as np

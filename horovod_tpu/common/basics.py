@@ -53,11 +53,11 @@ def _ensure_distributed(cfg: Config) -> bool:
     Returns True if this call performed jax.distributed.initialize().
     """
     if cfg.coordinator_addr and cfg.size > 1:
-        import os
+        from .config import env_value
         # See the HOROVOD_SHUTDOWN_BARRIER_TIMEOUT knob doc: 0 = auto
         # (60 under the elastic launcher, jax's 300 otherwise).
         shutdown_timeout = int(cfg.shutdown_barrier_timeout) or (
-            60 if os.environ.get("HOROVOD_ELASTIC") else 300)
+            60 if env_value("HOROVOD_ELASTIC") else 300)
         kwargs = dict(
             coordinator_address=cfg.coordinator_addr,
             num_processes=cfg.size,
@@ -106,6 +106,12 @@ def init(config_overrides: Optional[Dict[str, Any]] = None,
         # Fail fast on bad knob values BEFORE any threads/sockets/
         # backends exist — a raise later would leak a live engine
         # because shutdown() early-returns while !initialized.
+        if cfg["HOROVOD_CPU_OPERATIONS"] != "xla":
+            raise ValueError(
+                f"HOROVOD_CPU_OPERATIONS="
+                f"{cfg['HOROVOD_CPU_OPERATIONS']!r} is not supported: "
+                f"the data plane is always XLA collectives ('xla'); "
+                f"there is no gloo/mpi CPU path here")
         from ..ops import dispatch as _dispatch
         _dispatch.set_alltoall_mode(cfg.alltoall_mode)
         _dispatch.set_span_devices(cfg.eager_span_devices)

@@ -120,6 +120,9 @@ KNOBS: List[Knob] = [
     Knob("HOROVOD_CPU_OPERATIONS", str, "xla",
          "CPU data plane. Only 'xla' is supported: XLA CPU collectives "
          "(the reference's gloo/mpi analog for tests)."),
+    # hvdlint: disable-next=HVD002 (compat: recognised and deliberately
+    # ignored on TPU — declaring it keeps migrating users' env files
+    # from tripping unknown-variable warnings)
     Knob("HOROVOD_GPU_OPERATIONS", str, "",
          "Unused on TPU; recognised for compatibility and ignored. The "
          "data plane is always XLA collectives over ICI/DCN via PJRT."),
@@ -267,8 +270,14 @@ KNOBS: List[Knob] = [
          "a private stream keyed on (seed, point, action), so the "
          "same spec + seed reproduces the same failure schedule."),
     # -- process sets --------------------------------------------------------
+    # hvdlint: disable-next=HVD002 (compat: the reference gates
+    # post-init add_process_set on this; here registration is
+    # collective-free and always allowed, so the knob is recognised
+    # and ignored — see hvd.add_process_set's docstring)
     Knob("HOROVOD_DYNAMIC_PROCESS_SETS", _parse_bool, False,
-         "Allow process sets to be registered after init."),
+         "Allow process sets to be registered after init (recognised "
+         "for compatibility; registration is collective-free here and "
+         "always allowed)."),
     # -- bootstrap / topology (TPU-specific) ---------------------------------
     Knob("HOROVOD_RANK", int, -1,
          "Process rank, set by the launcher. -1 = single-process mode."),
@@ -294,6 +303,39 @@ KNOBS: List[Knob] = [
     Knob("HOROVOD_START_TIMEOUT", float, 30.0,
          "Seconds each rank waits for the coordination service to come "
          "up at init before aborting (set by hvdrun --start-timeout)."),
+    Knob("HOROVOD_HOSTNAME", str, "",
+         "This worker's host name as the launcher knows it (used to "
+         "key rendezvous slots and blacklists). Empty = "
+         "socket.gethostname()."),
+    Knob("HOROVOD_ELASTIC", _parse_bool, False,
+         "Set by the elastic launcher in every worker's environment; "
+         "switches init defaults (e.g. a short shutdown-barrier "
+         "timeout) to elastic-appropriate values."),
+    Knob("HOROVOD_ELASTIC_EPOCH", int, 0,
+         "Monotonic world-incarnation counter, set by the elastic "
+         "launcher on every (re)spawn; workers compare it against "
+         "notification payloads to drop stale resize pokes."),
+    Knob("HOROVOD_ELASTIC_RESET_LIMIT", int, 0,
+         "Abort the elastic run after this many world resets "
+         "(reference: --reset-limit). 0 = unlimited."),
+    Knob("HOROVOD_RENDEZVOUS_ADDR", str, "",
+         "host:port of the elastic rendezvous server, set by the "
+         "elastic launcher. Empty = not running under the elastic "
+         "launcher."),
+    # -- topology overrides (TPU-specific) -----------------------------------
+    Knob("HOROVOD_TPU_PROCESS_BOUNDS", str, "",
+         "Override for the TPU_PROCESS_BOUNDS topology the launcher "
+         "exports to workers ('x,y,z' grid). Empty = derived from the "
+         "host list."),
+    Knob("HOROVOD_TPU_CHIPS_PER_PROCESS_BOUNDS", str, "",
+         "Override for TPU_CHIPS_PER_PROCESS_BOUNDS exported to "
+         "workers. Empty = '1,1,1' (one chip per process)."),
+    # -- attention kernels ---------------------------------------------------
+    Knob("HOROVOD_FLASH_ATTENTION", str, "0",
+         "Pallas flash-attention kernel inside ring attention: '1' "
+         "forces it, 'auto' tries it for supported shapes, '0' "
+         "(default) keeps the jnp path (measured SLOWER inside the "
+         "remat'd layer scan — see docs/benchmarks.md)."),
 ]
 
 _KNOBS_BY_ENV: Dict[str, Knob] = {k.env: k for k in KNOBS}
@@ -402,6 +444,36 @@ class Config:
 
     def as_dict(self) -> Dict[str, Any]:
         return dict(self._values)
+
+
+def env_value(env_name: str,
+              env: Optional[Dict[str, str]] = None) -> Any:
+    """Registry-routed point read of one declared knob at CALL time.
+
+    The sanctioned replacement for scattered
+    ``os.environ.get("HOROVOD_*")`` reads (hvdlint rule HVD002): the
+    name must be declared in KNOBS — so ``hvdrun --help`` and the
+    doctor can enumerate it — and the raw string goes through the
+    knob's type and default exactly like the init-time snapshot.
+
+    Use ``Config`` for the coherent one-shot parse at ``hvd.init()``;
+    use this for pre-init plumbing (launcher-set variables read before
+    any Config exists) and for knobs that are deliberately re-read as
+    the environment changes (e.g. the elastic epoch bumped on every
+    respawn).
+    """
+    knob = _KNOBS_BY_ENV.get(env_name)
+    if knob is None:
+        raise KeyError(
+            f"{env_name} is not a declared knob; add a Knob to "
+            f"KNOBS in horovod_tpu/common/config.py")
+    raw = (os.environ if env is None else env).get(env_name, "")
+    if raw == "":
+        return knob.default
+    try:
+        return knob.type(raw)
+    except (ValueError, TypeError) as e:
+        raise ValueError(f"Bad value for {env_name}={raw!r}: {e}")
 
 
 def describe_knobs() -> str:

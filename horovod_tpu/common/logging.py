@@ -8,7 +8,6 @@ HOROVOD_LOG_LEVEL / HOROVOD_LOG_TIMESTAMP control
 from __future__ import annotations
 
 import logging as _pylog
-import os
 import sys
 
 TRACE = 5
@@ -48,15 +47,13 @@ _rank_filter = _RankFilter()
 
 def configure(level: str = None, timestamp: bool = None,
               rank0_only: bool = None) -> None:
-    level = level if level is not None else os.environ.get(
-        "HOROVOD_LOG_LEVEL", "warning")
+    from .config import env_value
+    if level is None:
+        level = env_value("HOROVOD_LOG_LEVEL")
     if timestamp is None:
-        timestamp = os.environ.get("HOROVOD_LOG_TIMESTAMP", "1").lower() in (
-            "1", "true", "yes", "on")
+        timestamp = env_value("HOROVOD_LOG_TIMESTAMP")
     if rank0_only is None:
-        rank0_only = os.environ.get(
-            "HOROVOD_LOG_RANK0_ONLY", "").lower() in (
-                "1", "true", "yes", "on")
+        rank0_only = env_value("HOROVOD_LOG_RANK0_ONLY")
     _rank_filter.rank0_only = bool(rank0_only)
     logger.setLevel(_LEVELS.get(level.lower(), _pylog.WARNING))
     logger.handlers.clear()

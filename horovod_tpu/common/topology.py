@@ -49,7 +49,8 @@ def detect(cfg) -> Topology:
         size = cfg.size
         local_rank = cfg.local_rank if cfg.local_rank >= 0 else 0
         local_size = cfg.local_size if cfg.local_size >= 0 else 1
-        cross_rank = cfg.cross_rank if cfg.cross_rank >= 0 else rank // max(local_size, 1)
+        cross_rank = (cfg.cross_rank if cfg.cross_rank >= 0
+                      else rank // max(local_size, 1))
         cross_size = cfg.cross_size if cfg.cross_size >= 0 else (
             size + local_size - 1) // max(local_size, 1)
     else:
@@ -97,7 +98,8 @@ def device_matrix(ranks: List[int]):
     return np.array(rows)
 
 
-def process_mesh_devices(ranks: Optional[List[int]] = None) -> List[jax.Device]:
+def process_mesh_devices(ranks: Optional[List[int]] = None
+                         ) -> List[jax.Device]:
     """One device per process, in rank order (optionally a subset)."""
     n = jax.process_count()
     ranks = list(range(n)) if ranks is None else ranks

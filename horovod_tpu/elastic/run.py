@@ -19,7 +19,7 @@ import os
 import time
 from typing import Callable
 
-from ..common import basics, logging as hlog
+from ..common import basics, config, logging as hlog
 from ..metrics import REGISTRY as _METRICS
 from . import notifications
 from .state import HorovodInternalError, HostsUpdatedInterrupt
@@ -64,13 +64,13 @@ def _reinitialize() -> None:
     # HOROVOD_START_TIMEOUT=elastic_timeout (600 s), which would make a
     # single stuck attempt eat the whole retry deadline — the short
     # per-attempt bound is what makes churn re-polling converge.
+    # hvdlint: disable-next=HVD002 (raw save/restore of the user's
+    # exact string around the loop's temporary override; env_value
+    # would erase the set-but-empty vs unset distinction)
     user_start_timeout = os.environ.get("HOROVOD_START_TIMEOUT")
-    base_timeout = float(os.environ.get(
-        "HOROVOD_ELASTIC_INIT_BASE_TIMEOUT", "15"))
-    max_timeout = float(os.environ.get(
-        "HOROVOD_ELASTIC_INIT_TIMEOUT", "120"))
-    deadline = time.time() + float(
-        os.environ.get("HOROVOD_ELASTIC_TIMEOUT", "600"))
+    base_timeout = config.env_value("HOROVOD_ELASTIC_INIT_BASE_TIMEOUT")
+    max_timeout = config.env_value("HOROVOD_ELASTIC_INIT_TIMEOUT")
+    deadline = time.time() + config.env_value("HOROVOD_ELASTIC_TIMEOUT")
     attempt = 0
     _m_resets.inc()
     t_reset = time.monotonic()
@@ -134,7 +134,7 @@ def run(func: Callable) -> Callable:
         # State.check_host_updates.
         if state.maybe_load_snapshot():
             hlog.info("elastic: resumed from snapshot")
-        reset_limit = int(os.environ.get("HOROVOD_ELASTIC_RESET_LIMIT", 0))
+        reset_limit = config.env_value("HOROVOD_ELASTIC_RESET_LIMIT")
         resets = 0
         while True:
             # sync() runs at the top of EVERY attempt, including the

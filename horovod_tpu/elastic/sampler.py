@@ -9,7 +9,7 @@ repeated or dropped within an epoch.
 from __future__ import annotations
 
 import random
-from typing import Iterator, List, Optional
+from typing import Iterator, List
 
 
 class ElasticSampler:

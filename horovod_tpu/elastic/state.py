@@ -116,13 +116,13 @@ class State:
         in is stale (e.g. a re-delivered notification after this rank
         resized) and is swallowed instead of triggering a one-sided
         re-init that the rest of the world would not join."""
-        import os
         from . import notifications
+        from ..common.config import env_value
         is_pending, info = notifications.peek()
         if not is_pending:
             return
         target = info.get("epoch") if isinstance(info, dict) else None
-        cur = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", "0") or 0)
+        cur = env_value("HOROVOD_ELASTIC_EPOCH")
         if target is not None and int(target) <= cur:
             # This epoch (re-delivered) or an OLDER one (late poke
             # arriving after this rank already resized past it) is

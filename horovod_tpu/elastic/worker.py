@@ -18,6 +18,7 @@ import urllib.request
 from typing import Optional
 
 from .. import faults as _faults
+from ..common import config as _config
 from ..common import logging as hlog
 from ..metrics import REGISTRY as _METRICS
 from ..runner import secret as _secret
@@ -32,10 +33,21 @@ _m_notify = _METRICS.counter(
 _m_heartbeats = _METRICS.counter(
     "hvd_elastic_heartbeats_total",
     "Liveness heartbeats this worker delivered to the rendezvous.")
-_m_register_retries = _METRICS.counter(
-    "hvd_control_retries_total",
-    "Control-plane RPC retries after a transient failure, by op.",
-    ("op",))
+# Shared with the control-plane wire layer: one registration site
+# (hvdlint HVD002), one counter for every control RPC retry.
+from ..runner.service import _m_retries as _m_register_retries  # noqa: E402
+
+
+def _rendezvous_addr() -> str:
+    """host:port of the elastic rendezvous, '' outside elastic runs."""
+    return _config.env_value("HOROVOD_RENDEZVOUS_ADDR")
+
+
+def _slot() -> tuple:
+    """(hostname, local_rank) naming this worker's rendezvous slot."""
+    me = _config.env_value("HOROVOD_HOSTNAME") or socket.gethostname()
+    lr = str(max(_config.env_value("HOROVOD_LOCAL_RANK"), 0))
+    return me, lr
 
 _listener: Optional["NotificationListener"] = None
 
@@ -90,19 +102,16 @@ def register_with_rendezvous() -> None:
     after the retry budget is exhausted does it degrade to the old
     warn-and-continue (the catch-up epoch check at the next
     registration opportunity is then the last line of defense)."""
-    addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "")
+    addr = _rendezvous_addr()
     if not addr:
         return
     from ..runner.service import retry_backoff
     port = start_listener()
-    me = os.environ.get("HOROVOD_HOSTNAME", socket.gethostname())
-    lr = os.environ.get("HOROVOD_LOCAL_RANK", "0")
+    me, lr = _slot()
     path = f"/notify/{me}/{lr}"
     body = json.dumps({"port": port}).encode()
-    retries = int(os.environ.get(
-        "HOROVOD_ELASTIC_REGISTER_RETRIES", "5") or 0)
-    backoff = float(os.environ.get(
-        "HOROVOD_CONTROL_RETRY_BACKOFF", "0.2") or 0.2)
+    retries = _config.env_value("HOROVOD_ELASTIC_REGISTER_RETRIES")
+    backoff = _config.env_value("HOROVOD_CONTROL_RETRY_BACKOFF")
     for attempt in range(retries + 1):
         req = urllib.request.Request(
             f"http://{addr}{path}", data=body, method="PUT",
@@ -118,7 +127,7 @@ def register_with_rendezvous() -> None:
             # listener), surface the missed membership change now so
             # the next commit boundary resizes instead of training to
             # completion in the old world.
-            cur = int(os.environ.get("HOROVOD_ELASTIC_EPOCH", "0") or 0)
+            cur = _config.env_value("HOROVOD_ELASTIC_EPOCH")
             latest = int(reply.get("epoch", cur) or cur)
             if latest != cur:
                 hlog.info("elastic: missed membership change "
@@ -158,15 +167,13 @@ _hb_last = 0.0
 
 
 def heartbeat_timeout() -> float:
-    return float(os.environ.get(
-        "HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT", "0") or 0)
+    return _config.env_value("HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT")
 
 
 def heartbeat_interval() -> float:
     """Pacer period: explicit knob, else timeout/3 (three missed beats
     before the driver calls it hung), floored at 0.5 s."""
-    iv = float(os.environ.get(
-        "HOROVOD_ELASTIC_HEARTBEAT_INTERVAL", "0") or 0)
+    iv = _config.env_value("HOROVOD_ELASTIC_HEARTBEAT_INTERVAL")
     if iv > 0:
         return iv
     return max(0.5, heartbeat_timeout() / 3.0)
@@ -176,11 +183,10 @@ def _heartbeat_once(timeout: float = 3.0) -> bool:
     """One best-effort signed heartbeat PUT. The rendezvous stamps
     arrival time server-side, so worker/driver clock skew never fakes
     a hang."""
-    addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "")
+    addr = _rendezvous_addr()
     if not addr:
         return False
-    me = os.environ.get("HOROVOD_HOSTNAME", socket.gethostname())
-    lr = os.environ.get("HOROVOD_LOCAL_RANK", "0")
+    me, lr = _slot()
     path = f"/heartbeat/{me}/{lr}"
     body = b"{}"
     req = urllib.request.Request(
@@ -218,7 +224,7 @@ def start_heartbeat() -> bool:
     global _hb_thread
     if heartbeat_timeout() <= 0:
         return False
-    if not os.environ.get("HOROVOD_RENDEZVOUS_ADDR", ""):
+    if not _rendezvous_addr():
         return False
     if _hb_thread is not None and _hb_thread.is_alive():
         return True
@@ -262,16 +268,14 @@ def refresh_env_from_rendezvous() -> None:
     errors, 5xx) retry under their own longer deadline — one dropped
     HTTP round-trip must not turn a routine resize into a worker
     death."""
-    addr = os.environ.get("HOROVOD_RENDEZVOUS_ADDR", "")
+    addr = _rendezvous_addr()
     if not addr:
         return
     from ..runner.service import retry_backoff
     _m_rendezvous.inc()
-    me = os.environ.get("HOROVOD_HOSTNAME", socket.gethostname())
-    lr = os.environ.get("HOROVOD_LOCAL_RANK", "0")
+    me, lr = _slot()
     path = f"/rank/{me}/{lr}"
-    backoff = float(os.environ.get(
-        "HOROVOD_CONTROL_RETRY_BACKOFF", "0.2") or 0.2)
+    backoff = _config.env_value("HOROVOD_CONTROL_RETRY_BACKOFF")
     deadline = time.time() + 10.0
     err_deadline = time.time() + 60.0
     err_attempt = 0

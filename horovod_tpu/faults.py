@@ -56,6 +56,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from .common import config as _config
 from .common import logging as hlog
 from .metrics import REGISTRY as _METRICS
 
@@ -149,7 +150,10 @@ class _Rule:
         """Called under the plan lock; advances the hit counter."""
         self.hits += 1
         if self.rank is not None:
-            if os.environ.get("HOROVOD_RANK", "") != str(self.rank):
+            # Launcher-set env, read at fire time: faults parse before
+            # hvd.init(), so no Config snapshot exists yet. Unset
+            # (env_value -> -1) never matches a rank selector.
+            if _config.env_value("HOROVOD_RANK") != self.rank:
                 return False
         if self.times and self.fired >= self.times:
             return False
@@ -266,8 +270,8 @@ def configure(spec: Optional[str], seed: int = 0) -> None:
 
 
 def configure_from_env() -> None:
-    spec = os.environ.get("HOROVOD_FAULTS", "")
-    seed = int(os.environ.get("HOROVOD_FAULTS_SEED", "0") or 0)
+    spec = _config.env_value("HOROVOD_FAULTS")
+    seed = _config.env_value("HOROVOD_FAULTS_SEED")
     configure(spec, seed)
 
 

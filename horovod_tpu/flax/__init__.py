@@ -83,11 +83,16 @@ class DistributedTrainState(train_state.TrainState):
                    else (_hvd.size() if _hvd.is_initialized() else 1))
         do_bcast = broadcast and _hvd.is_initialized() and members > 1
         if do_bcast:
+            # hvdlint: disable-next=HVD001 (uniform: `members` comes
+            # from size()/process_set.size, identical on every member
+            # of the set — single-process fast path, not divergence)
             params = _hvd.broadcast_parameters(
                 params, root_rank=root_rank, process_set=process_set)
         state = super().create(apply_fn=apply_fn, params=params,
                                tx=tx, **kwargs)
         if do_bcast:
+            # hvdlint: disable-next=HVD001 (uniform: same size()-
+            # derived condition as the params broadcast above)
             opt_state = _hvd.broadcast_optimizer_state(
                 state.opt_state, root_rank=root_rank,
                 process_set=process_set)

@@ -9,7 +9,6 @@ core, not NCCL/MPI.
 
 from __future__ import annotations
 
-import os
 
 
 def xla_built() -> bool:

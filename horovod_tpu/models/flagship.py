@@ -23,8 +23,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..parallel.mesh import (DATA_AXIS, EXPERT_AXIS, SEQ_AXIS,
-                             TENSOR_AXIS, batch_axes)
+from ..parallel.mesh import EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS, batch_axes
 from ..parallel.sharding import Rules
 from ..parallel.train import build_train_step, infer_opt_state_specs
 from . import transformer as tfm

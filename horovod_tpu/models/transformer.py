@@ -32,7 +32,6 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 from ..common.compat import axis_size as _compat_axis_size
-import numpy as np
 from jax import lax
 
 from ..parallel.mesh import EXPERT_AXIS, SEQ_AXIS, TENSOR_AXIS

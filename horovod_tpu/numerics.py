@@ -366,7 +366,7 @@ class DistributedLossScaler:
         scaler = hvd.DistributedLossScaler()
         sstate = scaler.init()
         loss   = scaler.scale(raw_loss, sstate)      # inside loss_fn
-        grads  = ...                                  # grads of scaled loss, reduced
+        grads  = ...                        # grads of scaled loss, reduced
         grads  = scaler.unscale(grads, sstate)
         ok     = numerics.all_finite(grads)
         sstate = scaler.update(sstate, ok)            # backoff/growth

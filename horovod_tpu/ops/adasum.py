@@ -50,6 +50,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..common import config as _config
 from ..common.compat import shard_map
 from .process_set import ProcessSet
 from . import dispatch
@@ -62,7 +63,6 @@ def _use_pallas() -> bool:
     kernel. Prefers the initialized Config (so
     hvd.init(config_overrides=...) works like every other knob),
     falling back to the raw env before init."""
-    import os
     v = None
     try:
         from ..common import basics
@@ -72,7 +72,7 @@ def _use_pallas() -> bool:
     except Exception:  # pragma: no cover - pre-init edge
         pass
     if v is None:
-        v = os.environ.get("HOROVOD_ADASUM_PALLAS", "auto")
+        v = str(_config.env_value("HOROVOD_ADASUM_PALLAS"))
     v = v.lower()
     if v in ("1", "true", "yes"):
         return True
@@ -85,7 +85,6 @@ def _pallas_forced() -> bool:
     """True when HOROVOD_ADASUM_PALLAS explicitly forces the Pallas
     pair-combine (value 1/true/yes) — under ADASUM_MODE=auto that
     routes to the gather+fold kernel, the only one that runs it."""
-    import os
     v = None
     try:
         from ..common import basics
@@ -95,7 +94,7 @@ def _pallas_forced() -> bool:
     except Exception:  # pragma: no cover - pre-init edge
         pass
     if v is None:
-        v = os.environ.get("HOROVOD_ADASUM_PALLAS", "auto")
+        v = str(_config.env_value("HOROVOD_ADASUM_PALLAS"))
     return v.lower() in ("1", "true", "yes")
 
 

@@ -15,7 +15,7 @@ XLA-native semantics; see engine.Handle.wait for the measured why).
 from __future__ import annotations
 
 import threading
-from typing import Any, List, Optional, Sequence, Union
+from typing import Any, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -24,7 +24,7 @@ import numpy as np
 from ..common.basics import _require_init
 from . import dispatch
 from .adasum import adasum_allreduce
-from .compression import Compression, NoneCompressor
+from .compression import NoneCompressor
 from .dispatch import AVERAGE, SUM, ADASUM, MIN, MAX, PRODUCT
 from .process_set import ProcessSet
 

@@ -11,7 +11,6 @@ no overflow cliff, native MXU dtype), so `Compression.bf16` is added and
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 
 

@@ -31,8 +31,7 @@ LocalGradientAggregationHelper), re-designed for JAX/optax:
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable, List, NamedTuple, Optional, Sequence
+from typing import Any, List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -42,7 +41,7 @@ import optax
 from .. import numerics as _numerics
 from ..ops import collective_ops as C
 from ..ops import sparse as S
-from ..ops.compression import Compression, NoneCompressor
+from ..ops.compression import NoneCompressor
 from ..ops.dispatch import AVERAGE, SUM, ADASUM, MIN
 from ..ops.process_set import ProcessSet
 

@@ -9,7 +9,6 @@ one fused-broadcast implementation serves both.
 
 from __future__ import annotations
 
-import io
 import pickle
 from typing import Any, Optional
 

@@ -49,7 +49,6 @@ import functools
 from typing import Any, NamedTuple, Optional
 
 import jax
-import jax.numpy as jnp
 import optax
 
 from ..ops import collective_ops as C

@@ -24,7 +24,6 @@ triangularly masked when i == j.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
@@ -121,8 +120,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array,
 # supported shapes and falls back silently. Read at trace time, like
 # the Adasum Pallas switch.
 def _flash_mode() -> str:
-    import os
-    v = os.environ.get("HOROVOD_FLASH_ATTENTION", "0").lower()
+    from ..common.config import env_value
+    v = str(env_value("HOROVOD_FLASH_ATTENTION")).lower()
     v = {"true": "1", "yes": "1", "false": "0", "no": "0",
          "": "0"}.get(v, v)
     if v not in ("0", "1", "auto"):

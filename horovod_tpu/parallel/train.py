@@ -32,7 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .. import numerics as _numerics
 from ..common.compat import GRADS_PRE_SUMMED, shard_map
 from .mesh import FSDP_AXIS, batch_axes
-from .sharding import Rules, replicated
+from .sharding import replicated
 
 
 def _fsdp_gather_fn(param_specs, mesh):

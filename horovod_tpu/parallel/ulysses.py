@@ -18,7 +18,6 @@ constraint and overlaps comm with compute).
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 from ..common.compat import axis_size as _compat_axis_size
 from jax import lax
 

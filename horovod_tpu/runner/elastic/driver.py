@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ...common import config as _config
 from ...common import logging as hlog
 from ...metrics import REGISTRY as _METRICS
 from .. import secret as _secret
@@ -77,8 +78,8 @@ class ElasticDriver:
         # start propagating to the per-call-site handling below.
         _env = dict(env if env is not None else os.environ)
         self.discovery = ResilientDiscovery(
-            discovery, staleness_window=float(_env.get(
-                "HOROVOD_DISCOVERY_STALENESS_WINDOW", "60") or 60))
+            discovery, staleness_window=_config.env_value(
+                "HOROVOD_DISCOVERY_STALENESS_WINDOW", env=_env))
         self.min_np = min_np
         self.max_np = max_np
         self.poll_interval = poll_interval
@@ -101,20 +102,20 @@ class ElasticDriver:
         # Escalating blacklist: a flat window let a flapping host
         # rejoin every 60 s and re-kill the gang forever. The window
         # doubles per repeated failure of the SAME host, capped.
-        self.blacklist_window = float(_env.get(
-            "HOROVOD_ELASTIC_BLACKLIST_WINDOW", "60") or 60)
-        self.blacklist_window_max = float(_env.get(
-            "HOROVOD_ELASTIC_BLACKLIST_WINDOW_MAX", "900") or 900)
+        self.blacklist_window = _config.env_value(
+            "HOROVOD_ELASTIC_BLACKLIST_WINDOW", env=_env)
+        self.blacklist_window_max = _config.env_value(
+            "HOROVOD_ELASTIC_BLACKLIST_WINDOW_MAX", env=_env)
         self._host_failures: Dict[str, int] = {}
         # Liveness detector: a rendezvous heartbeat older than this is
         # a hung worker (0 disables — detection requires workers to
         # heartbeat, which the same knob switches on worker-side).
-        self.heartbeat_timeout = float(_env.get(
-            "HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT", "0") or 0)
+        self.heartbeat_timeout = _config.env_value(
+            "HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT", env=_env)
         # Removed-slot drain: (host, local_rank) -> (_Slot, deadline).
         self._draining: Dict[Tuple[str, int], Tuple[_Slot, float]] = {}
-        self.drain_grace = float(
-            os.environ.get("HOROVOD_ELASTIC_DRAIN_GRACE", "30"))
+        self.drain_grace = _config.env_value(
+            "HOROVOD_ELASTIC_DRAIN_GRACE", env=_env)
 
     # ------------------------------------------------------------------
 

@@ -80,13 +80,13 @@ def per_chip_env(info: RankInfo, all_infos: List["RankInfo"],
     contract lists the slice's hosts); TPU_PROCESS_ADDRESSES lists
     every slot host:port in rank order so the per-process TPU runtimes
     can rendezvous."""
-    import os as _os
+    from ..common.config import env_value
     nproc = len(all_infos)
     bounds = (process_bounds
-              or _os.environ.get("HOROVOD_TPU_PROCESS_BOUNDS")
+              or env_value("HOROVOD_TPU_PROCESS_BOUNDS")
               or _PROCESS_BOUNDS_DEFAULT.get(nproc, f"{nproc},1,1"))
     chips = (chips_per_process_bounds
-             or _os.environ.get("HOROVOD_TPU_CHIPS_PER_PROCESS_BOUNDS")
+             or env_value("HOROVOD_TPU_CHIPS_PER_PROCESS_BOUNDS")
              or "1,1,1")
     addrs = ",".join(f"{i.host}:{port_base + i.local_rank}"
                      for i in all_infos)

@@ -16,7 +16,6 @@ spawning worker ranks.
 from __future__ import annotations
 
 import json
-import os
 import random
 import socket
 import struct
@@ -25,6 +24,7 @@ import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from .. import faults as _faults
+from ..common import config as _config
 from ..common import logging as hlog
 from ..metrics import REGISTRY as _METRICS
 from . import secret as _secret
@@ -227,8 +227,7 @@ class BasicClient:
     def request(self, obj: dict, retries: int = 0,
                 backoff: Optional[float] = None) -> Any:
         if backoff is None:
-            backoff = float(os.environ.get(
-                "HOROVOD_CONTROL_RETRY_BACKOFF", "0.2") or 0.2)
+            backoff = _config.env_value("HOROVOD_CONTROL_RETRY_BACKOFF")
         attempt = 0
         while True:
             try:

@@ -24,7 +24,7 @@ import subprocess
 import sys
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
 from . import network
 from . import secret as _secret
@@ -40,6 +40,7 @@ class TaskService:
         self._driver: Optional[BasicClient] = None
         self._procs: List[subprocess.Popen] = []
         self._ranks: List[int] = []
+        self._spawning = False
         self._done = threading.Event()
         self._lock = threading.Lock()
         self.service = BasicService(f"task[{host_id}]", secret)
@@ -92,9 +93,17 @@ class TaskService:
         # instead of back through the ssh pipe — the --driver analog
         # of hvdrun --output-filename.
         output = req.get("output") or None
+        # Claim-then-spawn: fork+exec of a whole gang is the slowest
+        # thing this service does, so it must not happen under the
+        # lock (hvdlint HVD003 — a concurrent shutdown RPC would stall
+        # behind every spawn). The _spawning flag keeps the
+        # one-job-at-a-time contract while the lock is released.
         with self._lock:
-            if self._procs:
+            if self._procs or self._spawning:
                 return {"error": "already running"}
+            self._spawning = True
+        started: List[Tuple[subprocess.Popen, int]] = []
+        try:
             for rankspec in req["ranks"]:
                 env = dict(os.environ)
                 env.update({str(k): str(v)
@@ -120,11 +129,36 @@ class TaskService:
                         threading.Thread(target=self._pump,
                                          args=(stream, rank, sink),
                                          daemon=True).start()
-                self._procs.append(p)
-                self._ranks.append(rank)
+                started.append((p, rank))
+        except BaseException:
+            # A partial gang is useless: kill what already started.
+            # The watchers started in the finally below still reap
+            # them, push task_exit to the driver, and set _done.
+            for p, _rank in started:
+                if p.poll() is None:
+                    p.terminate()
+            raise
+        finally:
+            with self._lock:
+                for p, rank in started:
+                    self._procs.append(p)
+                    self._ranks.append(rank)
+                self._spawning = False
+                shutdown_raced = self._done.is_set()
+            # Watchers start after registration (their all-exited
+            # check must never see a partial list) but on EVERY exit
+            # path — an unwatched proc would never be reaped and
+            # serve_forever would wait on _done forever.
+            for p, rank in started:
                 threading.Thread(target=self._wait_one,
                                  args=(p, rank), daemon=True).start()
-        return {"ok": True, "started": len(self._procs)}
+        if shutdown_raced:
+            # A shutdown RPC landed mid-spawn and only saw the procs
+            # registered at that point; sweep the full set now.
+            for p, _rank in started:
+                if p.poll() is None:
+                    p.terminate()
+        return {"ok": True, "started": len(started)}
 
     def _on_shutdown(self, req: dict, peer) -> dict:
         with self._lock:

@@ -25,7 +25,6 @@ import dataclasses
 from typing import Any, Optional, Sequence, Union
 
 import flax.linen as nn
-import jax.numpy as jnp
 
 
 class SyncBatchNorm(nn.BatchNorm):

@@ -16,7 +16,6 @@ blocks on file IO, matching the reference's TimelineWriter design.
 from __future__ import annotations
 
 import json
-import os
 import queue
 import threading
 import time

@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Repo lint gate: ruff (style/pyflakes) + hvdlint (framework
+# invariants: SPMD divergence, knob registry, lock discipline, trace
+# purity) + the native core's -Werror compile check. Exit nonzero on
+# any finding — this is the CI entry point; tests/test_lint.py runs
+# the hvdlint half in-process as part of tier-1.
+set -u
+cd "$(dirname "$0")/.."
+
+rc=0
+
+echo "== ruff =="
+if command -v ruff >/dev/null 2>&1; then
+    ruff check horovod_tpu tests bench.py setup.py || rc=1
+elif python -c "import ruff" >/dev/null 2>&1; then
+    python -m ruff check horovod_tpu tests bench.py setup.py || rc=1
+else
+    echo "ruff not installed; skipping (config lives in pyproject.toml)"
+fi
+
+echo "== hvdlint =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m horovod_tpu.analysis horovod_tpu/ || rc=1
+
+echo "== cc check (-Wall -Wextra -Werror) =="
+if command -v "${CXX:-g++}" >/dev/null 2>&1; then
+    make -C horovod_tpu/core/cc check || rc=1
+else
+    echo "no C++ toolchain; skipping"
+fi
+
+if [ "$rc" -ne 0 ]; then
+    echo "lint: FAILED"
+else
+    echo "lint: OK"
+fi
+exit "$rc"

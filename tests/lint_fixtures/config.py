@@ -1,0 +1,28 @@
+"""HVD002 fixture registry: a miniature common/config.py clone so the
+registry-enforcement pass has declared knobs to check against."""
+
+from typing import Any, Callable, List
+
+
+class Knob:
+    def __init__(self, env: str, type: Callable[[str], Any],
+                 default: Any, doc: str):
+        self.env = env
+        self.type = type
+        self.default = default
+        self.doc = doc
+
+
+KNOBS: List[Knob] = [
+    Knob("HOROVOD_FIXTURE_USED", int, 1, "Declared and used."),
+    Knob("HOROVOD_FIXTURE_DECLARED", str, "", "Declared; read "
+         "directly via os.environ elsewhere (a bypass)."),
+    Knob("HOROVOD_FIXTURE_UNUSED", int, 0,  # EXPECT: HVD002
+         "Declared but never used anywhere: dead config surface."),
+]
+
+
+class Config:
+    _ATTR_MAP = {
+        "fixture_used": "HOROVOD_FIXTURE_USED",
+    }

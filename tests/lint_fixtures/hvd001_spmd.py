@@ -1,0 +1,83 @@
+"""HVD001 fixture: seeded SPMD-divergence positives and negatives.
+
+Lines with a seeded violation carry trailing EXPECT markers naming the
+rule id; tests/test_lint.py asserts the analyzer reports exactly those
+(rule, line) pairs for this file.
+"""
+
+import horovod_tpu as hvd
+
+
+def direct_conditional(x):
+    if hvd.rank() == 0:
+        return hvd.allreduce(x)  # EXPECT: HVD001
+    return x
+
+
+def else_branch_is_divergent_too(x):
+    if hvd.rank() == 0:
+        return x
+    else:
+        return hvd.allgather(x)  # EXPECT: HVD001
+
+
+def early_return_guard(x):
+    if hvd.rank() != 0:
+        return x
+    hvd.barrier()  # EXPECT: HVD001
+    return x
+
+
+def variable_taint(x):
+    is_root = hvd.rank() == 0
+    if is_root:
+        hvd.broadcast(x, root_rank=0)  # EXPECT: HVD001
+    return x
+
+
+def size_conditional(x):
+    # uniform within one world, but an epoch hazard under elastic
+    if hvd.size() > 1:
+        return hvd.allreduce(x)  # EXPECT: HVD001
+    return x
+
+
+def _sync_helper(x):
+    return hvd.allreduce(x, name="helper")
+
+
+def one_level_indirection(x):
+    if hvd.local_rank() == 0:
+        return _sync_helper(x)  # EXPECT: HVD001
+    return x
+
+
+def boolop_shortcircuit():
+    hvd.rank() == 0 and hvd.barrier()  # EXPECT: HVD001
+
+
+# -- negatives: none of these may be reported ------------------------------
+
+def unconditional(x):
+    return hvd.allreduce(x)
+
+
+def loop_variable_named_rank(x):
+    # `rank` here is a plain loop variable, not the rank() query
+    for rank in range(8):
+        if rank == 0:
+            x = hvd.allreduce(x)
+    return x
+
+
+def rank_used_outside_condition(x):
+    root = hvd.rank()
+    hvd.broadcast(x, root_rank=0)
+    return root
+
+
+def guarded_but_suppressed(x):
+    if hvd.rank() == 0:
+        # hvdlint: disable-next=HVD001 (fixture: justified suppression)
+        hvd.barrier()
+    return x

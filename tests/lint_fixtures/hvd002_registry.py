@@ -1,0 +1,55 @@
+"""HVD002 fixture: seeded registry-enforcement positives/negatives."""
+
+import os
+
+from horovod_tpu.metrics import REGISTRY
+
+
+def undeclared_read():
+    return os.environ.get("HOROVOD_FIXTURE_MYSTERY", "")  # EXPECT: HVD002
+
+
+def declared_but_bypassing_read():
+    return os.getenv("HOROVOD_FIXTURE_DECLARED")  # EXPECT: HVD002
+
+
+def subscript_read():
+    return os.environ["HOROVOD_FIXTURE_DECLARED"]  # EXPECT: HVD002
+
+
+def suppressed_read():
+    # hvdlint: disable-next=HVD002 (fixture: launch plumbing)
+    return os.environ.get("HOROVOD_FIXTURE_DECLARED", "")
+
+
+def uses_the_registry(cfg):
+    # attribute access through _ATTR_MAP counts as a use
+    return cfg.fixture_used
+
+
+def writes_are_plumbing_not_reads():
+    # child-env propagation: none of these may be reported
+    os.environ["HOROVOD_FIXTURE_DECLARED"] = "x"
+    os.environ.pop("HOROVOD_FIXTURE_DECLARED", None)
+    os.environ.setdefault("HOROVOD_FIXTURE_DECLARED", "y")
+
+
+def non_horovod_reads_are_fine():
+    return os.environ.get("PATH", "")
+
+
+_m_ok = REGISTRY.counter(
+    "hvdfix_single_registration_total", "Registered exactly once: ok.")
+
+_m_dup_a = REGISTRY.counter(
+    "hvdfix_duplicated_total", "First site wins.")
+_m_dup_b = REGISTRY.counter(  # EXPECT: HVD002
+    "hvdfix_duplicated_total", "Second site: registry drift hazard.")
+
+
+def lookup_of_never_registered_name():
+    return REGISTRY.get("hvdfix_typo_total")  # EXPECT: HVD002
+
+
+def lookup_of_registered_name_is_fine():
+    return REGISTRY.get("hvdfix_single_registration_total")

@@ -1,0 +1,67 @@
+"""HVD003 fixture: blocking-under-lock and lock-order inversions."""
+
+import subprocess
+import threading
+import time
+
+_lock = threading.Lock()
+_other_mu = threading.Lock()
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._stop = threading.Event()
+
+    def sleep_under_lock(self):
+        with self._lock:
+            time.sleep(1.0)  # EXPECT: HVD003
+
+    def socket_io_under_lock(self, sock, payload):
+        with self._lock:
+            sock.sendall(payload)  # EXPECT: HVD003
+            return sock.recv(4)  # EXPECT: HVD003
+
+    def subprocess_under_lock(self, cmd):
+        with self._lock:
+            return subprocess.check_output(cmd)  # EXPECT: HVD003
+
+    def event_wait_under_lock(self):
+        with self._lock:
+            self._stop.wait(1.0)  # EXPECT: HVD003
+
+    def condition_wait_is_fine(self):
+        # Condition.wait on the held lock RELEASES it: not blocking.
+        with self._cv:
+            self._cv.wait(1.0)
+
+    def deferred_body_is_fine(self):
+        with self._lock:
+            def later():
+                time.sleep(5.0)
+            return later
+
+    def sleep_outside_lock_is_fine(self):
+        with self._lock:
+            n = 3
+        time.sleep(0.1)
+        return n
+
+    def suppressed(self):
+        with self._lock:
+            # hvdlint: disable-next=HVD003 (fixture: serialization of
+            # this io is the lock's entire purpose)
+            time.sleep(0.5)
+
+
+def order_ab():
+    with _lock:
+        with _other_mu:  # EXPECT: HVD003
+            pass
+
+
+def order_ba():
+    with _other_mu:
+        with _lock:
+            pass

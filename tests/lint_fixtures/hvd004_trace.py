@@ -1,0 +1,95 @@
+"""HVD004 fixture: python side-effects inside traced functions."""
+
+import os
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu import faults
+from horovod_tpu.metrics import REGISTRY
+
+_m_steps = REGISTRY.counter("hvdfix_traced_steps_total",
+                            "Seeded trace-impurity target.")
+
+
+@jax.jit
+def decorated_wallclock(x):
+    t0 = time.perf_counter()  # EXPECT: HVD004
+    return x * t0
+
+
+@partial(jax.jit, static_argnums=0)
+def decorated_partial_env(n, x):
+    scale = float(os.environ.get("HVDFIX_SCALE", "1"))  # EXPECT: HVD004
+    return x * scale * n
+
+
+@jax.jit
+def decorated_metrics(x):
+    _m_steps.inc()  # EXPECT: HVD004
+    return x + 1
+
+
+@jax.jit
+def decorated_faults(x):
+    faults.fire("numerics.grad")  # EXPECT: HVD004
+    return x
+
+
+def _wrapped_by_call(x):
+    _m_steps.inc()  # EXPECT: HVD004
+    return x * 2
+
+
+_jitted = jax.jit(_wrapped_by_call)
+
+
+@jax.jit
+def decorated_env_value(x):
+    from horovod_tpu.common import config
+    scale = config.env_value("HOROVOD_FUSION_THRESHOLD")  # EXPECT: HVD004
+    return x * scale
+
+
+@jax.jit
+def effect_after_nested_target(x):
+    # the nested traced def is skipped (it has its own pass), but the
+    # side-effect AFTER it in the same statement list must still fire
+    @jax.jit
+    def inner(y):
+        return y + 1
+    t0 = time.monotonic()  # EXPECT: HVD004
+    return inner(x) * t0
+
+
+# -- negatives -------------------------------------------------------------
+
+@jax.jit
+def pure_kernel(x):
+    # functional array update: .at[].set is NOT a metrics mutation
+    return x.at[0].set(jnp.sum(x))
+
+
+def side_effects_outside_tracing(x):
+    _m_steps.inc()
+    t0 = time.perf_counter()
+    return x, t0
+
+
+def _builder(n):
+    # env read in the BUILDER (runs per call, outside tracing) is fine
+    mode = os.environ.get("HVDFIX_MODE", "a")
+
+    @jax.jit
+    def kernel(x):
+        return x * n
+    return kernel, mode
+
+
+@jax.jit
+def suppressed_effect(x):
+    # hvdlint: disable-next=HVD004 (fixture: deliberate trace-time brand)
+    _m_steps.inc()
+    return x

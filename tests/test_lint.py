@@ -1,0 +1,229 @@
+"""hvdlint: the analyzer's own tests + the tier-1 repo gate.
+
+Layout:
+  * TestRepoGate — `horovod_tpu/` must be lint-clean (zero
+    unsuppressed findings) and the run must stay fast (< 10 s), so
+    the gate never becomes tier-1's slow step.
+  * TestFixtureCorpus — every seeded positive in
+    tests/lint_fixtures/ (marked `# EXPECT: HVD00x`) is reported at
+    exactly that file:line, and nothing else is: positives, negatives
+    and anchor accuracy in one assertion.
+  * determinism / baseline round-trip / suppression parsing / CLI
+    exit-code contract / config.env_value unit tests.
+"""
+
+import json
+import os
+import re
+import time
+
+import pytest
+
+from horovod_tpu.analysis import run_analysis
+from horovod_tpu.analysis import baseline as baseline_mod
+from horovod_tpu.analysis.cli import main as cli_main
+from horovod_tpu.analysis.model import Suppressions
+from horovod_tpu.analysis.report import render_json, render_text
+from horovod_tpu.common import config as hconfig
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO_ROOT, "horovod_tpu")
+FIXTURES = os.path.join(REPO_ROOT, "tests", "lint_fixtures")
+
+_EXPECT_RE = re.compile(r"#\s*EXPECT:\s*(HVD\d+)")
+
+
+def _expected_findings():
+    """{(relpath, line, rule), ...} from the fixture EXPECT markers."""
+    expected = set()
+    for name in sorted(os.listdir(FIXTURES)):
+        if not name.endswith(".py"):
+            continue
+        rel = f"tests/lint_fixtures/{name}"
+        path = os.path.join(FIXTURES, name)
+        with open(path, "r", encoding="utf-8") as fh:
+            for lineno, line in enumerate(fh, start=1):
+                m = _EXPECT_RE.search(line)
+                if m:
+                    expected.add((rel, lineno, m.group(1)))
+    return expected
+
+
+class TestRepoGate:
+    def test_repo_is_lint_clean(self):
+        """The tier-1 gate: no unsuppressed findings in the package."""
+        t0 = time.perf_counter()
+        result = run_analysis([PKG], cwd=REPO_ROOT)
+        elapsed = time.perf_counter() - t0
+        assert result.parse_errors == []
+        assert result.findings == [], (
+            "new hvdlint findings (fix them or add a justified "
+            "suppression):\n"
+            + render_text(result.findings))
+        # The gate must never become the slow step of tier-1.
+        assert elapsed < 10.0, f"hvdlint took {elapsed:.1f}s (>10s)"
+
+    def test_repo_suppressions_are_counted(self):
+        """The audited benign findings are suppressed, not invisible —
+        if this number drifts, someone added or removed a suppression
+        and the PR should say why."""
+        result = run_analysis([PKG], cwd=REPO_ROOT)
+        assert result.suppressed >= 5
+
+
+class TestFixtureCorpus:
+    def test_seeded_positives_and_negatives(self):
+        """Exactly the EXPECT-marked (file, line, rule) triples are
+        reported — anchors included — and nothing else."""
+        result = run_analysis([FIXTURES], cwd=REPO_ROOT)
+        got = {(f.path, f.line, f.rule) for f in result.findings}
+        expected = _expected_findings()
+        missing = expected - got
+        extra = got - expected
+        assert not missing, f"seeded violations not caught: {missing}"
+        assert not extra, f"false positives: {extra}"
+
+    def test_each_rule_has_positives(self):
+        result = run_analysis([FIXTURES], cwd=REPO_ROOT)
+        rules = {f.rule for f in result.findings}
+        assert rules == {"HVD001", "HVD002", "HVD003", "HVD004"}
+
+    def test_fixture_suppressions_filtered(self):
+        result = run_analysis([FIXTURES], cwd=REPO_ROOT)
+        assert result.suppressed == 4
+
+
+class TestDeterminism:
+    def test_json_report_byte_stable(self):
+        r1 = run_analysis([FIXTURES], cwd=REPO_ROOT)
+        r2 = run_analysis([FIXTURES], cwd=REPO_ROOT)
+        j1 = render_json(r1.findings, suppressed=r1.suppressed)
+        j2 = render_json(r2.findings, suppressed=r2.suppressed)
+        assert j1 == j2
+        # and it parses back with stable ordering
+        doc = json.loads(j1)
+        keys = [(f["path"], f["line"], f["col"], f["rule"])
+                for f in doc["findings"]]
+        assert keys == sorted(keys)
+
+
+class TestBaseline:
+    def test_round_trip_filters_everything(self, tmp_path):
+        result = run_analysis([FIXTURES], cwd=REPO_ROOT)
+        assert result.findings
+        text = baseline_mod.render(result.findings)
+        # render -> parse -> filter: a committed baseline silences
+        # exactly the findings it records
+        baseline = baseline_mod.parse(text)
+        again = run_analysis([FIXTURES], baseline=baseline,
+                             cwd=REPO_ROOT)
+        assert again.findings == []
+        assert again.baselined == len(result.findings)
+
+    def test_new_finding_still_fails(self):
+        result = run_analysis([FIXTURES], cwd=REPO_ROOT)
+        partial = baseline_mod.parse(
+            baseline_mod.render(result.findings[1:]))
+        again = run_analysis([FIXTURES], baseline=partial,
+                             cwd=REPO_ROOT)
+        assert len(again.findings) == 1
+        assert (again.findings[0].fingerprint
+                == result.findings[0].fingerprint)
+
+    def test_render_is_stable(self):
+        result = run_analysis([FIXTURES], cwd=REPO_ROOT)
+        assert (baseline_mod.render(result.findings)
+                == baseline_mod.render(list(result.findings)))
+
+
+class TestSuppressions:
+    def test_same_line(self):
+        sup = Suppressions.parse(
+            "x = 1  # hvdlint: disable=HVD002 (reason)\n")
+        assert sup.covers("HVD002", 1)
+        assert not sup.covers("HVD001", 1)
+        assert not sup.covers("HVD002", 2)
+
+    def test_disable_next_skips_comment_lines(self):
+        sup = Suppressions.parse(
+            "# hvdlint: disable-next=HVD001 (a reason that wraps\n"
+            "# over several comment lines)\n"
+            "do_thing()\n")
+        assert sup.covers("HVD001", 3)
+        assert not sup.covers("HVD001", 1)
+
+    def test_multiple_rules_and_file_wide(self):
+        sup = Suppressions.parse(
+            "x  # hvdlint: disable=HVD001,HVD003\n"
+            "# hvdlint: disable-file=HVD004\n")
+        assert sup.covers("HVD001", 1)
+        assert sup.covers("HVD003", 1)
+        assert sup.covers("HVD004", 999)
+        assert not sup.covers("HVD002", 1)
+
+    def test_marker_inside_string_is_ignored(self):
+        sup = Suppressions.parse(
+            's = "# hvdlint: disable=HVD001"\n')
+        assert not sup.covers("HVD001", 1)
+
+
+class TestCli:
+    def test_exit_codes_and_write_baseline(self, tmp_path, capsys,
+                                           monkeypatch):
+        monkeypatch.chdir(REPO_ROOT)
+        bl = tmp_path / "bl.json"
+        # findings without a baseline -> 1
+        assert cli_main([FIXTURES, "--no-baseline"]) == 1
+        capsys.readouterr()
+        # write-baseline -> 0, then the same run against it -> 0
+        assert cli_main([FIXTURES, "--write-baseline",
+                         "--baseline", str(bl)]) == 0
+        capsys.readouterr()
+        assert cli_main([FIXTURES, "--baseline", str(bl)]) == 0
+        out = capsys.readouterr().out
+        assert "0 finding(s)" in out
+        # unknown rule -> usage error 2
+        assert cli_main([FIXTURES, "--select", "HVD999"]) == 2
+        capsys.readouterr()
+        # a gate that scans nothing must fail loudly, not exit 0
+        assert cli_main(["no/such/dir"]) == 2
+
+    def test_github_format(self, capsys, monkeypatch):
+        monkeypatch.chdir(REPO_ROOT)
+        rc = cli_main([FIXTURES, "--no-baseline", "-f", "github"])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "::error file=tests/lint_fixtures/" in out
+        assert ",line=" in out
+
+    def test_list_rules(self, capsys):
+        assert cli_main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rid in ("HVD001", "HVD002", "HVD003", "HVD004"):
+            assert rid in out
+
+
+class TestEnvValue:
+    def test_declared_typed_read(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "1024")
+        assert hconfig.env_value("HOROVOD_FUSION_THRESHOLD") == 1024
+
+    def test_default_on_unset_and_empty(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_ELASTIC_TIMEOUT", raising=False)
+        assert hconfig.env_value("HOROVOD_ELASTIC_TIMEOUT") == 600.0
+        monkeypatch.setenv("HOROVOD_ELASTIC_TIMEOUT", "")
+        assert hconfig.env_value("HOROVOD_ELASTIC_TIMEOUT") == 600.0
+
+    def test_undeclared_raises(self):
+        with pytest.raises(KeyError):
+            hconfig.env_value("HOROVOD_NOT_A_KNOB")
+
+    def test_bad_value_raises(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_FUSION_THRESHOLD", "bogus")
+        with pytest.raises(ValueError):
+            hconfig.env_value("HOROVOD_FUSION_THRESHOLD")
+
+    def test_explicit_env_dict(self):
+        assert hconfig.env_value(
+            "HOROVOD_ELASTIC_EPOCH", env={"HOROVOD_ELASTIC_EPOCH":
+                                          "7"}) == 7
