@@ -141,6 +141,22 @@ def _metrics_snapshot():
     return out
 
 
+def _trace_digest():
+    """Compact tracing digest for the JSON artifact: negotiation-skew
+    p50/p99 (the runtime face of the merged straggler report) and
+    per-phase span totals from the flight-recorder ring — so a
+    recorded round carries WHERE the time went, not just the rate.
+    Merged-format trace runs (benchmarks/TIMELINE_*) additionally set
+    HOROVOD_TIMELINE and fuse the per-rank files afterwards with
+    `hvdrun --timeline-merge`."""
+    try:
+        from horovod_tpu import tracing
+        return tracing.trace_digest()
+    except Exception as e:  # pragma: no cover - defensive
+        log(f"bench: trace digest unavailable ({e})")
+        return {}
+
+
 def _make_reduced_resnet(stages: str):
     """Reduced-depth ResNet for multi-process CPU runs (8 procs
     compiling full ResNet-50 on shared cores takes tens of minutes;
@@ -507,6 +523,7 @@ def eager_main(model_name: str = "resnet50"):
         "unit": unit,
         "vs_baseline": round(vs, 4),
         "metrics": _metrics_snapshot(),
+        "trace": _trace_digest(),
     }), flush=True)
 
 
@@ -610,6 +627,7 @@ def transformer_main():
         "unit": "tokens/sec/chip",
         "vs_baseline": round(vs, 4),
         "metrics": _metrics_snapshot(),
+        "trace": _trace_digest(),
     }), flush=True)
 
 
@@ -785,6 +803,7 @@ def main(model_name: str = "resnet50"):
         "unit": "img/sec/chip",
         "vs_baseline": round(vs, 4),
         "metrics": _metrics_snapshot(),
+        "trace": _trace_digest(),
     }), flush=True)
 
 

@@ -72,6 +72,29 @@ def _metric_mutation(call: ast.Call) -> str:
     return ""
 
 
+# Span-emission surface of tracing.py / timeline.py: mutating the
+# flight-recorder ring or a timeline lane from inside a traced
+# function brands ONE stale event into the compiled program per
+# (re)trace — a phantom collective on every dashboard — instead of
+# one per step.
+_SPAN_ATTRS = frozenset({
+    "record", "record_skew", "enqueue", "dispatched",
+    "negotiate_start", "negotiate_end", "done", "fuse",
+    "error_marker", "clock_sync", "next_seq", "advance_step",
+})
+
+
+def _span_mutation(call: ast.Call) -> str:
+    f = call.func
+    if not isinstance(f, ast.Attribute) or f.attr not in _SPAN_ATTRS:
+        return ""
+    recv = attr_chain(f.value).lower()
+    if ("tracing" in recv or "timeline" in recv
+            or recv.split(".")[-1] in ("tl", "_trace", "_tracing")):
+        return f"{attr_chain(f) or f.attr}()"
+    return ""
+
+
 def _side_effect(node: ast.AST) -> str:
     """Human-readable description when `node` is a trace-impure
     operation, else ''."""
@@ -86,6 +109,9 @@ def _side_effect(node: ast.AST) -> str:
     m = _metric_mutation(node)
     if m:
         return f"metrics mutation '{m}'"
+    s = _span_mutation(node)
+    if s:
+        return f"trace-span mutation '{s}'"
     if call_name(node) == "fire" and "fault" in chain.lower():
         return f"fault-injection seam '{chain}()'"
     # The registry-routed point read mandated by HVD002 is just as
@@ -101,7 +127,8 @@ def _side_effect(node: ast.AST) -> str:
 class TracePurityRule(Rule):
     id = "HVD004"
     summary = ("python side-effect (metrics/faults/environ/wall-"
-               "clock) inside a jit/shard_map/pmap-traced function")
+               "clock/trace-span) inside a jit/shard_map/pmap-traced "
+               "function")
 
     def run(self, project: Project) -> List[Finding]:
         findings: List[Finding] = []

@@ -172,11 +172,28 @@ def init(config_overrides: Optional[Dict[str, Any]] = None,
             _state.engine.controller = NegotiatedController(
                 cfg, _state.topology, _state.engine, core=core)
 
-        if cfg.timeline_path and _state.topology.rank == 0:
+        if cfg.timeline_path:
+            # EVERY rank records a trace (the merge + straggler
+            # attribution needs all of them): rank 0 keeps the
+            # configured path verbatim (reference compatibility),
+            # rank N writes a .rankN sibling the merge discovers.
+            # Observability must never kill training: a host where
+            # the trace directory is missing/unwritable loses THAT
+            # rank's trace with a warning, not the whole job (rank 0
+            # alone opened the file before this build, so such
+            # worker hosts were previously valid).
             from ..timeline import Timeline
-            _state.timeline = Timeline(cfg.timeline_path,
-                                       mark_cycles=cfg.timeline_mark_cycles)
-            _state.engine.attach_timeline(_state.timeline)
+            r = _state.topology.rank
+            try:
+                _state.timeline = Timeline(
+                    Timeline.rank_path(cfg.timeline_path, r),
+                    mark_cycles=cfg.timeline_mark_cycles, rank=r)
+                _state.engine.attach_timeline(_state.timeline)
+            except OSError as e:
+                hlog.warning("timeline: cannot open %s (%s); this "
+                             "rank records no trace",
+                             Timeline.rank_path(cfg.timeline_path, r),
+                             e)
 
         if cfg.autotune:
             from ..autotune import Autotuner
@@ -221,6 +238,15 @@ def init(config_overrides: Optional[Dict[str, Any]] = None,
             if cfg.hierarchical_allreduce else 0)
 
         _state.initialized = True
+
+        # Tracing wiring LAST (the clock-calibration address broadcast
+        # is a collective, so the controller must already be live):
+        # SIGUSR2 flight-recorder dumps + the NTP-style offset
+        # estimation against rank 0 that makes per-rank timelines
+        # mergeable. Best-effort — never fails init.
+        from .. import tracing as _tracing
+        _tracing.on_init(cfg, _state)
+
         hlog.info("horovod_tpu initialized: rank=%d size=%d local_rank=%d "
                   "local_size=%d cross_rank=%d cross_size=%d devices=%d",
                   _state.topology.rank, _state.topology.size,
@@ -241,6 +267,8 @@ def shutdown() -> None:
         if _state.timeline is not None:
             _state.timeline.close()
             _state.timeline = None
+        from .. import tracing as _tracing
+        _tracing.on_shutdown()
         if _state.metrics_summary is not None:
             _state.metrics_summary.stop()
             _state.metrics_summary = None
@@ -320,15 +348,24 @@ def is_homogeneous() -> bool:
 
 
 def start_timeline(file_path: str, mark_cycles: bool = False) -> None:
-    """Runtime timeline start (reference: TimelineController)."""
+    """Runtime timeline start (reference: TimelineController). Every
+    rank records — rank 0 at `file_path` verbatim, rank N at a
+    `.rankN` sibling — so `hvdrun --timeline-merge` can fuse them.
+    Cross-host clock CALIBRATION only comes up when HOROVOD_TIMELINE
+    was set at init (its address broadcast cannot safely run
+    mid-training); a runtime-started trace rebinds an existing
+    calibrator, else merges on raw monotonic anchors (same-host
+    only — merge() warns)."""
     st = _require_init()
-    if st.topology.rank != 0:
-        return
     if st.timeline is not None:
         st.timeline.close()
+    from .. import tracing as _tracing
     from ..timeline import Timeline
-    st.timeline = Timeline(file_path, mark_cycles=mark_cycles)
+    r = st.topology.rank
+    st.timeline = Timeline(Timeline.rank_path(file_path, r),
+                           mark_cycles=mark_cycles, rank=r)
     st.engine.attach_timeline(st.timeline)
+    _tracing.rebind_timeline(st.timeline)
 
 
 def stop_timeline() -> None:
@@ -337,3 +374,5 @@ def stop_timeline() -> None:
         st.timeline.close()
         st.timeline = None
         st.engine.attach_timeline(None)
+        from .. import tracing as _tracing
+        _tracing.rebind_timeline(None)

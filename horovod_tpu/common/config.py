@@ -145,6 +145,33 @@ KNOBS: List[Knob] = [
          "negotiation/queue/fusion/collective phases (rank 0 only)."),
     Knob("HOROVOD_TIMELINE_MARK_CYCLES", _parse_bool, False,
          "Mark background-engine cycles in the timeline."),
+    # -- distributed tracing / flight recorder -------------------------------
+    Knob("HOROVOD_TRACE_RING_SIZE", int, 4096,
+         "Flight-recorder capacity: the last N span events per rank "
+         "are kept in an always-on in-memory ring (one tuple append "
+         "on the collective hot path, no file IO) and dumped into "
+         "postmortem-rank{r}.json on SIGUSR2, the elastic control "
+         "plane's 'dump' verb, or a HorovodInternalError. 0 disables "
+         "the recorder entirely."),
+    Knob("HOROVOD_TRACE_POSTMORTEM_DIR", str, "",
+         "Directory for flight-recorder postmortem dumps. Empty = "
+         "the HOROVOD_TIMELINE file's directory, else the working "
+         "directory."),
+    Knob("HOROVOD_TRACE_CLOCK_SYNC_INTERVAL", float, 30.0,
+         "Seconds between clock-calibration re-estimations against "
+         "rank 0 (NTP-style midpoint over the authenticated control-"
+         "plane wire) while a timeline is recording. Each estimate "
+         "rides the per-rank trace as a CLOCK_SYNC record consumed "
+         "by `hvdrun --timeline-merge`. 0 = calibrate once at init "
+         "only."),
+    Knob("HOROVOD_TRACE_CLOCK_PROBES", int, 8,
+         "Round-trip probes per clock-calibration estimate; the "
+         "min-RTT sample wins (offset error is bounded by that "
+         "RTT)."),
+    Knob("HOROVOD_TRACE_SIGUSR2", _parse_bool, True,
+         "Install the SIGUSR2 handler that dumps the flight "
+         "recorder to postmortem-rank{r}.json (main-thread init "
+         "only; the elastic 'dump' verb works regardless)."),
     # -- autotune ------------------------------------------------------------
     Knob("HOROVOD_AUTOTUNE", _parse_bool, False,
          "Enable online autotuning of fusion threshold and cycle time."),
@@ -385,6 +412,11 @@ class Config:
         "metrics_summary_seconds": "HOROVOD_METRICS_SUMMARY_SECONDS",
         "timeline_path": "HOROVOD_TIMELINE",
         "timeline_mark_cycles": "HOROVOD_TIMELINE_MARK_CYCLES",
+        "trace_ring_size": "HOROVOD_TRACE_RING_SIZE",
+        "trace_postmortem_dir": "HOROVOD_TRACE_POSTMORTEM_DIR",
+        "trace_clock_sync_interval": "HOROVOD_TRACE_CLOCK_SYNC_INTERVAL",
+        "trace_clock_probes": "HOROVOD_TRACE_CLOCK_PROBES",
+        "trace_sigusr2": "HOROVOD_TRACE_SIGUSR2",
         "autotune": "HOROVOD_AUTOTUNE",
         "autotune_log": "HOROVOD_AUTOTUNE_LOG",
         "autotune_mode": "HOROVOD_AUTOTUNE_MODE",

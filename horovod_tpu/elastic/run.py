@@ -147,9 +147,16 @@ def run(func: Callable) -> Callable:
             state.sync()
             try:
                 return func(state, *args, **kwargs)
-            except HorovodInternalError:
+            except HorovodInternalError as e:
                 hlog.warning("elastic: collective failure — restoring "
                              "committed state and re-initializing")
+                # Flight-recorder postmortem BEFORE the restore tears
+                # the evidence down: the in-flight tensor table and
+                # controller queue still show what this rank was
+                # waiting on when the collective died (never raises).
+                from .. import tracing as _tracing
+                _tracing.write_postmortem(
+                    f"HorovodInternalError: {e}", trigger="crash")
                 state.before_reset()
                 state.restore()
                 _reinitialize()

@@ -93,6 +93,11 @@ class State:
             while True:
                 time.sleep(60)
         _m_commits.inc()
+        # Commit == progress: the natural step boundary also advances
+        # the trace context's step id (tracing.py), so spans after
+        # this carry the new step on every rank in lockstep.
+        from .. import tracing as _tracing
+        _tracing.advance_step()
         # Commit == progress: beat the liveness heartbeat here too
         # (rate-limited inside), so a worker stuck BETWEEN the pacer's
         # beats still advertises forward progress at every commit.

@@ -64,6 +64,7 @@ class NotificationListener:
         self._svc = BasicService("elastic-notify", _secret.from_env(),
                                  port)
         self._svc.handle("hosts_updated", self._on_poke)
+        self._svc.handle("dump", self._on_dump)
 
     @property
     def port(self) -> int:
@@ -76,6 +77,19 @@ class NotificationListener:
         _m_notify.inc()
         notifications.notify(info)
         return {"ok": True}
+
+    @staticmethod
+    def _on_dump(req: dict, peer) -> dict:
+        """Control-plane flight-recorder dump: the driver (or an
+        operator with the job secret) asks a LIVE worker for its
+        postmortem — same artifact the crash path writes, without
+        killing anything. Works where SIGUSR2 cannot reach (no shell
+        on the host) or was not installed (non-main-thread init)."""
+        from .. import tracing
+        path = tracing.write_postmortem(
+            f"control-plane dump request from {peer[0]}",
+            trigger="dump_verb")
+        return {"ok": path is not None, "path": path}
 
     def stop(self) -> None:
         self._svc.close()

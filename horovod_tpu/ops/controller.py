@@ -39,6 +39,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import tracing as _tracing
 from ..common import logging as hlog
 from ..core import native
 from ..metrics import (BYTES_BUCKETS, COUNT_BUCKETS, LATENCY_BUCKETS,
@@ -469,6 +470,7 @@ class NegotiatedController:
             self._pending[name] = _PendingAllreduce(
                 tensors, compression, pset, rop, prescale,
                 postscale, h, grouped)
+        _tracing.record("submit", name)
         if self.engine.timeline is not None:
             self.engine.timeline.negotiate_start(name)
         self.core.submit(name, sig, nbytes)
@@ -492,6 +494,7 @@ class NegotiatedController:
                     f"a collective named '{name}' is already pending"))
                 return h
             self._pending[name] = _PendingBroadcast(t, set_root, pset, h)
+        _tracing.record("submit", name)
         if self.engine.timeline is not None:
             self.engine.timeline.negotiate_start(name)
         self.core.submit(name, sig, nbytes)
@@ -516,6 +519,7 @@ class NegotiatedController:
                     f"a collective named '{name}' is already pending"))
                 return h
             self._pending[name] = _PendingAllgather(t, pset, h)
+        _tracing.record("submit", name)
         if self.engine.timeline is not None:
             self.engine.timeline.negotiate_start(name)
         self.core.submit(name, sig, nbytes, str(t.shape[0]))
@@ -543,6 +547,7 @@ class NegotiatedController:
                 return h
             self._pending[name] = _PendingReducescatter(
                 t, pset, rop, prescale, postscale, h)
+        _tracing.record("submit", name)
         if self.engine.timeline is not None:
             self.engine.timeline.negotiate_start(name)
         self.core.submit(name, sig, nbytes)
@@ -566,6 +571,7 @@ class NegotiatedController:
                 return h
             self._pending[name] = _PendingGeneric(
                 fn, h, wants_meta=meta is not None)
+        _tracing.record("submit", name)
         if self.engine.timeline is not None:
             self.engine.timeline.negotiate_start(name)
         self.core.submit(name, f"g|{name}#", nbytes, meta or "")
@@ -680,16 +686,35 @@ class NegotiatedController:
 
     def _execute(self, batch):
         tl = self.engine.timeline
+        t_agree = time.monotonic()
+        # Trace context: one collective sequence id per agreed entry,
+        # assigned in batch order. The agreed batch list is identical
+        # on every rank (the controller's core guarantee), so the same
+        # collective carries the same seq everywhere with no extra
+        # wire bytes — what lets the merge correlate N ranks' spans.
+        seq0 = _tracing.next_seq(len(batch))
+        seqs = {e.name: seq0 + i for i, e in enumerate(batch)}
+        step = _tracing.current_step()
         # The batch was just agreed: locally-submitted entries close
         # their NEGOTIATE lanes and score the negotiation-latency
         # histogram (a joined rank executing a zero-fill entry never
         # submitted — skip it to keep lanes/metrics balanced).
         with self._mu:
-            local = {e.name for e in batch if e.name in self._pending}
+            local = {e.name: self._pending[e.name] for e in batch
+                     if e.name in self._pending}
         for e in batch:
-            if e.name in local:
-                self._m_negotiation.observe(
-                    max(getattr(e, "negotiate_us", 0) or 0, 0) / 1e6)
+            p = local.get(e.name)
+            if p is None:
+                continue
+            neg_s = max(getattr(e, "negotiate_us", 0) or 0, 0) / 1e6
+            self._m_negotiation.observe(neg_s)
+            # Arrival lateness: the coordinator measured first-submit
+            # -> agreed (neg_s); our own submit -> agreed wait leaves
+            # this rank's arrival delta behind the earliest rank —
+            # the runtime form of the merged straggler report.
+            wait_s = max(t_agree - p.submitted, 0.0)
+            _tracing.record_skew(max(neg_s - wait_s, 0.0))
+            _tracing.record("agree", e.name, seqs[e.name], wait_s)
         if tl is not None:
             # The core measured the coordinator-side duration in
             # e.negotiate_us; lanes use local clocks. Mark the cycle
@@ -699,9 +724,13 @@ class NegotiatedController:
                 self._last_cycle_mark = cyc
                 tl.cycle(cyc)
             for e in batch:
-                if e.name in local:
-                    tl.negotiate_end(e.name,
-                                     negotiate_us=e.negotiate_us)
+                p = local.get(e.name)
+                if p is not None:
+                    tl.negotiate_end(
+                        e.name, negotiate_us=e.negotiate_us,
+                        seq=seqs[e.name], step=step,
+                        arrival_us=tl.to_trace_us(
+                            int(p.submitted * 1e9)))
         # error entries: deliver and drop (all ranks got the same ones)
         live = []
         for e in batch:

@@ -22,6 +22,7 @@ relaxing the same-program-order requirement.
 
 from __future__ import annotations
 
+import contextlib
 import itertools
 import threading
 import time
@@ -29,6 +30,7 @@ from typing import Any, Callable, Dict, Optional
 
 import jax
 
+from .. import tracing as _tracing
 from ..common import logging as hlog
 from ..metrics import LATENCY_BUCKETS, REGISTRY as _METRICS
 
@@ -168,21 +170,35 @@ class Engine:
             raise RuntimeError("horovod_tpu engine is shut down")
         h = self.new_handle(name)
         t0 = time.perf_counter()
+        # Inline dispatch gets NO cross-rank sequence id: subset
+        # process-set ops run here on member ranks only, so advancing
+        # the shared counter would shift the controller's agreed ids
+        # differently per rank. seq=-1 marks a local-only span.
+        _tracing.record("dispatch", name)
         if self.timeline is not None:
             self.timeline.enqueue(name)
         try:
             # TraceAnnotation names the host-side dispatch span in
             # jax.profiler/XPlane traces so device timelines line up
             # with the per-tensor semantic lanes (SURVEY.md §5.1's
-            # "rebuild the semantic layer" guidance).
-            with jax.profiler.TraceAnnotation(f"hvd::{name}"):
+            # "rebuild the semantic layer" guidance). Only built while
+            # a profiler session is live — the annotation is invisible
+            # outside a capture, but its construction is not free on
+            # the per-op hot path.
+            cm = (jax.profiler.TraceAnnotation(f"hvd::{name}")
+                  if _tracing.profiler_active()
+                  else contextlib.nullcontext())
+            with cm:
                 result = fn()
             h.set_result(result)
         except BaseException as e:
             h.set_error(e)
+            _tracing.record("error", name)
             if self.timeline is not None:
                 self.timeline.error(name)
             return h
+        _tracing.record("dispatched", name,
+                        arg=time.perf_counter() - t0)
         if self.timeline is not None:
             self.timeline.dispatched(name)
         if self.order_check is not None:
@@ -199,6 +215,7 @@ class Engine:
 
     def synchronize(self, h: Handle) -> Any:
         res = h.wait()
+        _tracing.record("done", h.name)
         if self.timeline is not None:
             self.timeline.done(h.name)
         self.release_handle(h.id)

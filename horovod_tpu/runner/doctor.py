@@ -1,8 +1,13 @@
-"""`hvdrun --check-build` — the capability matrix
-(reference: horovod/runner/launch.py --check-build, which prints the
-[X] NCCL / [ ] MPI style table from horovod/metadata)."""
+"""Diagnostics CLI: `hvdrun --check-build` (the capability matrix;
+reference: horovod/runner/launch.py --check-build, which prints the
+[X] NCCL / [ ] MPI style table from horovod/metadata) and
+`python -m horovod_tpu.runner.doctor trace <dir>` — merge per-rank
+timelines on calibrated clocks and print the straggler report
+(tracing.py)."""
 
 from __future__ import annotations
+
+from typing import List, Optional
 
 
 def _mark(b: bool) -> str:
@@ -67,3 +72,56 @@ def check_build(verbose: bool = False) -> str:
         from ..common.config import describe_knobs
         lines += ["", "Configuration knobs:", describe_knobs()]
     return "\n".join(lines)
+
+
+def trace_report(target: str, out: Optional[str] = None,
+                 top_k: int = 3) -> str:
+    """Merge per-rank trace files under `target` (a directory, or one
+    rank's HOROVOD_TIMELINE file whose .rankN siblings are picked up)
+    into a single clock-aligned Chrome trace and return the rendered
+    straggler report. Also invoked by `hvdrun --timeline-merge`."""
+    from .. import tracing
+    _, report = tracing.merge(target, out=out, top_k=top_k)
+    return tracing.render_report(report)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """`python -m horovod_tpu.runner.doctor [trace <dir>|check-build]`."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.runner.doctor",
+        description="horovod_tpu diagnostics: capability matrix and "
+                    "distributed-trace merge/attribution.")
+    sub = p.add_subparsers(dest="cmd")
+    pc = sub.add_parser("check-build",
+                        help="print the capability matrix (default)")
+    pc.add_argument("--verbose", action="store_true")
+    pt = sub.add_parser(
+        "trace",
+        help="merge per-rank HOROVOD_TIMELINE files into one clock-"
+             "aligned Chrome trace and print the straggler report")
+    pt.add_argument("target",
+                    help="trace directory, or one rank's timeline "
+                         "file (its .rankN siblings are discovered)")
+    pt.add_argument("--out", default=None,
+                    help="merged-trace output path (default: "
+                         "timeline.merged.json next to the inputs)")
+    pt.add_argument("--top-k", type=int, default=3,
+                    help="offender ranks listed in the report")
+    args = p.parse_args(argv)
+    if args.cmd == "trace":
+        try:
+            print(trace_report(args.target, out=args.out,
+                               top_k=args.top_k))
+        except (OSError, ValueError) as e:
+            print(f"doctor trace: {e}")
+            return 1
+        return 0
+    print(check_build(verbose=getattr(args, "verbose", False)))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

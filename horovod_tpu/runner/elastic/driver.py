@@ -61,6 +61,9 @@ class _Slot:
         self.info = info
         self.proc = proc
         self.pumps: List[threading.Thread] = []
+        # Postmortem freshness gate: only dumps written AFTER this
+        # spawn belong to this incarnation's failure.
+        self.spawned = time.time()
 
 
 class ElasticDriver:
@@ -206,6 +209,50 @@ class ElasticDriver:
         t1.start(); t2.start()
         slot.pumps = [t1, t2]
         return slot
+
+    def _collect_postmortems(self, bad: Dict) -> None:
+        """Surface dead workers' flight-recorder postmortems
+        (tracing.py writes postmortem-rank{r}.json on
+        HorovodInternalError / SIGUSR2 / the dump verb) into the
+        driver log before blacklisting recycles the world. Reads the
+        postmortem directory directly — local workers and shared
+        filesystems are covered; a missing file just means the worker
+        died too hard to dump. Best-effort by design."""
+        import json as _json
+        from ... import tracing as _tracing
+        pmdir = _tracing.postmortem_dir()
+        for key, code in bad.items():
+            slot = self.slots.get(key)
+            if slot is None:
+                continue
+            path = os.path.join(
+                pmdir, f"postmortem-rank{slot.info.rank}.json")
+            try:
+                with open(path) as f:
+                    doc = _json.load(f)
+            except (OSError, ValueError):
+                continue
+            # Freshness: a dump from a PREVIOUS incarnation (reset,
+            # or an earlier job sharing the dir) must not be logged
+            # as this crash's evidence — a SIGKILLed worker writes
+            # nothing, and attributing the old reason would actively
+            # mislead the operator. 1 s slack for clock granularity.
+            if float(doc.get("unix_time", 0)) < slot.spawned - 1.0:
+                hlog.debug(
+                    "elastic: ignoring stale postmortem %s (written "
+                    "before this incarnation spawned)", path)
+                continue
+            runtime = doc.get("runtime", {})
+            hlog.warning(
+                "elastic: postmortem for rank %d (exit %s): "
+                "reason=%r step=%s in_flight=%d pending=%d "
+                "ring_events=%d threads=%d -> %s",
+                slot.info.rank, code, doc.get("reason"),
+                doc.get("step"),
+                len(runtime.get("in_flight_handles", [])),
+                runtime.get("controller_queue_depth", 0),
+                len(doc.get("ring", [])),
+                len(doc.get("thread_stacks", {})), path)
 
     def _notify_workers(self) -> None:
         """Poke every registered notification listener (reference:
@@ -445,6 +492,11 @@ class ElasticDriver:
                         print("[elastic] reset limit reached",
                               file=sys.stderr)
                         return max(bad.values())
+                    # Collect flight-recorder postmortems BEFORE the
+                    # blacklist/gang-restart recycles the world —
+                    # the dead workers' last evidence of what they
+                    # were waiting on.
+                    self._collect_postmortems(bad)
                     # Blacklist failing hosts — but never below
                     # min_np capacity (a single-host job must restart
                     # on the same host, not starve out the window).

@@ -403,6 +403,11 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--verbose", action="store_true")
     p.add_argument("--check-build", action="store_true",
                    help="print the capability matrix and exit")
+    p.add_argument("--timeline-merge", default=None, metavar="DIR",
+                   help="merge the per-rank HOROVOD_TIMELINE traces "
+                        "under DIR (or one rank's timeline file) into "
+                        "a single clock-aligned Chrome trace, print "
+                        "the straggler-attribution report, and exit")
     # elastic (reference: horovodrun --host-discovery-script /
     # --min-num-proc / --max-num-proc)
     p.add_argument("--host-discovery-script", default=None,
@@ -551,6 +556,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.check_build:
         from .doctor import check_build
         print(check_build(verbose=args.verbose))
+        return 0
+    if args.timeline_merge:
+        from .doctor import trace_report
+        try:
+            print(trace_report(args.timeline_merge))
+        except (OSError, ValueError) as e:
+            print(f"hvdrun --timeline-merge: {e}", file=sys.stderr)
+            return 1
         return 0
     command = args.command
     if command and command[0] == "--":

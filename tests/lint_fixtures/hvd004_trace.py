@@ -64,7 +64,41 @@ def effect_after_nested_target(x):
     return inner(x) * t0
 
 
+@jax.jit
+def decorated_span_mutation(x):
+    from horovod_tpu import tracing
+    tracing.record("dispatch", "fixture_op")  # EXPECT: HVD004
+    return x + 1
+
+
+@jax.jit
+def decorated_timeline_span(x):
+    tl = _FAKE_TIMELINE
+    tl.negotiate_start("fixture_op")  # EXPECT: HVD004
+    return x * 2
+
+
 # -- negatives -------------------------------------------------------------
+
+_FAKE_TIMELINE = None
+
+
+def span_outside_tracing(x):
+    # span emission in plain (untraced) python is the intended use
+    from horovod_tpu import tracing
+    tracing.record("dispatch", "fixture_ok")
+    return x
+
+
+@jax.jit
+def lookalike_record(x):
+    # a .record() on a non-tracing receiver (the autotuner's sample
+    # sink) is NOT a span mutation
+    class _Tuner:
+        def record(self, *a):
+            return None
+    _Tuner().record(1, 2)
+    return x
 
 @jax.jit
 def pure_kernel(x):
