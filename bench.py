@@ -631,6 +631,47 @@ def transformer_main():
     }), flush=True)
 
 
+def _overlap_ab_requested() -> bool:
+    """--overlap-ab / BENCH_OVERLAP=ab: run the jit bench twice
+    (bucketed overlap on, then off) and record the A/B in the JSON's
+    `overlap` block, plus the probe's exposed-comm fraction."""
+    return ("--overlap-ab" in sys.argv
+            or os.environ.get("BENCH_OVERLAP", "") == "ab")
+
+
+def _probe_overlap_stats(build_step, params, opt_state, batch,
+                         probe_steps: int = 8):
+    """Bucket plan + schedule-placement accounting from a short
+    probed run: builds the step once more with a tracing.OverlapProbe
+    attached (callbacks cost host time, so this run is SEPARATE from
+    the timed loops), arms it after one compile/warmup call, and
+    reads the exposed-comm fraction — the share of bucket-reduce wall
+    time past the last bucket's cotangent-ready edge, i.e. the tail
+    no schedule can hide. Non-donating build so the caller's buffers
+    survive."""
+    from horovod_tpu import tracing
+    from horovod_tpu.parallel.train import last_overlap_info
+    probe = tracing.OverlapProbe()
+    step = build_step(overlap=True, overlap_probe=probe, donate=False)
+    out = step(params, opt_state, batch)          # compile: unrecorded
+    jax.block_until_ready(out)
+    info = last_overlap_info()
+    probe.armed = True
+    for _ in range(probe_steps):
+        t0 = time.monotonic_ns()
+        out = step(params, opt_state, batch)
+        jax.block_until_ready(out)
+        probe.step_span(t0, time.monotonic_ns())
+    probe.armed = False
+    stats = {"overlap_enabled": bool(info.get("enabled")),
+             "buckets": info.get("buckets"),
+             "bucket_bytes": info.get("bucket_bytes"),
+             "threshold_bytes": info.get("threshold"),
+             "n_grad_leaves": info.get("n_leaves")}
+    stats.update(probe.hidden_fraction())
+    return stats, probe
+
+
 def main(model_name: str = "resnet50"):
     batch_per_chip = int(os.environ.get("BENCH_BATCH", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "200"))
@@ -710,11 +751,19 @@ def main(model_name: str = "resnet50"):
     opt = optax.sgd(0.0125 * n_chips, momentum=0.9)
     opt_state = opt.init(params)
 
-    step = build_train_step(
-        loss_fn, opt, mesh,
-        batch_spec={"images": P("data"), "labels": P("data"),
-                    "batch_stats": P()},
-        loss_has_aux=True, donate=True)
+    def build_step(**overrides):
+        kw = dict(batch_spec={"images": P("data"), "labels": P("data"),
+                              "batch_stats": P()},
+                  loss_has_aux=True, donate=True)
+        kw.update(overrides)
+        return build_train_step(loss_fn, opt, mesh, **kw)
+
+    step = build_step()
+    # Effective overlap of the HEADLINE program (knob default may be
+    # off, or the jax band unsupported): build_train_step records it
+    # at build time; captured here before any other build resets it.
+    from horovod_tpu.parallel.train import last_overlap_info
+    headline_overlap = bool(last_overlap_info().get("enabled"))
 
     rng = np.random.default_rng(0)
     images = jnp.asarray(
@@ -790,6 +839,77 @@ def main(model_name: str = "resnet50"):
             f"{flops_per_step / global_batch / 1e9:.1f} GFLOP/img "
             f"compiled)")
 
+    overlap_block = None
+    if _overlap_ab_requested():
+        # A/B: the headline loop above ran with the shipped default
+        # (overlap ON). Probe the bucket plan + exposed-comm fraction
+        # on a separate short run (callbacks are not free), then time
+        # the overlap-OFF (monolithic end-of-step reduction) program
+        # under the same warmup discipline.
+        batch = {"images": images, "labels": labels,
+                 "batch_stats": batch_stats}
+        stats, _ = _probe_overlap_stats(build_step, params, opt_state,
+                                        batch)
+        step_off, _ = aot_compile(build_step(overlap=False),
+                                  params, opt_state, batch)
+
+        def run_off(params, opt_state, batch_stats):
+            b = {"images": images, "labels": labels,
+                 "batch_stats": batch_stats}
+            params, opt_state, m = step_off(params, opt_state, b)
+            return params, opt_state, m["aux"], m["loss"]
+
+        for _ in range(warmup):
+            params, opt_state, batch_stats, loss = run_off(
+                params, opt_state, batch_stats)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, opt_state, batch_stats, loss = run_off(
+                params, opt_state, batch_stats)
+        float(loss)
+        dt_off = time.perf_counter() - t0
+        off_chip = global_batch * steps / dt_off / n_chips
+        overlap_block = dict(stats)
+        overlap_block["on_leg_overlap_enabled"] = headline_overlap
+        if not headline_overlap:
+            # The 'on' leg is the headline loop (shipped default): if
+            # the knob or the jax band disabled overlap there, BOTH
+            # timed legs ran the identical monolithic program — say so
+            # instead of publishing a vacuous A/B as a hiding proof
+            # (the probe forces overlap=True, so its bucket stats
+            # describe a program the headline never executed).
+            overlap_block["note"] = (
+                "overlap disabled on the headline leg "
+                "(HOROVOD_JIT_OVERLAP=0 or unsupported jax band): "
+                "both timed legs ran the monolithic reduction — the "
+                "rates below are a null A/B, and the bucket/"
+                "exposed_comm stats describe the forced-overlap probe "
+                "program only")
+        overlap_block.update({
+            "on_img_sec_per_chip": round(img_sec_chip, 2),
+            "off_img_sec_per_chip": round(off_chip, 2),
+            "delta_pct": round((img_sec_chip / off_chip - 1) * 100, 2)
+            if off_chip else 0.0,
+            "world_size": hvd.size(),
+        })
+        if hvd.size() <= 1 and n_chips <= 1:
+            overlap_block["roofline_note"] = (
+                "world_size 1: psum lowers to a no-op, so on/off "
+                "rates are flat BY CONSTRUCTION — the overlap's win "
+                "is wire-time hiding, which needs wire. The claim "
+                "this artifact gates is schedule placement: "
+                "exposed_comm_fraction measures the reduce tail past "
+                "the last cotangent-ready edge (per-bucket spans in "
+                "the merged timeline show the rest under backprop); "
+                "the throughput delta materializes at scale, where "
+                "item 2's efficiency curve is dominated by the "
+                "end-of-step serialization this removes.")
+        log(f"bench: overlap A/B on={img_sec_chip:.1f} "
+            f"off={off_chip:.1f} img/s/chip "
+            f"({overlap_block['delta_pct']:+.2f}%) "
+            f"buckets={stats.get('buckets')} "
+            f"exposed_comm={stats.get('exposed_comm_fraction')}")
+
     # BASELINE.json's `published` is empty (see BASELINE.md provenance
     # note), so the most meaningful ratio is against the FIRST
     # recorded round on this same hardware — cross-round progress
@@ -797,14 +917,17 @@ def main(model_name: str = "resnet50"):
     metric = f"{model_name}_synthetic_train_img_sec_per_chip"
     baseline = _resolve_baseline(metric)
     vs = img_sec_chip / baseline if baseline else 1.0
-    print(json.dumps({
+    doc = {
         "metric": metric,
         "value": round(img_sec_chip, 2),
         "unit": "img/sec/chip",
         "vs_baseline": round(vs, 4),
         "metrics": _metrics_snapshot(),
         "trace": _trace_digest(),
-    }), flush=True)
+    }
+    if overlap_block is not None:
+        doc["overlap"] = overlap_block
+    print(json.dumps(doc), flush=True)
 
 
 if __name__ == "__main__":

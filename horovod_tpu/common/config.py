@@ -37,6 +37,17 @@ KNOBS: List[Knob] = [
          "Tensor-fusion buffer threshold in bytes; pending gradients are "
          "greedily packed into buckets up to this size before a single "
          "fused allreduce is launched. 0 disables fusion."),
+    Knob("HOROVOD_JIT_OVERLAP", _parse_bool, True,
+         "Bucketed reverse-order gradient reduction in the jitted "
+         "train step (parallel/train.py build_train_step): gradient "
+         "leaves pack into HOROVOD_FUSION_THRESHOLD-sized buckets in "
+         "reverse (last-produced-first) order and each bucket's psum "
+         "is emitted inside the backward pass as soon as its "
+         "cotangents exist, so XLA's async collectives hide the "
+         "reduction under remaining backprop — the jit-path mirror "
+         "of the eager fusion-buffer overlap. On by default; 0 "
+         "restores the monolithic end-of-step reduction (byte-"
+         "identical HLO to the pre-overlap builder, test-pinned)."),
     Knob("HOROVOD_CYCLE_TIME", float, 1.0,
          "Background engine cycle time in milliseconds: how often the "
          "pending-tensor queue is drained and negotiated."),
@@ -402,6 +413,7 @@ class Config:
     # Convenience attribute access: cfg.fusion_threshold etc.
     _ATTR_MAP = {
         "fusion_threshold": "HOROVOD_FUSION_THRESHOLD",
+        "jit_overlap": "HOROVOD_JIT_OVERLAP",
         "cycle_time_ms": "HOROVOD_CYCLE_TIME",
         "batch_quiescence": "HOROVOD_BATCH_QUIESCENCE",
         "cache_capacity": "HOROVOD_CACHE_CAPACITY",
@@ -506,6 +518,18 @@ def env_value(env_name: str,
         return knob.type(raw)
     except (ValueError, TypeError) as e:
         raise ValueError(f"Bad value for {env_name}={raw!r}: {e}")
+
+
+def knob_default(env_name: str) -> Any:
+    """Declared default of a registered knob — the single authority
+    for fallback values at call sites that read a knob pre-init (so a
+    changed default in KNOBS never leaves stale literals behind)."""
+    knob = _KNOBS_BY_ENV.get(env_name)
+    if knob is None:
+        raise KeyError(
+            f"{env_name} is not a declared knob; add a Knob to "
+            f"KNOBS in horovod_tpu/common/config.py")
+    return knob.default
 
 
 def describe_knobs() -> str:

@@ -119,13 +119,14 @@ def group_by_dtype(arrs: Sequence[jax.Array], fn) -> List[jax.Array]:
     """Split `arrs` into same-dtype subgroups (preserving order within
     each), apply `fn(group_list) -> outputs_list` per group, and
     reassemble in original order. The fusion layer only fuses same-dtype
-    tensors, mirroring the reference controller's FuseResponses rule."""
+    tensors, mirroring the reference controller's FuseResponses rule.
+    The grouping itself lives in ops/bucketing.py — the shared layer
+    the jit overlap path's per-bucket wire packing also routes
+    through."""
+    from .bucketing import split_by_dtype
     arrs = [_as_local(a) for a in arrs]
-    by_dtype: dict = {}
-    for i, a in enumerate(arrs):
-        by_dtype.setdefault(str(a.dtype), []).append(i)
     out: List[Any] = [None] * len(arrs)
-    for idxs in by_dtype.values():
+    for idxs in split_by_dtype(arrs):
         results = fn([arrs[i] for i in idxs])
         for i, r in zip(idxs, results):
             out[i] = r

@@ -104,6 +104,13 @@ class Engine:
         self._hid = itertools.count(1)
         self._name_counters: Dict[str, itertools.count] = {}
         self._lock = threading.Lock()
+        # Frontends (torch) keep per-handle metadata keyed on the
+        # integer id; they register a hook here so their entry dies
+        # WITH the engine's handle — releasing via any path (torch
+        # synchronize, raw collective_ops.synchronize, future GC
+        # sweeps) frees both sides, instead of orphaned metadata
+        # accumulating until session end.
+        self._release_hooks: list = []
         self.timeline = None
         self.autotuner = None
         self.controller = None      # negotiated-cycle controller (optional)
@@ -157,9 +164,19 @@ class Engine:
         with self._lock:
             return self._handles[hid]
 
+    def add_release_hook(self, fn) -> None:
+        """Register `fn(hid)` to run whenever a handle id is
+        released (idempotent per function object)."""
+        with self._lock:
+            if fn not in self._release_hooks:
+                self._release_hooks.append(fn)
+
     def release_handle(self, hid: int) -> None:
         with self._lock:
             self._handles.pop(hid, None)
+            hooks = list(self._release_hooks)
+        for fn in hooks:
+            fn(hid)
 
     # -- execution -----------------------------------------------------------
     def run(self, name: str, nbytes: int,

@@ -111,7 +111,24 @@ def _eager_reduce(leaves: List[Any], op: int, compression,
         chunks = [list(c) for c in
                   _split_round_robin(list(range(len(leaves))), num_groups)]
     else:
-        chunks = [list(range(len(leaves)))]
+        # Default submission order/shape comes from the SHARED bucket
+        # partitioner (ops/bucketing.py — the same layer the jit
+        # overlap path packs with): reverse (last-produced-first)
+        # HOROVOD_FUSION_THRESHOLD-sized groups, the schedule the
+        # reference's backward hooks produce. Sub-threshold trees
+        # still submit as ONE group (bucket), so the stable-
+        # composition fused program of the grouped eager path is
+        # unchanged; results map back by leaf index either way.
+        from ..common.config import knob_default
+        from ..ops.bucketing import partition_cached
+        thresh = int(_numerics._cfg(
+            "HOROVOD_FUSION_THRESHOLD",
+            knob_default("HOROVOD_FUSION_THRESHOLD")))
+        # Signature-cached: the greedy walk runs once per distinct
+        # (tree signature, threshold), not once per step — the knob
+        # read stays per-step because the autotuner retunes it live.
+        chunks = [list(b.indices)
+                  for b in partition_cached(leaves, thresh)]
     out: List[Any] = [None] * len(leaves)
     for idxs in chunks:
         reduced = C.grouped_allreduce(
@@ -303,8 +320,9 @@ def DistributedGradientTransformation(
         if guard and leaves and op in (AVERAGE, SUM) \
                 and compression is NoneCompressor:
             # Eager fused ride: the flag is ONE extra f32 leaf in the
-            # same grouped allreduce (it joins the trailing fusion
-            # chunk), so the veto costs no extra launch. Under AVERAGE
+            # same grouped allreduce (appended last, so the reverse-
+            # order partitioner places it in the first-emitted
+            # bucket), so the veto costs no extra launch. Under AVERAGE
             # (incl. the predivide prescale/postscale rewrite, which
             # nets out to the mean) the reduced flag is the mean of
             # the per-rank 0/1 votes — 1.0 iff everyone voted finite;

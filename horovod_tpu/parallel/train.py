@@ -25,14 +25,54 @@ from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import numerics as _numerics
 from ..common.compat import GRADS_PRE_SUMMED, shard_map
+from ..ops.bucketing import partition_buckets, split_by_dtype
 from .mesh import FSDP_AXIS, batch_axes
 from .sharding import replicated
+
+# VMA-leg bucketing needs lax.pvary to keep the tag's outputs varying
+# (so the implicit-pbroadcast transpose cannot double-psum the
+# cotangents); modern shard_map without pvary (a narrow jax 0.5.x
+# band) falls back to the monolithic reduction.
+_OVERLAP_SUPPORTED = (not GRADS_PRE_SUMMED) or hasattr(lax, "pvary")
+
+
+def overlap_enabled() -> bool:
+    """The HOROVOD_JIT_OVERLAP knob (build-time read, Config-aware)."""
+    from ..common.config import knob_default
+    return bool(_numerics._cfg("HOROVOD_JIT_OVERLAP",
+                               knob_default("HOROVOD_JIT_OVERLAP")))
+
+
+def overlap_threshold_bytes() -> int:
+    """Bucket size for the jit overlap path — the SAME knob the eager
+    fusion buffer packs to (HOROVOD_FUSION_THRESHOLD; default from
+    the registry, not a second literal)."""
+    from ..common.config import knob_default
+    return int(_numerics._cfg("HOROVOD_FUSION_THRESHOLD",
+                              knob_default("HOROVOD_FUSION_THRESHOLD")))
+
+
+# Introspection for bench/tests, following dispatch.py's
+# last_allreduce_info idiom: the LAST build_train_step's overlap
+# resolution (written at build time, traced=False) and the LAST
+# traced overlap-on step's bucket plan (traced=True). Like every
+# last_* surface this is ordering-sensitive — read it right after
+# the build/run you mean to inspect, before building another step.
+# The partition itself is a pure function of the gradient tree, so
+# every process records the identical plan (pinned by the bucketing
+# tests).
+_last_overlap_info: dict = {}
+
+
+def last_overlap_info() -> dict:
+    return dict(_last_overlap_info)
 
 
 def _fsdp_gather_fn(param_specs, mesh):
@@ -131,6 +171,144 @@ def infer_opt_state_specs(optimizer: optax.GradientTransformation,
     return jax.tree_util.tree_map_with_path(leaf_spec, state_shape)
 
 
+def _spec_named_axes(spec) -> set:
+    """Mesh-axis names a PartitionSpec shards over."""
+    named = set()
+    if isinstance(spec, P):
+        for entry in spec:
+            if entry is None:
+                continue
+            for nm in (entry if isinstance(entry, tuple) else (entry,)):
+                named.add(nm)
+    return named
+
+
+def _flag_carrier_group(groups, dtypes):
+    """Index (into `groups`) of the per-dtype wire group whose packed
+    psum the bucket's finite-flag rides, or None. Exact-count dtypes
+    only (f32/f64): a 0/1 vote COUNT accumulated in bf16/fp16 stops
+    being integer-exact past a few hundred ranks (the same rule that
+    keeps the eager fused ride off lossy-compressed groups — see
+    numerics.local_finite_flag); those buckets carry the veto via a
+    separate exact f32 psum instead."""
+    for gi, positions in enumerate(groups):
+        if str(dtypes[positions[0]]) in ("float32", "float64"):
+            return gi
+    return None
+
+
+def _make_bucket_tag(bucket_id: int, raxes: Tuple[str, ...],
+                     all_axes: Tuple[str, ...],
+                     shapes: Tuple, dtypes: Tuple, scale,
+                     guard: bool, vma: bool, probe):
+    """custom_vjp identity over one bucket of parameter leaves whose
+    BACKWARD rule is the bucket's fused reduction: the cotangents are
+    flattened and packed into one wire array per dtype (the in-jit
+    MemcpyInFusionBuffer, mirroring dispatch._pack), psum'd over the
+    bucket's reduce axes, and unpacked — emitted exactly where the
+    cotangents are produced, so the reduction sits INSIDE the backward
+    pass and XLA's async collectives can hide it under the remaining
+    backprop (reference: the fusion-buffer + gradient-hook overlap of
+    SURVEY.md §0/§2.1, compiled instead of threaded).
+
+    The guard's finite-flag rides the same psum as one extra packed
+    element (see _flag_carrier_group); its reduced count leaves the
+    backward pass as the cotangent of a zero `dummy` scalar — the only
+    way a value computed in a bwd rule can reach the caller of
+    value_and_grad.
+
+    VMA leg (`vma`): the forward lifts each leaf to varying over the
+    reduce axes with lax.pvary, so no implicit pbroadcast (whose
+    transpose would psum the cotangent BEFORE it reaches this bwd
+    rule) is inserted downstream — the bucket psum here is the one
+    and only reduction, same as the legacy leg.
+
+    `probe` (timeline verification only, off by default): host
+    callbacks on the packed wire array (cotangents ready) and on the
+    reduced array (reduction done) timestamp each bucket's reduce
+    span against the surrounding backprop in real execution order.
+    """
+    sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
+    groups = split_by_dtype([jnp.dtype(d) for d in dtypes])
+    flag_gi = _flag_carrier_group(groups, dtypes) if guard else None
+    has_inexact = any(jnp.issubdtype(jnp.dtype(d), jnp.inexact)
+                      for d in dtypes)
+    # Axes the bucket's leaves are SHARDED over: the flag count must
+    # still fold them (a NaN confined to one shard of a model-sharded
+    # leaf would otherwise split the skip decision per device — see
+    # _unanimity), so the scalar gets one extra tiny psum after the
+    # ride.
+    rem_axes = tuple(a for a in all_axes if a not in raxes)
+
+    def _psum_r(x):
+        for a in raxes:
+            x = lax.psum(x, a)
+        return x
+
+    def _primal(xs):
+        if vma:
+            return tuple(lax.pvary(x, raxes) for x in xs)
+        return tuple(xs)
+
+    @jax.custom_vjp
+    def tag(dummy, *xs):
+        return _primal(xs)
+
+    def fwd(dummy, *xs):
+        return _primal(xs), None
+
+    def bwd(_, cts):
+        outs: list = [None] * len(cts)
+        rflag = jnp.zeros((), jnp.float32)
+        flag = None
+        if guard and has_inexact:
+            flag = _numerics.local_finite_flag(list(cts))
+        for gi, positions in enumerate(groups):
+            flats = [cts[p].reshape(-1) for p in positions]
+            concat = (jnp.concatenate(flats) if len(flats) > 1
+                      else flats[0])
+            rides = flag is not None and gi == flag_gi
+            if rides:
+                concat = jnp.concatenate(
+                    [concat, flag.astype(concat.dtype).reshape(1)])
+            wire_nbytes = int(concat.size) * concat.dtype.itemsize
+            if probe is not None:
+                # Data dependency on one element anchors the callback
+                # at the pack's completion without copying the bucket
+                # to the host; statics ride the closure.
+                jax.debug.callback(
+                    lambda _t, b=bucket_id, nb=wire_nbytes:
+                        probe(b, "ready", nb),
+                    concat[0])
+            red = _psum_r(concat)
+            if probe is not None:
+                jax.debug.callback(
+                    lambda _t, b=bucket_id, nb=wire_nbytes:
+                        probe(b, "reduced", nb),
+                    red[0])
+            if rides:
+                rflag = red[-1].astype(jnp.float32)
+                red = red[:-1]
+            off = 0
+            for p in positions:
+                seg = red[off:off + sizes[p]].reshape(shapes[p])
+                if scale is not None:
+                    seg = seg * jnp.asarray(scale, seg.dtype)
+                outs[p] = seg
+                off += sizes[p]
+        if flag is not None and flag_gi is None:
+            # No exact-count wire group in this bucket: the veto
+            # travels as its own (tiny, still-inline) f32 psum.
+            rflag = _psum_r(flag)
+        if flag is not None:
+            for a in rem_axes:
+                rflag = lax.psum(rflag, a)
+        return (rflag,) + tuple(outs)
+
+    tag.defvjp(fwd, bwd)
+    return tag
+
+
 def build_train_step(
     loss_fn: Callable[..., Any],
     optimizer: optax.GradientTransformation,
@@ -143,6 +321,9 @@ def build_train_step(
     loss_has_aux: bool = False,
     donate: bool = True,
     check_vma: bool = True,
+    overlap: Optional[bool] = None,
+    overlap_threshold: Optional[int] = None,
+    overlap_probe: Optional[Callable] = None,
 ) -> Callable:
     """Build `step(params, opt_state, batch) -> (params, opt_state,
     metrics)` as a single jitted shard_map over `mesh`.
@@ -167,6 +348,22 @@ def build_train_step(
     do NOT pmean inside it (the values are already replicated across
     the batch axes, so a pmean is a no-op and the result stays
     n_batch× too large).
+
+    Backprop-overlapped reduction (`overlap`, default = the
+    HOROVOD_JIT_OVERLAP knob, on): gradient leaves pack into
+    `overlap_threshold`-byte buckets (default HOROVOD_FUSION_THRESHOLD
+    — the shared partitioner in ops/bucketing.py) in reverse
+    (last-produced-first) order, and each bucket's fused psum is
+    emitted inside the backward pass via a custom_vjp boundary the
+    moment its cotangents exist, so XLA's async collectives hide the
+    reduction under the remaining backprop — the jit-path mirror of
+    the eager fusion-buffer overlap. Numerics are identical to the
+    monolithic path (test-pinned), the numerics finite-flag rides each
+    bucket's psum, and `overlap=False` lowers BYTE-IDENTICALLY to the
+    pre-overlap builder (the HLO-identity test pins this too).
+    `overlap_probe` (verification only) is a host callback
+    `(bucket_id, phase, nbytes)` timestamping each bucket's
+    ready/reduced edges — see tracing.OverlapProbe.
     """
     baxes = batch_axes(mesh)
     n_batch = 1
@@ -199,14 +396,7 @@ def build_train_step(
         spec_tree = _broadcast_specs(param_specs, grads)
 
         def one(g, spec):
-            named = set()
-            if isinstance(spec, P):
-                for entry in spec:
-                    if entry is None:
-                        continue
-                    for nm in (entry if isinstance(entry, tuple)
-                               else (entry,)):
-                        named.add(nm)
+            named = _spec_named_axes(spec)
             for a in axis_names:
                 if a not in named:
                     g = lax.psum(g, a)
@@ -278,14 +468,133 @@ def build_train_step(
                 (lambda params, batch: loss_fn(fsdp_gather(params),
                                                batch)))
 
-    def local_step(params, opt_state, batch):
+    # Bucketed backprop-overlapped reduction (the jit-path mirror of
+    # the eager fusion-buffer overlap): resolved once at BUILD time —
+    # like the numerics guard — so the off position changes NOTHING in
+    # the traced program (the HLO-identity acceptance test pins that
+    # overlap=off lowers byte-identically to the monolithic builder).
+    use_overlap = (overlap_enabled() if overlap is None
+                   else bool(overlap)) and _OVERLAP_SUPPORTED
+    bthresh = (overlap_threshold_bytes() if overlap_threshold is None
+               else int(overlap_threshold))
+    vma_leg = GRADS_PRE_SUMMED and hasattr(lax, "pvary")
+    axis_names = tuple(mesh.shape.keys())
+    default_scale = (1.0 / n_batch
+                     if grad_reducer is None and n_batch != 1 else None)
+
+    def _bucketed_value_and_grad(params, batch):
+        """value_and_grad with per-bucket custom_vjp boundaries: each
+        bucket's fused psum is emitted INSIDE the backward pass, as
+        soon as its cotangents exist (reverse topological bucket
+        order), instead of as one end-of-step block — XLA's async
+        collectives then hide the reduction under the remaining
+        backprop. Returns (loss, aux, reduced_grads) — the guard's
+        unanimity vote is already folded in via imprint_non_finite."""
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        spec_tree = _broadcast_specs(param_specs, params)
+        spec_leaves = jax.tree_util.tree_leaves(
+            spec_tree, is_leaf=lambda x: isinstance(x, P))
+        raxes_of = [tuple(a for a in axis_names
+                          if a not in _spec_named_axes(s))
+                    for s in spec_leaves]
+        # Leaves sharded over EVERY mesh axis need no reduction, and
+        # integer/bool leaves carry float0 cotangents (zero-size —
+        # nothing to pack or reduce); both stay outside the buckets
+        # and pass through exactly as on the monolithic path.
+        bucketable = [i for i in range(len(leaves))
+                      if raxes_of[i]
+                      and jnp.issubdtype(leaves[i].dtype, jnp.inexact)]
+        parts = partition_buckets(
+            [leaves[i] for i in bucketable], bthresh,
+            key_fn=lambda j, leaf: raxes_of[bucketable[j]])
+        bucket_idx = [tuple(bucketable[j] for j in b.indices)
+                      for b in parts]
+        _last_overlap_info.clear()
+        _last_overlap_info.update(
+            enabled=True, traced=True, threshold=bthresh,
+            buckets=len(bucket_idx),
+            bucket_bytes=[int(b.nbytes) for b in parts],
+            bucket_leaves=[len(idxs) for idxs in bucket_idx],
+            n_leaves=len(leaves))
+        tags = []
+        for bid, idxs in enumerate(bucket_idx):
+            tags.append(_make_bucket_tag(
+                bid, raxes_of[idxs[0]], axis_names,
+                tuple(tuple(leaves[i].shape) for i in idxs),
+                tuple(leaves[i].dtype for i in idxs),
+                default_scale, guard, vma_leg, overlap_probe))
+        dummies = tuple(jnp.zeros((), jnp.float32) for _ in bucket_idx)
+
+        def wrapped(leaves_t, dummies_t, batch):
+            lvs = list(leaves_t)
+            for tag, idxs, d in zip(tags, bucket_idx, dummies_t):
+                ys = tag(d, *[lvs[i] for i in idxs])
+                for i, y in zip(idxs, ys):
+                    lvs[i] = y
+            p = jax.tree_util.tree_unflatten(treedef, lvs)
+            return eff_loss(p, batch)
+
+        vg = jax.value_and_grad(wrapped, argnums=(0, 1),
+                                has_aux=loss_has_aux)
         if loss_has_aux:
-            (loss, aux), grads = jax.value_and_grad(
-                eff_loss, has_aux=True)(params, batch)
+            (loss, aux), (glvs, gflags) = vg(tuple(leaves), dummies,
+                                             batch)
         else:
-            loss, grads = jax.value_and_grad(eff_loss)(params, batch)
+            loss, (glvs, gflags) = vg(tuple(leaves), dummies, batch)
             aux = None
-        grads = reduce_grads(grads)
+        glvs = list(glvs)
+        bucketed = {i for idxs in bucket_idx for i in idxs}
+        # Un-bucketed inexact leaves: same treatment the monolithic
+        # path gives them — no psum (their spec names every axis),
+        # uniform scale. float0 (int-leaf) cotangents pass through.
+        if default_scale is not None:
+            for i in range(len(glvs)):
+                if i not in bucketed and jnp.issubdtype(
+                        leaves[i].dtype, jnp.inexact):
+                    glvs[i] = glvs[i] * jnp.asarray(
+                        default_scale, glvs[i].dtype)
+        ok = None
+        if guard:
+            # Fold the per-bucket reduced vote counts (each already a
+            # device-global count — the bwd rule lifts its flag over
+            # the bucket's non-reduce axes too) into one unanimity
+            # decision, exactly the semantics of _unanimity on the
+            # monolithic path: any rank's non-finite veto skips the
+            # step on EVERY rank.
+            votes = []
+            for bid, idxs in enumerate(bucket_idx):
+                if any(jnp.issubdtype(leaves[i].dtype, jnp.inexact)
+                       for i in idxs):
+                    votes.append(gflags[bid] > n_devices - 0.5)
+            loose = [glvs[i] for i in range(len(glvs))
+                     if i not in bucketed
+                     and jnp.issubdtype(leaves[i].dtype, jnp.inexact)]
+            if loose:
+                votes.append(_unanimity(
+                    _numerics.local_finite_flag(loose)))
+            if votes:
+                ok = votes[0]
+                for v in votes[1:]:
+                    ok = jnp.logical_and(ok, v)
+        grads = jax.tree_util.tree_unflatten(treedef, glvs)
+        if grad_reducer is not None:
+            grads = grad_reducer(grads)
+        if ok is not None:
+            grads = _numerics.imprint_non_finite(grads, ok)
+        return loss, aux, grads
+
+    def local_step(params, opt_state, batch):
+        if use_overlap:
+            loss, aux, grads = _bucketed_value_and_grad(params, batch)
+        else:
+            if loss_has_aux:
+                (loss, aux), grads = jax.value_and_grad(
+                    eff_loss, has_aux=True)(params, batch)
+            else:
+                loss, grads = jax.value_and_grad(eff_loss)(params,
+                                                           batch)
+                aux = None
+            grads = reduce_grads(grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         metrics = {"loss": _pmean_axes(loss, baxes)}
@@ -295,6 +604,14 @@ def build_train_step(
             metrics["aux"] = jax.tree.map(
                 lambda a: _pmean_axes(a, baxes), aux)
         return params, opt_state, metrics
+
+    # Reset the introspection dict at BUILD time on both branches so
+    # last_overlap_info() never reports a previous builder's bucket
+    # plan for a step that has not traced yet (traced=False flips
+    # when the overlap-on step records its real plan at first trace).
+    _last_overlap_info.clear()
+    _last_overlap_info.update(enabled=use_overlap, threshold=bthresh,
+                              traced=False)
 
     step = shard_map(
         local_step, mesh=mesh,
@@ -318,7 +635,16 @@ def build_gspmd_train_step(
 ) -> Callable:
     """Constraint-based variant: plain jit; XLA's SPMD partitioner
     derives every collective from the in/out shardings. loss_fn sees
-    GLOBAL arrays."""
+    GLOBAL arrays.
+
+    Backprop overlap on this path is XLA-SCHEDULED by design: the
+    partitioner inserts the gradient reduces where the cotangents are
+    produced and the latency-hiding scheduler overlaps them — the
+    compiler already holds the whole-program schedule that the
+    explicit-collective builder reconstructs manually with its
+    reverse-order buckets (HOROVOD_JIT_OVERLAP), so no manual bucket
+    hints are added here; HOROVOD_FUSION_THRESHOLD does not apply
+    (XLA's own collective-combiner thresholds govern fusion)."""
     baxes = batch_axes(mesh)
     if batch_sharding is None:
         batch_sharding = NamedSharding(

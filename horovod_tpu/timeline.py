@@ -139,6 +139,25 @@ class Timeline:
             ev["args"] = args
         self._q.put(ev)
 
+    def span(self, name: str, phase: str, begin_mono_ns: int,
+             end_mono_ns: int, args: dict = None) -> None:
+        """One closed B/E span on `name`'s lane from raw
+        time.monotonic_ns() readings captured elsewhere — the
+        jit-path overlap probe (tracing.OverlapProbe) records its
+        bucket-reduce edges host-side during step execution and hands
+        them here afterwards, landing them on the same merged-trace
+        axis as the engine's eager lanes."""
+        if self._closed:
+            return
+        tid = self._tid(name)
+        begin = {"name": phase, "ph": "B", "pid": 0, "tid": tid,
+                 "ts": self.to_trace_us(begin_mono_ns)}
+        if args:
+            begin["args"] = dict(args)
+        self._q.put(begin)
+        self._q.put({"name": phase, "ph": "E", "pid": 0, "tid": tid,
+                     "ts": self.to_trace_us(end_mono_ns)})
+
     def fuse(self, name: str, bucket: int) -> None:
         if self._closed:
             return

@@ -1,0 +1,60 @@
+"""HVD001 fixture pair for the per-bucket collective emission pattern
+(PR 6 jit-overlap / shared bucketing layer): looping over a
+deterministic bucket partition and submitting one collective per
+bucket is UNIFORM — every process derives the identical bucket list
+from the identical gradient tree (the partition is a pure function of
+structure/shapes/threshold, pinned by tests/test_bucketing.py), so the
+schedule cannot diverge and none of it may be reported. The positive
+twin shows the SAME loop shape made divergent by a rank-dependent
+bucket selection, which must still be caught.
+"""
+
+import horovod_tpu as hvd
+from horovod_tpu.ops.bucketing import partition_buckets
+
+
+def per_bucket_emission(leaves):
+    # negative: bucket list is rank-independent; one grouped
+    # submission per bucket is the uniform schedule the eager
+    # DistributedOptimizer and the jit overlap path both emit.
+    out = list(leaves)
+    for bucket in partition_buckets(leaves, 64 * 1024 * 1024):
+        reduced = hvd.grouped_allreduce(
+            [leaves[i] for i in bucket.indices])
+        for i, r in zip(bucket.indices, reduced):
+            out[i] = r
+    return out
+
+
+def per_bucket_emission_with_flag(leaves, flag):
+    # negative: the numerics finite-flag riding the trailing bucket is
+    # still an unconditional, uniform submission.
+    buckets = partition_buckets(leaves + [flag], 1 << 20)
+    outs = []
+    for bucket in buckets:
+        outs.append(hvd.grouped_allreduce(
+            [(leaves + [flag])[i] for i in bucket.indices]))
+    return outs
+
+
+def rank_selected_bucket_is_still_divergent(leaves):
+    # positive: slicing the bucket list by rank() makes each process
+    # submit a DIFFERENT schedule — the classic deadlock, loop shape
+    # or not.
+    buckets = partition_buckets(leaves, 1 << 20)
+    mine = buckets[hvd.rank() % len(buckets)]
+    if hvd.rank() == 0:
+        return hvd.grouped_allreduce(  # EXPECT: HVD001
+            [leaves[i] for i in mine.indices])
+    return leaves
+
+
+def rank_gated_bucket_loop(leaves):
+    # positive: an early rank guard taints everything after it,
+    # including the per-bucket loop body.
+    if hvd.rank() != 0:
+        return leaves
+    for bucket in partition_buckets(leaves, 1 << 20):
+        hvd.grouped_allreduce(  # EXPECT: HVD001
+            [leaves[i] for i in bucket.indices])
+    return leaves

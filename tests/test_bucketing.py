@@ -1,0 +1,402 @@
+"""Shared bucketing layer (ops/bucketing.py) + the jit-path bucketed
+overlap it feeds (parallel/train.py): partition determinism (SPMD
+safety — byte-identical assignment for the same tree + threshold,
+in-process and across a fresh interpreter), the reverse-order
+property, threshold edge cases (oversized leaf, empty tree, zero
+threshold, mixed dtypes via key_fn), and the train-step equivalences
+the overlap path must preserve — bucketed == monolithic numerics,
+guard flag-ride equivalence, the overlap-off HLO identity (byte-equal
+to the pre-overlap builder) and overlap-on actually changing the
+program, and the probe's span accounting."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from horovod_tpu.ops.bucketing import (Bucket, assignment_digest,
+                                       leaf_nbytes, partition_buckets,
+                                       partition_tree, split_by_dtype)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _leaves():
+    return [jnp.zeros(s, d) for s, d in
+            [((8,), jnp.float32),      # 32 B
+             ((4, 4), jnp.float32),    # 64 B
+             ((2,), jnp.float32),      # 8 B
+             ((16,), jnp.float32),     # 64 B
+             ((3,), jnp.float32)]]     # 12 B
+
+
+class TestPartition:
+    def test_reverse_order_property(self):
+        """Buckets walk the leaves last-first: bucket 0 starts at the
+        LAST leaf, indices within a bucket strictly decrease, and the
+        concatenation of all buckets is exactly reversed(range(n))."""
+        buckets = partition_buckets(_leaves(), 80)
+        flat = [i for b in buckets for i in b.indices]
+        assert flat == list(range(len(_leaves()) - 1, -1, -1))
+        for b in buckets:
+            assert list(b.indices) == sorted(b.indices, reverse=True)
+
+    def test_threshold_respected_and_bytes_accounted(self):
+        buckets = partition_buckets(_leaves(), 80)
+        for b in buckets:
+            assert b.nbytes <= 80 or len(b.indices) == 1
+            assert b.nbytes == sum(leaf_nbytes(_leaves()[i])
+                                   for i in b.indices)
+
+    def test_oversized_leaf_travels_alone(self):
+        leaves = [jnp.zeros(4, jnp.float32),     # 16 B
+                  jnp.zeros(100, jnp.float32),   # 400 B >> threshold
+                  jnp.zeros(4, jnp.float32)]
+        buckets = partition_buckets(leaves, 64)
+        by_size = {b.indices: b.nbytes for b in buckets}
+        assert (1,) in by_size and by_size[(1,)] == 400
+
+    def test_empty_tree(self):
+        assert partition_buckets([], 1024) == []
+        assert partition_tree({}, 1024) == []
+
+    def test_zero_threshold_disables_fusion(self):
+        buckets = partition_buckets(_leaves(), 0)
+        assert all(len(b.indices) == 1 for b in buckets)
+        assert len(buckets) == len(_leaves())
+
+    def test_scalar_leaf_counts_itemsize(self):
+        assert leaf_nbytes(jnp.zeros((), jnp.float32)) == 4
+        b = partition_buckets([jnp.zeros((), jnp.float64)], 1024)
+        assert b == [Bucket(indices=(0,), nbytes=8)]
+
+    def test_key_fn_families_never_share_a_bucket(self):
+        leaves = [jnp.zeros(4, jnp.float32), jnp.zeros(4, jnp.int32),
+                  jnp.zeros(4, jnp.float32), jnp.zeros(4, jnp.int32)]
+        buckets = partition_buckets(
+            leaves, 1 << 20, key_fn=lambda i, leaf: str(leaf.dtype))
+        for b in buckets:
+            assert len({str(leaves[i].dtype) for i in b.indices}) == 1
+        # emission order still last-produced-first ACROSS families
+        assert buckets[0].indices[0] == 3
+
+    def test_split_by_dtype_preserves_order(self):
+        xs = [jnp.zeros(1, jnp.float32), jnp.zeros(1, jnp.bfloat16),
+              jnp.zeros(2, jnp.float32)]
+        groups = split_by_dtype(xs)
+        assert sorted(i for g in groups for i in g) == [0, 1, 2]
+        assert [0, 2] in groups and [1] in groups
+
+    def test_determinism_in_process(self):
+        """Same shapes/dtypes/threshold => byte-identical digest, for
+        independently constructed trees."""
+        a = assignment_digest(partition_buckets(_leaves(), 80))
+        b = assignment_digest(partition_buckets(_leaves(), 80))
+        assert a == b
+        # golden pin: the assignment itself is part of the SPMD
+        # contract — a silent partitioner change would compile
+        # different programs on different processes mid-rollout.
+        assert a == "4,3:76;2,1:72;0:32"
+
+    def test_determinism_across_processes(self):
+        """A fresh interpreter derives the identical assignment — the
+        SPMD-safety contract for cross-process compilation."""
+        code = (
+            "import jax.numpy as jnp\n"
+            "from horovod_tpu.ops.bucketing import (partition_buckets,"
+            " assignment_digest)\n"
+            "leaves = [jnp.zeros(s, d) for s, d in"
+            " [((8,), jnp.float32), ((4, 4), jnp.float32),"
+            " ((2,), jnp.float32), ((16,), jnp.float32),"
+            " ((3,), jnp.float32)]]\n"
+            "print(assignment_digest(partition_buckets(leaves, 80)))\n")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        out = subprocess.run([sys.executable, "-c", code], env=env,
+                             capture_output=True, text=True,
+                             timeout=120)
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip() == assignment_digest(
+            partition_buckets(_leaves(), 80))
+
+
+# ---------------------------------------------------------------------------
+# bucketed overlap in build_train_step
+# ---------------------------------------------------------------------------
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:8]), axis_names=("proc",))
+
+
+def _loss(params, batch):
+    h = jnp.tanh(batch[:, None] * params["w1"][None, :])
+    return jnp.mean((h @ params["w2"]) ** 2) + jnp.mean(params["b"] ** 2)
+
+
+def _params():
+    return {"w1": jnp.arange(4.0), "w2": jnp.ones((4, 2)),
+            "b": jnp.zeros(3)}
+
+
+class TestBucketedTrainStep:
+    def test_bucketed_matches_monolithic(self):
+        from horovod_tpu.parallel.train import (build_train_step,
+                                                last_overlap_info)
+        mesh = _mesh()
+        opt = optax.sgd(0.1)
+        params = _params()
+        st = opt.init(params)
+        batch = jnp.arange(8.0)
+        s_on = build_train_step(_loss, opt, mesh, donate=False,
+                                overlap=True, overlap_threshold=16)
+        p_on, _, m_on = s_on(params, st, batch)
+        info = last_overlap_info()
+        assert info["enabled"] and info["buckets"] >= 2
+        assert sum(info["bucket_bytes"]) == sum(
+            leaf_nbytes(v) for v in jax.tree_util.tree_leaves(params))
+        s_off = build_train_step(_loss, opt, mesh, donate=False,
+                                 overlap=False)
+        p_off, _, m_off = s_off(params, st, batch)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(p_on[k]),
+                                       np.asarray(p_off[k]), rtol=1e-6)
+        np.testing.assert_allclose(float(m_on["loss"]),
+                                   float(m_off["loss"]), rtol=1e-6)
+
+    def test_default_on_and_knob_off(self, monkeypatch):
+        from horovod_tpu.parallel import train as T
+        monkeypatch.delenv("HOROVOD_JIT_OVERLAP", raising=False)
+        assert T.overlap_enabled() is True
+        monkeypatch.setenv("HOROVOD_JIT_OVERLAP", "0")
+        assert T.overlap_enabled() is False
+
+    def test_overlap_off_hlo_identical_to_monolithic(self,
+                                                     monkeypatch):
+        """The off-switch restores TODAY'S program byte-for-byte: an
+        explicitly-off build and a knob-off default build lower to
+        identical HLO text (extends — does not weaken — the numerics
+        HLO-identity test, which pins guard-off equality separately).
+        Overlap ON must also genuinely change the program, or the
+        knob is theater."""
+        from horovod_tpu.parallel.train import build_train_step
+        monkeypatch.delenv("HOROVOD_NUMERICS_GUARD", raising=False)
+        mesh = _mesh()
+        opt = optax.sgd(0.1)
+        params = _params()
+        st = opt.init(params)
+        batch = jnp.arange(8.0)
+        s_off = build_train_step(_loss, opt, mesh, donate=False,
+                                 overlap=False)
+        monkeypatch.setenv("HOROVOD_JIT_OVERLAP", "0")
+        s_knob = build_train_step(_loss, opt, mesh, donate=False)
+        monkeypatch.delenv("HOROVOD_JIT_OVERLAP", raising=False)
+        s_on = build_train_step(_loss, opt, mesh, donate=False,
+                                overlap=True, overlap_threshold=16)
+        off = s_off.lower(params, st, batch).as_text()
+        knob = s_knob.lower(params, st, batch).as_text()
+        on = s_on.lower(params, st, batch).as_text()
+        assert off == knob
+        assert on != off
+
+    def test_guard_flag_rides_buckets_equivalently(self, monkeypatch):
+        """Numerics flag-ride equivalence, bucketed vs monolithic: a
+        NaN batch skips the step (update exactly zero) on both paths,
+        and a clean step produces identical updates."""
+        from horovod_tpu import numerics
+        from horovod_tpu.parallel.train import build_train_step
+        monkeypatch.setenv("HOROVOD_NUMERICS_GUARD", "1")
+        mesh = _mesh()
+        params = _params()
+        g = numerics.guard_non_finite(optax.sgd(0.1), enabled=True)
+        st = g.init(params)
+        bad = jnp.arange(8.0).at[3].set(jnp.nan)
+        clean = jnp.arange(8.0)
+        results = {}
+        for ov in (True, False):
+            s = build_train_step(_loss, g, mesh, donate=False,
+                                 overlap=ov, overlap_threshold=16)
+            p_bad, o_bad, _ = s(params, st, bad)
+            for k in params:
+                np.testing.assert_array_equal(np.asarray(p_bad[k]),
+                                              np.asarray(params[k]))
+            assert numerics.consecutive_skips(o_bad) == 1
+            p_ok, o_ok, _ = s(params, st, clean)
+            assert numerics.consecutive_skips(o_ok) == 0
+            results[ov] = p_ok
+        for k in params:
+            np.testing.assert_allclose(np.asarray(results[True][k]),
+                                       np.asarray(results[False][k]),
+                                       rtol=1e-6)
+
+    def test_mixed_dtype_bucket_and_bf16_flag_routing(self,
+                                                      monkeypatch):
+        """bf16+f32 leaves share buckets (per-dtype wire arrays); the
+        guard veto still lands even when a NaN hits only the bf16
+        group (whose wire cannot carry an exact vote count)."""
+        from horovod_tpu import numerics
+        from horovod_tpu.parallel.train import build_train_step
+        monkeypatch.setenv("HOROVOD_NUMERICS_GUARD", "1")
+        mesh = _mesh()
+
+        def loss2(params, batch):
+            h = jnp.tanh(batch[:, None].astype(jnp.bfloat16)
+                         * params["wb"][None, :])
+            return jnp.mean((h.astype(jnp.float32) @ params["wf"])
+                            ** 2)
+
+        params = {"wb": jnp.ones(4, jnp.bfloat16),
+                  "wf": jnp.ones((4, 2), jnp.float32)}
+        g = numerics.guard_non_finite(optax.sgd(0.1), enabled=True)
+        st = g.init(params)
+        s = build_train_step(loss2, g, mesh, donate=False,
+                             overlap=True, overlap_threshold=1 << 20)
+        p, o, _ = s(params, st, jnp.arange(8.0))
+        assert numerics.consecutive_skips(o) == 0
+        assert float(jnp.abs(p["wf"] - params["wf"]).max()) > 0
+        bad = dict(params, wb=params["wb"].at[0].set(jnp.nan))
+        p2, o2, _ = s(bad, st, jnp.arange(8.0))
+        assert numerics.consecutive_skips(o2) == 1
+        np.testing.assert_array_equal(
+            np.asarray(p2["wf"]), np.asarray(params["wf"]))
+
+    def test_custom_grad_reducer_gets_summed_grads(self):
+        """grad_reducer contract unchanged under overlap: it receives
+        SUMMED gradients and owns scaling."""
+        from horovod_tpu.parallel.train import build_train_step
+        mesh = _mesh()
+        opt = optax.sgd(1.0)
+        params = {"w": jnp.zeros(3)}
+
+        def loss(params, batch):
+            return jnp.mean(batch) + jnp.sum(params["w"])
+
+        seen = {}
+
+        def reducer(grads):
+            seen["called"] = True
+            return jax.tree_util.tree_map(lambda g: g / 8.0, grads)
+
+        st = opt.init(params)
+        s = build_train_step(loss, opt, mesh, donate=False,
+                             overlap=True, overlap_threshold=4,
+                             grad_reducer=reducer)
+        p, _, _ = s(params, st, jnp.arange(8.0))
+        assert seen.get("called")
+        # d(sum w)/dw = 1 per device, psum'd to 8, reducer /8 => step
+        # of exactly -1.0 under sgd(1.0)
+        np.testing.assert_allclose(np.asarray(p["w"]), -1.0,
+                                   rtol=1e-6)
+
+    def test_probe_records_interleaved_bucket_spans(self, tmp_path):
+        """The overlap probe sees every bucket's ready->reduced pair
+        in real execution order, reverse-bucket emission first, and
+        its exposed-comm accounting + timeline spans are well-formed
+        (the single-host face of the 2-proc merged-timeline
+        artifact)."""
+        from horovod_tpu import tracing
+        from horovod_tpu.parallel.train import build_train_step
+        from horovod_tpu.timeline import Timeline
+        import time as _time
+        probe = tracing.OverlapProbe()
+        mesh = _mesh()
+        opt = optax.sgd(0.1)
+        params = _params()
+        st = opt.init(params)
+        batch = jnp.arange(8.0)
+        s = build_train_step(_loss, opt, mesh, donate=False,
+                             overlap=True, overlap_threshold=16,
+                             overlap_probe=probe)
+        s(params, st, batch)          # compile cycle: NOT recorded
+        assert probe.spans() == []
+        probe.armed = True
+        t0 = _time.monotonic_ns()
+        out = s(params, st, batch)
+        jax.block_until_ready(out)
+        probe.step_span(t0, _time.monotonic_ns())
+        probe.armed = False
+        spans = probe.spans()
+        n_buckets = 3
+        assert len(spans) >= n_buckets
+        assert {b for b, *_ in spans} == set(range(n_buckets))
+        for _, t_ready, t_reduced, nb in spans:
+            assert t_reduced >= t_ready and nb > 0
+        acct = probe.hidden_fraction()
+        assert acct["spans"] >= n_buckets
+        assert 0.0 <= acct["exposed_comm_fraction"] <= 1.0
+        tl = Timeline(str(tmp_path / "tl.json"))
+        assert probe.to_timeline(tl) == len(spans)
+        tl.close()
+        doc = json.load(open(tmp_path / "tl.json"))
+        reduces = [e for e in doc if e.get("name") == "REDUCE"]
+        assert len(reduces) == 2 * len(spans)
+        assert any(e.get("name") == "STEP" for e in doc)
+
+
+# ---------------------------------------------------------------------------
+# 2-rank integration: merged timeline with per-bucket reduce spans
+# ---------------------------------------------------------------------------
+
+@pytest.mark.integration
+def test_two_rank_merged_timeline_shows_bucket_overlap(tmp_path):
+    """Acceptance path: a 2-process run of the bucketed jit step with
+    HOROVOD_TIMELINE + an armed OverlapProbe produces per-rank traces
+    that merge into ONE clock-aligned trace whose overlap.bucketN
+    REDUCE spans sit INSIDE the step's STEP envelope on both ranks —
+    per-bucket reduction overlapping backprop compute, compile cycles
+    excluded (the probe records only armed steps)."""
+    tl_path = str(tmp_path / "overlap_tl.json")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["HOROVOD_TIMELINE"] = tl_path
+    r = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+         sys.executable, os.path.join("tests", "mp_worker_overlap.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=300)
+    if "Multiprocess computations aren't implemented" in (
+            r.stdout + r.stderr):
+        pytest.skip("this jaxlib's CPU backend cannot run cross-"
+                    "process collectives (affects every multiprocess "
+                    "integration test)")
+    assert r.returncode == 0, r.stdout + "\n" + r.stderr
+    assert r.stdout.count("OVERLAP WORKER OK") == 2
+
+    from horovod_tpu import tracing
+    merged_path, _report = tracing.merge(tl_path)
+    doc = json.load(open(merged_path))
+    evs = doc["traceEvents"]
+    assert {0, 1} <= {e.get("pid") for e in evs}
+
+    # per-rank: REDUCE spans exist and fall inside a STEP envelope
+    for pid in (0, 1):
+        mine = [e for e in evs if e.get("pid") == pid]
+        tids = {}
+        for e in mine:
+            if e.get("name") == "thread_name":
+                tids[e["tid"]] = e["args"]["name"]
+        bucket_tids = {t for t, nm in tids.items()
+                       if nm.startswith("overlap.bucket")}
+        assert len(bucket_tids) >= 2, tids
+        steps = [(b["ts"], e["ts"]) for b, e in zip(
+            [x for x in mine if x.get("name") == "STEP"
+             and x["ph"] == "B"],
+            [x for x in mine if x.get("name") == "STEP"
+             and x["ph"] == "E"])]
+        assert steps
+        reduces = [x for x in mine if x.get("name") == "REDUCE"
+                   and x["ph"] == "B"]
+        inside = [x for x in reduces
+                  if any(b <= x["ts"] <= e for b, e in steps)]
+        assert inside, (steps[:2], [x["ts"] for x in reduces][:4])

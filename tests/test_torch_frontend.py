@@ -107,6 +107,23 @@ class TestTensorOps:
         finally:
             hvd.shutdown()
 
+    def test_unsynchronized_handle_meta_released_with_engine_handle(
+            self, hvd_init):
+        """A never-torch-synchronized async handle's metadata dies
+        when the ENGINE releases the handle (e.g. the raw
+        collective_ops synchronize path), not at session end — the
+        r05 leak: torch meta entries accumulated for the whole
+        session when callers synchronized through the non-torch
+        API."""
+        from horovod_tpu.ops import collective_ops as C
+        from horovod_tpu.torch import _handle_meta
+        h = hvd.allreduce_async(torch.ones(3), op=hvd.Sum,
+                                name="engine-released")
+        assert h in _handle_meta
+        # Engine-side release without torch.synchronize ever running.
+        C.synchronize(h)
+        assert h not in _handle_meta
+
     def test_composite_handle_rejected_across_reinit(self):
         """A grouped handle held across shutdown+init must refuse to
         synchronize (its child ids would resolve against the new
