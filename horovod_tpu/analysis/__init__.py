@@ -14,6 +14,18 @@ registry drift and jit-trace impurity fail CI before they reach a pod:
           bodies; cross-module lock-acquisition-order inversions.
   HVD004  trace purity: python side-effects inside jit/shard_map/
           pmap-traced functions.
+  HVD005  collective-protocol consistency: collectives reachable on
+          some paths but not others (swallowed exceptions, partial
+          early returns, breaks out of collective loops, finally
+          reordering) and async handles never drained.
+  HVD006  lockset races: fields written from >=2 thread entry points
+          with an empty common lockset (static Eraser).
+
+HVD005/HVD006 run on a whole-repo call graph + per-function CFGs
+(analysis/graph.py, analysis/dataflow.py) with bounded
+interprocedural budgets; parsed modules and call graphs are cached on
+content hashes, and `--changed-only REF` narrows a run to the files
+touched since a git ref plus their call-graph neighbors.
 
 Per-rule suppression: `# hvdlint: disable=HVD00x (reason)` on the
 flagged line (or `disable-next=` on the line above, `disable-file=`
@@ -54,12 +66,23 @@ class AnalysisResult:
 def run_analysis(paths: Iterable[str],
                  select: Optional[Iterable[str]] = None,
                  baseline: Optional[Dict[str, dict]] = None,
-                 cwd: Optional[str] = None) -> AnalysisResult:
+                 cwd: Optional[str] = None,
+                 focus_from: Optional[Iterable[str]] = None
+                 ) -> AnalysisResult:
     """Analyze `paths` (files/dirs) with the selected rules (default:
     all) and return kept findings, suppression-filtered and
-    baseline-filtered, deterministically sorted."""
+    baseline-filtered, deterministically sorted.
+
+    `focus_from` (--changed-only): rel paths that changed; the full
+    project is still parsed (cross-file tables need it) but findings
+    are restricted — and the expensive per-function passes skipped —
+    outside those files plus their call-graph neighbors."""
     t0 = time.perf_counter()
     project = Project(collect_files(paths, cwd=cwd))
+    if focus_from is not None:
+        from . import graph as graph_mod
+        project.focus = graph_mod.focus_neighbors(
+            project, set(focus_from))
     rule_ids = list(select) if select else sorted(RULES_BY_ID)
     raw: List[Finding] = []
     for rid in rule_ids:
@@ -72,6 +95,8 @@ def run_analysis(paths: Iterable[str],
     kept: List[Finding] = []
     suppressed = 0
     for f in raw:
+        if project.focus is not None and f.path not in project.focus:
+            continue
         sf = by_rel.get(f.path)
         if sf is not None and sf.suppressions.covers(f.rule, f.line):
             suppressed += 1

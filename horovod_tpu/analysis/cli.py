@@ -9,8 +9,9 @@ from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
-from typing import List, Optional
+from typing import List, Optional, Set
 
 from . import baseline as baseline_mod
 from . import run_analysis
@@ -25,7 +26,9 @@ def build_parser() -> argparse.ArgumentParser:
         prog="python -m horovod_tpu.analysis",
         description=("hvdlint: framework-aware static analysis for "
                      "horovod_tpu (SPMD divergence, registry "
-                     "enforcement, lock discipline, trace purity)."))
+                     "enforcement, lock discipline, trace purity, "
+                     "collective-protocol consistency, lockset "
+                     "races)."))
     p.add_argument("paths", nargs="*", default=["horovod_tpu"],
                    help="files or directories to analyze "
                         "(default: horovod_tpu)")
@@ -44,7 +47,42 @@ def build_parser() -> argparse.ArgumentParser:
                         "file and exit 0")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
+    p.add_argument("--changed-only", nargs="?", const="HEAD",
+                   metavar="REF",
+                   help="analyze only files changed since the git "
+                        "ref (default HEAD: staged+unstaged+"
+                        "untracked) plus their call-graph neighbors; "
+                        "the pre-commit fast path — CI runs the full "
+                        "pass")
     return p
+
+
+def git_changed_files(ref: str) -> Optional[Set[str]]:
+    """Repo-relative paths of .py files changed vs `ref`, plus
+    untracked ones; None when git is unavailable or the ref is bad.
+    Paths come back relative to the CURRENT directory (git
+    --relative), matching the analyzer's rel-path scheme when run from
+    the repo root like scripts/lint.sh does."""
+    out: Set[str] = set()
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "--relative", ref, "--"],
+            capture_output=True, text=True, timeout=30)
+        if diff.returncode != 0:
+            return None
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    for line in diff.stdout.splitlines():
+        if line.endswith(".py"):
+            out.add(line.strip())
+    if untracked.returncode == 0:
+        for line in untracked.stdout.splitlines():
+            if line.endswith(".py"):
+                out.add(line.strip())
+    return out
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -80,9 +118,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                       file=sys.stderr)
                 return 2
 
+    focus_from = None
+    if args.changed_only:
+        focus_from = git_changed_files(args.changed_only)
+        if focus_from is None:
+            print(f"hvdlint: --changed-only: git diff against "
+                  f"{args.changed_only!r} failed (not a repo, or bad "
+                  f"ref)", file=sys.stderr)
+            return 2
+        print(f"hvdlint: changed-only vs {args.changed_only}: "
+              f"{len(focus_from)} changed python file(s)",
+              file=sys.stderr)
+
     try:
         result = run_analysis(args.paths, select=select,
-                              baseline=baseline)
+                              baseline=baseline,
+                              focus_from=focus_from)
     except ValueError as e:
         print(f"hvdlint: {e}", file=sys.stderr)
         return 2

@@ -1,0 +1,356 @@
+"""Per-function control-flow graphs for hvdlint's path-sensitive rules.
+
+One CFG node per executed statement, with the edge classes the v2
+rules need:
+
+  * branch edges for `if`/`while`/`for`/`match` (including the
+    zero-iteration edge of a loop and no `while True:` exit);
+  * `break`/`continue` routed to the loop exit/head;
+  * exception edges: every node inside a `try` body gets an edge to
+    that try's *dispatch* node, whose arms are the handler bodies plus
+    an unmatched-arm that unwinds (through the `finally`) to the outer
+    dispatch or the raise-exit;
+  * `finally` bodies sit on the normal path once and are CLONED onto
+    every abrupt route (return/raise/break/continue crossing them), so
+    "drained in finally" genuinely covers all exits;
+  * two distinct terminals: EXIT (normal return / fell off the end)
+    and RAISE_EXIT (uncaught propagation) — leak analysis only cares
+    about paths that end in EXIT, because *everything* is abandoned on
+    an uncaught raise.
+
+Nested `def`/`class`/`lambda` bodies are deferred execution and are
+not part of the enclosing function's CFG.
+
+The walkers are approximate where python is dynamic (an exception "at
+any point" is modeled as an edge from every statement of the try body)
+— sound enough for the protocol/leak questions HVD005 asks, and
+documented honestly in the user guide.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set
+
+EXIT = -1
+RAISE_EXIT = -2
+
+
+class CFGNode:
+    __slots__ = ("idx", "stmt", "kind", "succs", "esuccs")
+
+    def __init__(self, idx: int, stmt: ast.AST, kind: str):
+        self.idx = idx
+        self.stmt = stmt
+        self.kind = kind            # stmt|branch|return|raise|break|
+        #                             continue|excdispatch
+        self.succs: List[int] = []  # normal control flow
+        self.esuccs: List[int] = []  # exception edge (to a dispatch)
+
+
+class CFG:
+    def __init__(self, nodes: List[CFGNode],
+                 by_stmt: Dict[int, List[int]]):
+        self.nodes = nodes
+        self._by_stmt = by_stmt
+        self._reach: Dict[int, FrozenSet[int]] = {}
+
+    def nodes_of(self, stmt: ast.AST) -> List[int]:
+        """All CFG nodes for an AST statement (finally bodies are
+        cloned onto abrupt routes, so one stmt may own several)."""
+        return self._by_stmt.get(id(stmt), [])
+
+    def reachable(self, idx: int,
+                  follow_exc: bool = False) -> FrozenSet[int]:
+        """Forward closure from `idx` (terminals included), following
+        normal edges and — optionally — exception edges."""
+        key = idx if not follow_exc else ~idx
+        hit = self._reach.get(key)
+        if hit is not None:
+            return hit
+        seen: Set[int] = set()
+        stack = [idx]
+        while stack:
+            n = stack.pop()
+            if n in seen or n < 0:
+                if n < 0:
+                    seen.add(n)
+                continue
+            seen.add(n)
+            node = self.nodes[n]
+            stack.extend(node.succs)
+            if follow_exc:
+                stack.extend(node.esuccs)
+        seen.discard(idx)
+        out = frozenset(seen)
+        self._reach[key] = out
+        return out
+
+    def exit_reachable_avoiding(self, starts: Iterable[int],
+                                avoid: Set[int]) -> bool:
+        """True when EXIT is reachable from any of `starts` along a
+        path touching no node in `avoid`. Exception edges ARE followed
+        (a swallowed exception that skips the avoid-set is exactly the
+        path this question exists for); RAISE_EXIT does not count —
+        uncaught propagation abandons everything by design."""
+        seen: Set[int] = set()
+        stack = [s for s in starts if s not in avoid]
+        while stack:
+            n = stack.pop()
+            if n == EXIT:
+                return True
+            if n < 0 or n in seen:
+                continue
+            seen.add(n)
+            node = self.nodes[n]
+            for s in node.succs + node.esuccs:
+                if s >= 0 and s in avoid:
+                    continue
+                stack.append(s)
+        return False
+
+
+class _Ctx:
+    """Builder context: enclosing loop, exception dispatch, and the
+    finally bodies an abrupt edge must unwind through."""
+
+    __slots__ = ("loop", "dispatch", "finallies")
+
+    def __init__(self, loop=None, dispatch: Optional[int] = None,
+                 finallies: tuple = ()):
+        self.loop = loop            # _Loop or None
+        self.dispatch = dispatch    # innermost excdispatch idx
+        self.finallies = finallies  # tuple of (finalbody stmt lists)
+
+
+class _Loop:
+    __slots__ = ("head", "break_exits", "final_depth")
+
+    def __init__(self, head: int, final_depth: int):
+        self.head = head
+        self.break_exits: List[int] = []
+        self.final_depth = final_depth
+
+
+class _Builder:
+    def __init__(self):
+        self.nodes: List[CFGNode] = []
+        self.by_stmt: Dict[int, List[int]] = {}
+
+    def node(self, stmt: ast.AST, kind: str,
+             ctx: Optional[_Ctx]) -> CFGNode:
+        n = CFGNode(len(self.nodes), stmt, kind)
+        self.nodes.append(n)
+        self.by_stmt.setdefault(id(stmt), []).append(n.idx)
+        if ctx is not None and ctx.dispatch is not None:
+            n.esuccs.append(ctx.dispatch)
+        return n
+
+    @staticmethod
+    def connect(exits: List[int], target: int, nodes) -> None:
+        for e in exits:
+            nodes[e].succs.append(target)
+
+    def route_abrupt(self, from_idx: int, finallies: tuple,
+                     terminal: Optional[int]) -> List[int]:
+        """Clone the pending finally bodies onto an abrupt route; the
+        returned exits still need connecting when terminal is None."""
+        cur = [from_idx]
+        for fb in reversed(finallies):
+            entry, exits = self.seq(fb, _Ctx())
+            if entry is None:
+                continue
+            self.connect(cur, entry, self.nodes)
+            cur = exits
+        if terminal is not None:
+            self.connect(cur, terminal, self.nodes)
+            return []
+        return cur
+
+    # -- statements ----------------------------------------------------------
+    def seq(self, stmts: List[ast.stmt], ctx: _Ctx):
+        """Returns (entry idx | None, open fall-through exits)."""
+        entry: Optional[int] = None
+        exits: List[int] = []
+        started = False
+        for stmt in stmts:
+            s_entry, s_exits = self.visit(stmt, ctx)
+            if s_entry is None:
+                continue
+            if not started:
+                entry, started = s_entry, True
+            else:
+                self.connect(exits, s_entry, self.nodes)
+            exits = s_exits
+        return entry, exits
+
+    def visit(self, stmt: ast.stmt, ctx: _Ctx):
+        if isinstance(stmt, ast.If):
+            return self.visit_if(stmt, ctx)
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            return self.visit_loop(stmt, ctx)
+        if isinstance(stmt, ast.Try):
+            return self.visit_try(stmt, ctx)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            n = self.node(stmt, "stmt", ctx)
+            entry, exits = self.seq(stmt.body, ctx)
+            if entry is None:
+                return n.idx, [n.idx]
+            n.succs.append(entry)
+            return n.idx, exits
+        if isinstance(stmt, ast.Return):
+            n = self.node(stmt, "return", ctx)
+            self.route_abrupt(n.idx, ctx.finallies, EXIT)
+            return n.idx, []
+        if isinstance(stmt, ast.Raise):
+            n = self.node(stmt, "raise", ctx)
+            if ctx.dispatch is not None:
+                n.succs.append(ctx.dispatch)
+            else:
+                self.route_abrupt(n.idx, ctx.finallies, RAISE_EXIT)
+            return n.idx, []
+        if isinstance(stmt, ast.Break):
+            n = self.node(stmt, "break", ctx)
+            if ctx.loop is not None:
+                pend = ctx.finallies[ctx.loop.final_depth:]
+                ctx.loop.break_exits.extend(
+                    self.route_abrupt(n.idx, pend, None) or [n.idx])
+            return n.idx, []
+        if isinstance(stmt, ast.Continue):
+            n = self.node(stmt, "continue", ctx)
+            if ctx.loop is not None:
+                pend = ctx.finallies[ctx.loop.final_depth:]
+                self.route_abrupt(n.idx, pend, ctx.loop.head)
+            return n.idx, []
+        if hasattr(ast, "Match") and isinstance(stmt, ast.Match):
+            head = self.node(stmt, "branch", ctx)
+            exits: List[int] = []
+            wildcard = False
+            for case in stmt.cases:
+                entry, c_exits = self.seq(case.body, ctx)
+                if entry is not None:
+                    head.succs.append(entry)
+                    exits.extend(c_exits)
+                else:
+                    exits.append(head.idx)
+                if (isinstance(case.pattern, ast.MatchAs)
+                        and case.pattern.pattern is None):
+                    wildcard = True
+            if not wildcard:
+                exits.append(head.idx)
+            return head.idx, exits
+        # everything else (incl. nested def/class: deferred bodies)
+        n = self.node(stmt, "stmt", ctx)
+        return n.idx, [n.idx]
+
+    def visit_if(self, stmt: ast.If, ctx: _Ctx):
+        head = self.node(stmt, "branch", ctx)
+        exits: List[int] = []
+        b_entry, b_exits = self.seq(stmt.body, ctx)
+        if b_entry is not None:
+            head.succs.append(b_entry)
+            exits.extend(b_exits)
+        else:
+            exits.append(head.idx)
+        if stmt.orelse:
+            o_entry, o_exits = self.seq(stmt.orelse, ctx)
+            if o_entry is not None:
+                head.succs.append(o_entry)
+                exits.extend(o_exits)
+            else:
+                exits.append(head.idx)
+        else:
+            exits.append(head.idx)
+        return head.idx, exits
+
+    def visit_loop(self, stmt, ctx: _Ctx):
+        head = self.node(stmt, "branch", ctx)
+        loop = _Loop(head.idx, len(ctx.finallies))
+        body_ctx = _Ctx(loop, ctx.dispatch, ctx.finallies)
+        b_entry, b_exits = self.seq(stmt.body, body_ctx)
+        if b_entry is not None:
+            head.succs.append(b_entry)
+            self.connect(b_exits, head.idx, self.nodes)
+        infinite = (isinstance(stmt, ast.While)
+                    and isinstance(stmt.test, ast.Constant)
+                    and bool(stmt.test.value))
+        exits: List[int] = []
+        normal_exit = [] if infinite else [head.idx]
+        if stmt.orelse:
+            o_entry, o_exits = self.seq(stmt.orelse, ctx)
+            if o_entry is not None:
+                self.connect(normal_exit, o_entry, self.nodes)
+                normal_exit = o_exits
+        exits.extend(normal_exit)
+        exits.extend(loop.break_exits)
+        return head.idx, exits
+
+    def visit_try(self, stmt: ast.Try, ctx: _Ctx):
+        has_final = bool(stmt.finalbody)
+        dispatch = self.node(stmt, "excdispatch", None)
+        inner_fin = (ctx.finallies + (stmt.finalbody,)) if has_final \
+            else ctx.finallies
+        body_ctx = _Ctx(ctx.loop, dispatch.idx, inner_fin)
+        b_entry, b_exits = self.seq(stmt.body, body_ctx)
+        if stmt.orelse:
+            o_ctx = _Ctx(ctx.loop, ctx.dispatch, inner_fin)
+            o_entry, o_exits = self.seq(stmt.orelse, o_ctx)
+            if o_entry is not None:
+                self.connect(b_exits, o_entry, self.nodes)
+                b_exits = o_exits
+        # handlers: exceptions inside them propagate OUTWARD but still
+        # unwind this try's finally
+        normal_exits = list(b_exits)
+        h_ctx = _Ctx(ctx.loop, ctx.dispatch, inner_fin)
+        for handler in stmt.handlers:
+            h_entry, h_exits = self.seq(handler.body, h_ctx)
+            if h_entry is not None:
+                dispatch.succs.append(h_entry)
+                normal_exits.extend(h_exits)
+            else:
+                normal_exits.append(dispatch.idx)
+        # unmatched (or no handlers): unwind through finally, outward
+        unmatched_terminal = (ctx.dispatch if ctx.dispatch is not None
+                              else None)
+        pend = (stmt.finalbody,) if has_final else ()
+        if unmatched_terminal is not None:
+            self.route_abrupt(dispatch.idx, pend, unmatched_terminal)
+        else:
+            self.route_abrupt(dispatch.idx, pend, RAISE_EXIT)
+        # normal path through the finally
+        if has_final:
+            f_entry, f_exits = self.seq(stmt.finalbody, ctx)
+            if f_entry is not None:
+                self.connect(normal_exits, f_entry, self.nodes)
+                normal_exits = f_exits
+        entry = b_entry if b_entry is not None else dispatch.idx
+        return entry, normal_exits
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """CFG of one function (or module) body."""
+    b = _Builder()
+    body = fn.body if isinstance(fn.body, list) else [ast.Expr(fn.body)]
+    _entry, exits = b.seq(body, _Ctx())
+    b.connect(exits, EXIT, b.nodes)
+    return CFG(b.nodes, b.by_stmt)
+
+
+def always_raises(stmts: List[ast.stmt]) -> bool:
+    """Whether a block unconditionally re-raises (the non-swallowing
+    handler shape: `except E: log(); raise`). Process-exit calls count
+    — a crashed rank is *detected* (liveness/elastic), silently
+    diverging from the schedule is not."""
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Raise):
+        return True
+    if isinstance(last, ast.If):
+        return (bool(last.orelse) and always_raises(last.body)
+                and always_raises(last.orelse))
+    if isinstance(last, ast.Expr) and isinstance(last.value, ast.Call):
+        from .model import attr_chain
+        return attr_chain(last.value.func) in (
+            "sys.exit", "os._exit", "exit")
+    return False

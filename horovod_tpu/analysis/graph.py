@@ -1,0 +1,549 @@
+"""Whole-repo call graph + thread-entry-point index for hvdlint.
+
+This is the interprocedural substrate the v2 rules (HVD005 protocol
+consistency, HVD006 lockset races) stand on. It stays inside the
+analyzer's charter: pure AST, never imports the code under analysis,
+deterministic. Resolution is deliberately modest and *documented* —
+precision the rules can reason about beats cleverness they can't:
+
+  * def/use indexing across modules: `import a.b as c` / `from .m
+    import f as g` aliases are followed to project files (relative
+    imports resolved against the importer's package);
+  * method resolution through `self`/`cls` to the enclosing class
+    (plus single-inheritance bases defined in the same module);
+  * module-level singletons (`REGISTRY = MetricsRegistry()`) give
+    `REGISTRY.counter(...)` a one-level type so cross-module method
+    calls on well-known instances resolve;
+  * one level of closure/partial indirection: a local name bound to a
+    nested `def`, a plain function alias, or `functools.partial(f,
+    ...)` resolves to `f` when called or passed as a callback.
+
+Anything else (duck-typed receivers, dict-dispatched callables,
+decorators that swap the function) is unresolved — the honest gap the
+docs advertise.
+
+The thread-entry index records every function the process can enter
+OFF the main thread: `threading.Thread(target=...)` / `Timer(...)`
+targets, `executor.submit(fn, ...)` arguments, and `signal.signal`
+handlers (signal handlers interleave with the main thread between
+bytecodes, which is exactly the reentrancy a lockset cares about).
+`entries(key)` folds these with a main-reachability fixpoint so every
+function carries the set of thread entry points that can reach it.
+
+Graphs are cached keyed on the (rel, content-hash) set of the project
+files, so repeated runs in one process (the tier-1 gate, tests,
+--changed-only pre-commit) never re-index unchanged sources.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .model import Project, SourceFile, attr_chain, call_name
+
+MAIN_ENTRY = "<main>"
+
+# Reachability horizon for entry-point closure; deep enough for any
+# real call chain in this tree, finite so cycles/pathological graphs
+# stay bounded.
+REACH_DEPTH = 64
+# Rounds of the held-at-entry lockset fixpoint (monotone; converges in
+# ~call-chain depth between lock acquisition and field access).
+LOCKSET_ROUNDS = 4
+
+
+def module_of(rel: str) -> str:
+    """Dotted module path of a project-relative file path."""
+    mod = rel[:-3] if rel.endswith(".py") else rel
+    if mod.endswith("/__init__"):
+        mod = mod[: -len("/__init__")]
+    return mod.replace("/", ".")
+
+
+class FuncInfo:
+    """One function/method definition in the project."""
+
+    __slots__ = ("key", "rel", "qual", "node", "cls", "name")
+
+    def __init__(self, key: str, rel: str, qual: str, node: ast.AST,
+                 cls: str):
+        self.key = key          # "rel::qual" — the project-wide id
+        self.rel = rel
+        self.qual = qual
+        self.node = node
+        self.cls = cls          # enclosing class name ("" for plain)
+        self.name = getattr(node, "name", "<lambda>")
+
+
+class CallSite:
+    """One resolved call edge occurrence."""
+
+    __slots__ = ("caller", "callee", "rel", "line")
+
+    def __init__(self, caller: str, callee: str, rel: str, line: int):
+        self.caller = caller    # func key, or "rel::<module>"
+        self.callee = callee
+        self.rel = rel
+        self.line = line
+
+
+class ThreadRoot:
+    """A function the process enters off the main thread."""
+
+    __slots__ = ("key", "kind", "rel", "line")
+
+    def __init__(self, key: str, kind: str, rel: str, line: int):
+        self.key = key
+        self.kind = kind        # "thread" | "executor" | "signal" | "timer"
+        self.rel = rel
+        self.line = line
+
+    @property
+    def label(self) -> str:
+        qual = self.key.split("::", 1)[-1]
+        return f"{self.kind} '{qual}' (registered at {self.rel}:{self.line})"
+
+
+class CallGraph:
+    def __init__(self, project: Project):
+        self.project = project
+        self.funcs: Dict[str, FuncInfo] = {}
+        # caller key -> set of callee keys (direct calls only; thread
+        # targets/callbacks are roots, not edges — a spawn site's held
+        # locks do NOT extend into the spawned body).
+        self.edges: Dict[str, Set[str]] = {}
+        self.callers: Dict[str, Set[str]] = {}
+        self.call_sites: Dict[str, List[CallSite]] = {}
+        self.thread_roots: Dict[str, ThreadRoot] = {}
+        self.module_called: Set[str] = set()   # called at import time
+        self._reach_cache: Dict[str, FrozenSet[str]] = {}
+        self._entries_cache: Optional[Dict[str, FrozenSet[str]]] = None
+        # per-file lookup tables
+        self._toplevel: Dict[str, Dict[str, str]] = {}   # rel -> name -> key
+        self._imports: Dict[str, Dict[str, str]] = {}    # rel -> alias -> dotted
+        self._singletons: Dict[str, Dict[str, str]] = {} # rel -> var -> class
+        self._classes: Dict[str, Dict[str, ast.ClassDef]] = {}
+        self._bases: Dict[Tuple[str, str], List[str]] = {}
+        self._module_by_dotted: Dict[str, str] = {}      # dotted -> rel
+        self._bindings_memo: Dict[Tuple[str, str], Dict[str, str]] = {}
+        self._build()
+
+    # -- indexing ------------------------------------------------------------
+    def _build(self) -> None:
+        for sf in self.project.files:
+            if sf.tree is None:
+                continue
+            self._module_by_dotted[module_of(sf.rel)] = sf.rel
+            self._index_file(sf)
+        for sf in self.project.files:
+            if sf.tree is None:
+                continue
+            self._resolve_file(sf)
+
+    def _index_file(self, sf: SourceFile) -> None:
+        rel = sf.rel
+        top: Dict[str, str] = {}
+        classes: Dict[str, ast.ClassDef] = {}
+        for node, qual in sf.qualname.items():
+            cls = self._enclosing_class_name(sf, node)
+            info = FuncInfo(f"{rel}::{qual}", rel, qual, node, cls)
+            self.funcs[info.key] = info
+            if "." not in qual:
+                top[qual] = info.key
+        for node in sf.tree.body:
+            if isinstance(node, ast.ClassDef):
+                classes[node.name] = node
+                self._bases[(rel, node.name)] = [
+                    b.id for b in node.bases if isinstance(b, ast.Name)]
+        self._toplevel[rel] = top
+        self._classes[rel] = classes
+        self._imports[rel] = self._import_table(sf)
+        self._singletons[rel] = self._singleton_table(sf, classes)
+
+    @staticmethod
+    def _enclosing_class_name(sf: SourceFile, node: ast.AST) -> str:
+        cur = sf.parent.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur.name
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return ""   # nested def: owned by a function, not a class
+            cur = sf.parent.get(cur)
+        return ""
+
+    def _import_table(self, sf: SourceFile) -> Dict[str, str]:
+        """alias -> dotted target ('pkg.mod' or 'pkg.mod.symbol')."""
+        mod = module_of(sf.rel)
+        is_pkg = sf.rel.endswith("/__init__.py")
+        table: Dict[str, str] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    alias = a.asname or a.name.split(".")[0]
+                    target = a.name if a.asname else a.name.split(".")[0]
+                    table[alias] = target
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    parts = mod.split(".")
+                    # one level climbs to the containing package; a
+                    # plain module must first drop its own name
+                    drop = node.level - (1 if is_pkg else 0)
+                    base_parts = parts[: len(parts) - drop]
+                    base = ".".join(base_parts)
+                    if node.module:
+                        base = f"{base}.{node.module}" if base \
+                            else node.module
+                else:
+                    base = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    alias = a.asname or a.name
+                    table[alias] = f"{base}.{a.name}" if base else a.name
+        return table
+
+    @staticmethod
+    def _singleton_table(sf: SourceFile,
+                         classes: Dict[str, ast.ClassDef]
+                         ) -> Dict[str, str]:
+        """Module-level `NAME = ClassName(...)` instances (one level of
+        type knowledge for method resolution on well-known objects)."""
+        out: Dict[str, str] = {}
+        for stmt in sf.tree.body:
+            if (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Call)):
+                cname = call_name(stmt.value)
+                if cname in classes:
+                    out[stmt.targets[0].id] = cname
+        return out
+
+    # -- resolution ----------------------------------------------------------
+    def _dotted_to_key(self, dotted: str) -> Optional[str]:
+        """Resolve 'pkg.mod.symbol' to a function key, trying the
+        longest module prefix that exists in the project."""
+        if dotted in self._module_by_dotted:
+            return None     # a module, not a callable
+        parts = dotted.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            prefix = ".".join(parts[:cut])
+            rel = self._module_by_dotted.get(prefix)
+            if rel is None:
+                continue
+            sym = parts[cut:]
+            top = self._toplevel.get(rel, {})
+            if len(sym) == 1:
+                key = top.get(sym[0])
+                if key:
+                    return key
+                # constructor: pkg.mod.ClassName(...) -> __init__
+                if sym[0] in self._classes.get(rel, {}):
+                    return self._method_key(rel, sym[0], "__init__")
+            elif len(sym) == 2:
+                return self._method_key(rel, sym[0], sym[1])
+            return None
+        return None
+
+    def _method_key(self, rel: str, cls: str,
+                    meth: str) -> Optional[str]:
+        """Class.method in `rel`, following same-module Name bases."""
+        seen: Set[str] = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop(0)
+            if c in seen:
+                continue
+            seen.add(c)
+            key = f"{rel}::{c}.{meth}"
+            if key in self.funcs:
+                return key
+            stack.extend(self._bases.get((rel, c), []))
+        return None
+
+    def _local_bindings(self, sf: SourceFile,
+                        fn: Optional[ast.AST]) -> Dict[str, str]:
+        """name -> dotted/plain target for one level of indirection:
+        `x = f`, `x = functools.partial(f, ...)` inside `fn` (or at
+        module level when fn is None)."""
+        memo_key = (sf.rel, sf.qualname.get(fn, "<module>")
+                    if fn is not None else "<module>")
+        hit = self._bindings_memo.get(memo_key)
+        if hit is not None:
+            return hit
+        body = fn.body if fn is not None else sf.tree.body
+        out: Dict[str, str] = {}
+        for stmt in ast.walk(ast.Module(body=list(body),
+                                        type_ignores=[])):
+            if not isinstance(stmt, ast.Assign):
+                continue
+            if len(stmt.targets) != 1 or not isinstance(
+                    stmt.targets[0], ast.Name):
+                continue
+            value = stmt.value
+            if (isinstance(value, ast.Call)
+                    and attr_chain(value.func).split(".")[-1]
+                    == "partial" and value.args):
+                value = value.args[0]
+            chain = attr_chain(value)
+            if chain:
+                out[stmt.targets[0].id] = chain
+        self._bindings_memo[memo_key] = out
+        return out
+
+    def resolve_func_expr(self, sf: SourceFile,
+                          encl: Optional[ast.AST],
+                          expr: ast.AST) -> Optional[str]:
+        """Resolve an expression denoting a callable (a callback
+        target, or a call's func) to a function key, or None."""
+        if (isinstance(expr, ast.Call)
+                and attr_chain(expr.func).split(".")[-1] == "partial"
+                and expr.args):
+            return self.resolve_func_expr(sf, encl, expr.args[0])
+        chain = attr_chain(expr)
+        if not chain:
+            return None
+        rel = sf.rel
+        parts = chain.split(".")
+        head = parts[0]
+        # self.m / cls.m -> enclosing class method
+        if head in ("self", "cls") and len(parts) == 2:
+            cls = ""
+            cur = expr
+            while cur is not None:
+                if isinstance(cur, ast.ClassDef):
+                    cls = cur.name
+                    break
+                cur = sf.parent.get(cur)
+            if not cls and encl is not None:
+                info = self.funcs.get(
+                    f"{rel}::{sf.qualname.get(encl, '')}")
+                cls = info.cls if info else ""
+            if cls:
+                return self._method_key(rel, cls, parts[1])
+            return None
+        # nested def / local alias / partial binding in the enclosing fn
+        if encl is not None and len(parts) == 1:
+            encl_qual = sf.qualname.get(encl)
+            if encl_qual is not None:
+                nested = f"{rel}::{encl_qual}.{head}"
+                if nested in self.funcs:
+                    return nested
+                bound = self._local_bindings(sf, encl).get(head)
+                if bound and bound != chain:
+                    return self.resolve_func_expr(
+                        sf, encl, ast.parse(bound, mode="eval").body)
+        # same-module top-level function or class constructor
+        if len(parts) == 1:
+            key = self._toplevel.get(rel, {}).get(head)
+            if key:
+                return key
+            if head in self._classes.get(rel, {}):
+                return self._method_key(rel, head, "__init__")
+        # module-level singleton instance: NAME.method(...)
+        if len(parts) == 2 and head in self._singletons.get(rel, {}):
+            return self._method_key(
+                rel, self._singletons[rel][head], parts[1])
+        # imported alias (module or symbol)
+        imp = self._imports.get(rel, {})
+        if head in imp:
+            dotted = imp[head] + ("." + ".".join(parts[1:])
+                                  if len(parts) > 1 else "")
+            return self._dotted_to_key(dotted)
+        return None
+
+    # -- edge construction ---------------------------------------------------
+    _SPAWN_KINDS = {
+        "Thread": ("target", None, "thread"),
+        "Timer": (None, 1, "timer"),
+    }
+
+    def _resolve_file(self, sf: SourceFile) -> None:
+        rel = sf.rel
+        # map every AST node to its innermost enclosing function once
+        encl_of: Dict[ast.AST, Optional[ast.AST]] = {}
+
+        def enclosing(node: ast.AST) -> Optional[ast.AST]:
+            if node in encl_of:
+                return encl_of[node]
+            cur = sf.parent.get(node)
+            while cur is not None and cur not in sf.qualname:
+                cur = sf.parent.get(cur)
+            encl_of[node] = cur
+            return cur
+
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            encl = enclosing(node)
+            caller = (f"{rel}::{sf.qualname[encl]}" if encl is not None
+                      else f"{rel}::<module>")
+            callee = self.resolve_func_expr(sf, encl, node.func)
+            if callee is not None:
+                self.edges.setdefault(caller, set()).add(callee)
+                self.callers.setdefault(callee, set()).add(caller)
+                self.call_sites.setdefault(callee, []).append(
+                    CallSite(caller, callee, rel, node.lineno))
+                if encl is None:
+                    self.module_called.add(callee)
+            self._scan_spawn(sf, encl, node)
+
+    def _scan_spawn(self, sf: SourceFile, encl: Optional[ast.AST],
+                    call: ast.Call) -> None:
+        last = attr_chain(call.func).split(".")[-1] or call_name(call)
+        target_expr: Optional[ast.AST] = None
+        kind = ""
+        if last in ("Thread", "Timer"):
+            kind = "thread" if last == "Thread" else "timer"
+            for kw in call.keywords:
+                if kw.arg == "target":
+                    target_expr = kw.value
+            if target_expr is None and last == "Timer" \
+                    and len(call.args) >= 2:
+                target_expr = call.args[1]
+        elif last == "submit" and call.args:
+            # executor.submit(fn, ...): only counts when the first arg
+            # resolves to a project function (the controller's
+            # core.submit(name, ...) takes a string and never will)
+            kind = "executor"
+            target_expr = call.args[0]
+        elif (attr_chain(call.func) in ("signal.signal",)
+              and len(call.args) >= 2):
+            kind = "signal"
+            target_expr = call.args[1]
+        if target_expr is None or not kind:
+            return
+        key = self.resolve_func_expr(sf, encl, target_expr)
+        if key is None:
+            return
+        existing = self.thread_roots.get(key)
+        site = ThreadRoot(key, kind, sf.rel, call.lineno)
+        if existing is None or (site.rel, site.line) < (existing.rel,
+                                                        existing.line):
+            self.thread_roots[key] = site
+
+    # -- reachability / entries ---------------------------------------------
+    def reach(self, roots: List[str],
+              depth: int = REACH_DEPTH) -> FrozenSet[str]:
+        cache_key = "|".join(sorted(roots))
+        hit = self._reach_cache.get(cache_key)
+        if hit is not None:
+            return hit
+        seen: Set[str] = set(roots)
+        frontier = list(roots)
+        for _ in range(depth):
+            nxt: List[str] = []
+            for k in frontier:
+                for callee in self.edges.get(k, ()):
+                    if callee not in seen:
+                        seen.add(callee)
+                        nxt.append(callee)
+            if not nxt:
+                break
+            frontier = nxt
+        out = frozenset(seen)
+        self._reach_cache[cache_key] = out
+        return out
+
+    def _main_reachable(self) -> FrozenSet[str]:
+        """Functions the main thread can enter: called at import time,
+        or public-surface (no resolved project callers, and not
+        registered as a thread root), closed over call edges."""
+        seeds = set(self.module_called)
+        for key in self.funcs:
+            if key not in self.callers and key not in self.thread_roots:
+                seeds.add(key)
+        return self.reach(sorted(seeds))
+
+    def entries(self, key: str) -> FrozenSet[str]:
+        """Entry points that can reach `key`: MAIN_ENTRY and/or thread
+        root keys."""
+        if self._entries_cache is None:
+            table: Dict[str, Set[str]] = {k: set() for k in self.funcs}
+            for k in self._main_reachable():
+                if k in table:
+                    table[k].add(MAIN_ENTRY)
+            for root in self.thread_roots:
+                for k in self.reach([root]):
+                    if k in table:
+                        table[k].add(root)
+            self._entries_cache = {
+                k: frozenset(v) for k, v in table.items()}
+        return self._entries_cache.get(key, frozenset())
+
+    def entry_label(self, entry: str) -> str:
+        if entry == MAIN_ENTRY:
+            return MAIN_ENTRY
+        root = self.thread_roots.get(entry)
+        return root.label if root else entry
+
+    def propagate_to_callers(self, seeds: Dict[str, str],
+                             depth: int) -> Dict[str, str]:
+        """Close a property over the reverse call graph, bounded by
+        `depth` hops: seeds maps key -> description; callers inherit
+        'via <callee qual>' chained descriptions. Used for 'this
+        function transitively submits collective X'."""
+        out = dict(seeds)
+        frontier = sorted(seeds)
+        for _ in range(depth):
+            nxt: List[str] = []
+            for callee in frontier:
+                desc = out[callee]
+                qual = callee.split("::", 1)[-1]
+                for caller in sorted(self.callers.get(callee, ())):
+                    if caller in out or caller.endswith("::<module>"):
+                        continue
+                    out[caller] = f"via {qual}: {desc}"
+                    nxt.append(caller)
+            if not nxt:
+                break
+            frontier = nxt
+        return out
+
+
+# -- cache -------------------------------------------------------------------
+
+_GRAPH_CACHE: Dict[Tuple, CallGraph] = {}
+_GRAPH_CACHE_MAX = 8
+_STATS = {"hits": 0, "misses": 0}
+
+
+def get_call_graph(project: Project) -> CallGraph:
+    """Project call graph, cached on the (rel, content-hash) set so
+    repeated runs over unchanged sources never re-index."""
+    key = tuple((sf.rel, sf.content_hash) for sf in project.files)
+    g = _GRAPH_CACHE.get(key)
+    if g is not None:
+        _STATS["hits"] += 1
+        return g
+    _STATS["misses"] += 1
+    g = CallGraph(project)
+    if len(_GRAPH_CACHE) >= _GRAPH_CACHE_MAX:
+        _GRAPH_CACHE.pop(next(iter(_GRAPH_CACHE)))
+    _GRAPH_CACHE[key] = g
+    return g
+
+
+def cache_stats() -> Dict[str, int]:
+    return dict(_STATS)
+
+
+def focus_neighbors(project: Project,
+                    changed: Set[str]) -> Set[str]:
+    """`changed` rel paths plus their call-graph neighbors: any file
+    with a resolved call edge into or out of a changed file. This is
+    the --changed-only analysis set — a touched function's callers and
+    callees are where an interprocedural finding can appear or
+    disappear."""
+    g = get_call_graph(project)
+    out = set(changed)
+    for caller, callees in g.edges.items():
+        crel = caller.split("::", 1)[0]
+        for callee in callees:
+            krel = callee.split("::", 1)[0]
+            if crel in changed:
+                out.add(krel)
+            if krel in changed:
+                out.add(crel)
+    return out

@@ -27,7 +27,8 @@ import re
 import tokenize
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
-RULE_IDS = ("HVD001", "HVD002", "HVD003", "HVD004")
+RULE_IDS = ("HVD001", "HVD002", "HVD003", "HVD004", "HVD005",
+            "HVD006")
 
 _SUPPRESS_RE = re.compile(
     r"#\s*hvdlint:\s*(disable|disable-next|disable-file)\s*="
@@ -151,6 +152,10 @@ class SourceFile:
         self.path = path
         self.rel = rel
         self.source = source
+        # Content identity for the parse/call-graph caches: two files
+        # with the same bytes share one parsed representation.
+        self.content_hash = hashlib.sha1(
+            source.encode("utf-8", "replace")).hexdigest()
         self.tree: Optional[ast.Module] = None
         self.error: Optional[str] = None
         try:
@@ -254,8 +259,15 @@ class Project:
     """The full set of files under analysis plus cross-file tables the
     whole-program rules (HVD002/HVD003) need."""
 
-    def __init__(self, files: List[SourceFile]):
+    def __init__(self, files: List[SourceFile],
+                 focus: Optional[Set[str]] = None):
         self.files = sorted(files, key=lambda f: f.rel)
+        # --changed-only: when set, only findings anchored in these
+        # rel paths are reported, and the expensive per-function
+        # passes skip everything else. Cross-file TABLES (registry,
+        # call graph, lock graph) always build from the full set —
+        # neighbors' context is why the full project is parsed at all.
+        self.focus = focus
         self.registry: Optional[KnobRegistry] = None
         self.registry_file: Optional[SourceFile] = None
         for sf in self.files:
@@ -264,6 +276,9 @@ class Project:
                 self.registry = reg
                 self.registry_file = sf
                 break
+
+    def in_focus(self, sf: "SourceFile") -> bool:
+        return self.focus is None or sf.rel in self.focus
 
 
 def _rel(path: str, cwd: str) -> str:
@@ -275,6 +290,19 @@ def _rel(path: str, cwd: str) -> str:
     if r.startswith(".."):
         return ap.replace(os.sep, "/")
     return r.replace(os.sep, "/")
+
+
+# Parsed-module cache: (path, rel) -> (content sha1, SourceFile).
+# SourceFiles are immutable after construction, so a content hit can
+# be shared across Project instances; parsing (not reading) dominates
+# collection time, and the tier-1 gate + --changed-only pre-commit
+# both re-run over a mostly-unchanged tree.
+_SF_CACHE: Dict[Tuple[str, str], Tuple[str, "SourceFile"]] = {}
+_SF_STATS = {"hits": 0, "misses": 0}
+
+
+def cache_stats() -> Dict[str, int]:
+    return dict(_SF_STATS)
 
 
 def collect_files(paths: Iterable[str],
@@ -309,5 +337,16 @@ def collect_files(paths: Iterable[str],
                     src = fh.read()
             except OSError:
                 continue
-            out.append(SourceFile(c, _rel(c, cwd), src))
+            rel = _rel(c, cwd)
+            sha = hashlib.sha1(
+                src.encode("utf-8", "replace")).hexdigest()
+            cached = _SF_CACHE.get((c, rel))
+            if cached is not None and cached[0] == sha:
+                _SF_STATS["hits"] += 1
+                out.append(cached[1])
+                continue
+            _SF_STATS["misses"] += 1
+            sf = SourceFile(c, rel, src)
+            _SF_CACHE[(c, rel)] = (sha, sf)
+            out.append(sf)
     return sorted(out, key=lambda f: f.rel)

@@ -26,12 +26,16 @@ from .spmd import SpmdDivergenceRule        # noqa: E402
 from .registry import RegistryRule          # noqa: E402
 from .locks import LockDisciplineRule       # noqa: E402
 from .trace import TracePurityRule          # noqa: E402
+from .protocol import ProtocolRule          # noqa: E402
+from .lockset import LocksetRule            # noqa: E402
 
 ALL_RULES: List[Type[Rule]] = [
     SpmdDivergenceRule,
     RegistryRule,
     LockDisciplineRule,
     TracePurityRule,
+    ProtocolRule,
+    LocksetRule,
 ]
 
 RULES_BY_ID: Dict[str, Type[Rule]] = {r.id: r for r in ALL_RULES}

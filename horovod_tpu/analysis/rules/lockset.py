@@ -1,0 +1,266 @@
+"""HVD006 — lockset race detection (static Eraser, Savage et al.
+SOSP '97).
+
+For every module-level name and every `self.<attr>` instance field
+written from ≥ 2 distinct thread entry points (analysis/graph.py's
+index: Thread/Timer targets, executor submissions, signal handlers,
+plus the main thread), intersect the locks held at each write. Empty
+intersection on a multi-thread-written field = no lock consistently
+protects it = a report naming both witness sites, their locksets, and
+the entry points that reach them. This is the shift-left for the bug
+class the repo keeps paying for at runtime: the unlocked
+`_bytes_processed` accumulation (PR 1) raced exactly this shape.
+
+Lock identity and recognition are shared with HVD003 (`with <lock>:`
+over lock-named attributes, project-wide `file::Class.attr` ids). On
+top of the lexical lockset, a bounded interprocedural pass adds locks
+held at EVERY resolved call site of the enclosing function (the
+"called with the lock held" convention): a helper only ever invoked
+under `self._lock` keeps `self._lock` in its lockset.
+
+Deliberate exemptions, to keep findings actionable:
+  * writes inside `__init__`/`__post_init__`/`__new__` of the owning
+    class — publication happens-before `Thread.start()`;
+  * read sites — a read-read overlap is not a race, and flagging every
+    unlocked read would bury the write-write witnesses that matter;
+  * fields on receivers other than `self`/`cls` and globals without a
+    `global` declaration — untyped receivers are the documented gap.
+
+GIL-atomic single-store publishes that are *intentionally* unlocked
+take a reasoned inline suppression, same as every benign finding.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from ..graph import LOCKSET_ROUNDS, CallGraph, get_call_graph
+from ..model import Finding, Project, SourceFile
+from . import Rule
+from .locks import _node_id, lock_name
+
+_INIT_NAMES = {"__init__", "__post_init__", "__new__"}
+
+
+class _Write:
+    __slots__ = ("field", "rel", "line", "col", "func_key", "locks",
+                 "context", "in_init")
+
+    def __init__(self, field: str, rel: str, line: int, col: int,
+                 func_key: str, locks: FrozenSet[str], context: str,
+                 in_init: bool):
+        self.field = field
+        self.rel = rel
+        self.line = line
+        self.col = col
+        self.func_key = func_key
+        self.locks = locks
+        self.context = context
+        self.in_init = in_init
+
+
+class _FnWalk:
+    """Lexical walk of one function: field writes and resolved call
+    sites, each with the lock set held at that point."""
+
+    def __init__(self, sf: SourceFile, fn: ast.AST, qual: str,
+                 graph: CallGraph, rule: "LocksetRule"):
+        self.sf = sf
+        self.fn = fn
+        self.qual = qual
+        self.key = f"{sf.rel}::{qual}"
+        self.graph = graph
+        self.rule = rule
+        self.cls = graph.funcs[self.key].cls \
+            if self.key in graph.funcs else ""
+        self.globals: Set[str] = set()
+        self.in_init = (qual.split(".")[-1] in _INIT_NAMES
+                        and bool(self.cls))
+        for stmt in ast.walk(fn):
+            if isinstance(stmt, ast.Global):
+                self.globals.update(stmt.names)
+
+    def walk(self) -> None:
+        self._block(self.fn.body, frozenset())
+
+    def _block(self, stmts: List[ast.stmt],
+               held: FrozenSet[str]) -> None:
+        for stmt in stmts:
+            self._stmt(stmt, held)
+
+    def _stmt(self, stmt: ast.stmt, held: FrozenSet[str]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # deferred execution: its own function/entry
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            new_held = set(held)
+            for item in stmt.items:
+                self._exprs(item.context_expr, frozenset(new_held))
+                ln = lock_name(item.context_expr)
+                if ln:
+                    new_held.add(_node_id(self.sf, stmt, ln))
+            self._block(stmt.body, frozenset(new_held))
+            return
+        if isinstance(stmt, (ast.Assign, ast.AugAssign,
+                             ast.AnnAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for tgt in targets:
+                self._target(tgt, stmt, held)
+            if stmt.value is not None:
+                self._exprs(stmt.value, held)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                self._stmt(child, held)
+            elif isinstance(child, ast.excepthandler):
+                self._block(child.body, held)
+            elif isinstance(child, ast.expr):
+                self._exprs(child, held)
+
+    def _field_of(self, tgt: ast.AST) -> Optional[Tuple[ast.AST, str]]:
+        """(anchor, field id) for a write target we can attribute."""
+        node = tgt
+        if isinstance(node, ast.Subscript):
+            node = node.value     # self.d[k] = v mutates field d
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id in ("self", "cls"):
+            cls = self.cls
+            if not cls:
+                return None
+            return node, f"{self.sf.rel}::{cls}.{node.attr}"
+        if isinstance(node, ast.Name) and node.id in self.globals:
+            return node, f"{self.sf.rel}::{node.id}"
+        return None
+
+    def _target(self, tgt: ast.AST, stmt: ast.stmt,
+                held: FrozenSet[str]) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._target(elt, stmt, held)
+            return
+        hit = self._field_of(tgt)
+        if hit is None:
+            return
+        anchor, field = hit
+        self.rule.writes.setdefault(field, []).append(_Write(
+            field, self.sf.rel, anchor.lineno, anchor.col_offset + 1,
+            self.key, held, self.sf.context_of(anchor), self.in_init))
+
+    def _exprs(self, expr: ast.AST, held: FrozenSet[str]) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Lambda):
+                continue
+            if isinstance(node, ast.Call):
+                callee = self.graph.resolve_func_expr(
+                    self.sf, self.fn, node.func)
+                if callee is not None:
+                    self.rule.call_locks.setdefault(
+                        callee, []).append((self.key, held))
+
+
+class LocksetRule(Rule):
+    id = "HVD006"
+    summary = ("field written from >=2 thread entry points with an "
+               "empty common lockset (static Eraser)")
+
+    def __init__(self):
+        self.writes: Dict[str, List[_Write]] = {}
+        self.call_locks: Dict[
+            str, List[Tuple[str, FrozenSet[str]]]] = {}
+
+    # -- interprocedural held-at-entry fixpoint ------------------------------
+    def _entry_held(self, graph: CallGraph
+                    ) -> Dict[str, FrozenSet[str]]:
+        """Locks guaranteed held whenever a function is entered: the
+        intersection over all resolved call sites of (lexically held
+        there + locks held at the caller's own entry). Monotone
+        (entry sets only grow), so a few rounds converge. A thread
+        root holds NOTHING at entry regardless of its direct callers —
+        the spawn, not the call, is how the racing thread gets in."""
+        held: Dict[str, FrozenSet[str]] = {}
+        for _ in range(LOCKSET_ROUNDS):
+            changed = False
+            for key, sites in self.call_locks.items():
+                if key in graph.thread_roots:
+                    continue
+                acc: Optional[Set[str]] = None
+                for caller, lex in sites:
+                    s = set(lex) | set(
+                        held.get(caller, frozenset()))
+                    acc = s if acc is None else (acc & s)
+                new = frozenset(acc or ())
+                if held.get(key, frozenset()) != new:
+                    held[key] = new
+                    changed = True
+            if not changed:
+                break
+        return held
+
+    def run(self, project: Project) -> List[Finding]:
+        self.writes = {}
+        self.call_locks = {}
+        graph = get_call_graph(project)
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            for fn, qual in sf.qualname.items():
+                _FnWalk(sf, fn, qual, graph, self).walk()
+        entry_held = self._entry_held(graph)
+        findings: List[Finding] = []
+        focus = project.focus
+        for field in sorted(self.writes):
+            writes = [w for w in self.writes[field] if not w.in_init]
+            if not writes:
+                continue
+            writes.sort(key=lambda w: (w.rel, w.line, w.col))
+            entries_of = [graph.entries(w.func_key) for w in writes]
+            all_entries = frozenset().union(*entries_of)
+            if len(all_entries) < 2:
+                continue
+            common: Optional[Set[str]] = None
+            for w in writes:
+                eff = set(w.locks) | set(
+                    entry_held.get(w.func_key, frozenset()))
+                common = eff if common is None else (common & eff)
+            if common:
+                continue
+            w1 = writes[0]
+            w2 = next((w for w, e in zip(writes, entries_of)
+                       if e != entries_of[0]), w1)
+            if focus is not None and w1.rel not in focus:
+                if w2.rel not in focus:
+                    continue
+                # --changed-only: anchor at the witness inside the
+                # changed set, or the generic anchor-path filter would
+                # silently drop a race the pre-commit change just
+                # introduced (the unchanged witness stays named in the
+                # message).
+                w1, w2 = w2, w1
+            short = field.split("::", 1)[-1]
+            labels = sorted(graph.entry_label(e)
+                            for e in all_entries)
+            shown = ", ".join(labels[:3]) + (
+                f" (+{len(labels) - 3} more)" if len(labels) > 3
+                else "")
+
+            def _locks(w: _Write) -> str:
+                eff = sorted(set(w.locks) | set(
+                    entry_held.get(w.func_key, frozenset())))
+                return ("holding " + ", ".join(
+                    lk.split("::", 1)[-1] for lk in eff)
+                    if eff else "holding no lock")
+            second = ("" if w2 is w1 else
+                      f"; also written at {w2.rel}:{w2.line} "
+                      f"({_locks(w2)})")
+            findings.append(Finding(
+                self.id, w1.rel, w1.line, w1.col,
+                f"field '{short}' is written from {len(all_entries)} "
+                f"thread entry points [{shown}] with an empty common "
+                f"lockset: write here {_locks(w1)}{second} — no lock "
+                f"consistently protects this field (Eraser lockset)",
+                w1.context))
+        findings.sort(key=Finding.sort_key)
+        return findings

@@ -1,0 +1,502 @@
+"""HVD005 — collective-protocol consistency: path-divergent schedules
+and abandoned async handles.
+
+The controller invariant (SURVEY §2.1) is that every rank submits the
+same collectives in the same order; HVD001 catches *rank-conditional*
+submissions, but the gang deadlocks just as hard when the divergence
+comes from a *path* only some ranks take — an exception swallowed on
+one rank, a data-dependent early return, a per-rank break out of a
+collective-bearing loop. This rule walks each function's CFG
+(analysis/dataflow.py) with a bounded interprocedural summary
+(analysis/graph.py: a call into a function that transitively submits a
+collective is itself a submission site) and reports four shapes:
+
+  1. except-arm skip — a collective inside a `try` whose handler (or
+     `contextlib.suppress`) swallows: the rank that hit the exception
+     silently drops out of the schedule mid-protocol while its peers
+     block in negotiation.
+  2. partial protocol — a conditional `return` reachable after one
+     collective has been submitted but before another that the
+     fall-through path still owes; and any conditional `return`/
+     `break` inside a loop that submits collectives (ranks disagreeing
+     on the exit submit different iteration counts — the uneven-
+     batches hazard hvd.join exists for).
+  3. finally-after-try — a collective issued in `finally` after a
+     collective-bearing `try`: on the exception path the try's
+     schedule was cut short but the finally op still runs, so ranks
+     observe reordered/mismatched schedules.
+  4. async-handle leak — a `*_async` submission whose handle can reach
+     function exit on some path with no `synchronize`/`poll` drain
+     (the PR-6 never-synchronized-handle class), including a handle
+     whose result is simply discarded. Returning/storing/passing the
+     handle transfers responsibility to the caller and is not flagged.
+
+Elastic `state.commit()` counts as a schedule point: commit carries
+the coordinated reset/numerics collectives across the gang.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import dataflow
+from ..graph import CallGraph, get_call_graph
+from ..model import Finding, Project, SourceFile, attr_chain, call_name
+from . import Rule
+from .spmd import COLLECTIVES
+
+# Hops a collective summary propagates to callers; call chains deeper
+# than this are invisible (documented in the user guide).
+INTERPROC_DEPTH = 2
+
+# jit-path collective primitives: a trace that diverges across ranks
+# compiles different programs with mismatched channel ids — the same
+# deadlock, reached at compile time.
+JIT_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "psum_scatter", "pshuffle",
+}
+
+PROTOCOL_COLLECTIVES = (COLLECTIVES | JIT_COLLECTIVES) - {
+    # drains, not submissions — the handle-leak detector owns these
+    "synchronize",
+}
+
+
+def _is_commit(call: ast.Call) -> bool:
+    """Elastic `state.commit()` / `self.state.commit()`."""
+    if call_name(call) != "commit":
+        return False
+    chain = attr_chain(call.func)
+    recv = chain.rsplit(".", 2)[-2] if chain.count(".") >= 1 else ""
+    return recv == "state" or recv.endswith("_state")
+
+
+def _direct_site(call: ast.Call) -> Optional[str]:
+    name = call_name(call)
+    if name in PROTOCOL_COLLECTIVES:
+        return name
+    if _is_commit(call):
+        return "commit"
+    return None
+
+
+class _Site:
+    """One schedule-submission point inside a function."""
+
+    __slots__ = ("stmt", "call", "display", "line", "idxs")
+
+    def __init__(self, stmt: ast.AST, call: ast.Call, display: str,
+                 idxs: List[int]):
+        self.stmt = stmt
+        self.call = call
+        self.display = display
+        self.line = call.lineno
+        self.idxs = idxs
+
+
+def owned_exprs(stmt: ast.AST) -> List[ast.AST]:
+    """The expressions a compound statement itself evaluates (its
+    child statements own their own CFG nodes)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter, stmt.target]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        out: List[ast.AST] = []
+        for item in stmt.items:
+            out.append(item.context_expr)
+            if item.optional_vars is not None:
+                out.append(item.optional_vars)
+        return out
+    if isinstance(stmt, ast.Return):
+        return [stmt.value] if stmt.value is not None else []
+    if isinstance(stmt, ast.Raise):
+        return [e for e in (stmt.exc, stmt.cause) if e is not None]
+    if isinstance(stmt, ast.Try):
+        return []
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return []   # deferred body
+    return [stmt]
+
+
+def _calls_in(exprs: List[ast.AST]) -> List[ast.Call]:
+    """Calls in the given expressions, lambdas excluded (deferred)."""
+    out: List[ast.Call] = []
+    stack = list(exprs)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Lambda):
+            continue
+        if isinstance(node, ast.Call):
+            out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _own_stmts(fn: ast.AST) -> List[ast.stmt]:
+    """Every statement executed by `fn` itself (nested def/class
+    bodies excluded)."""
+    out: List[ast.stmt] = []
+    stack = list(fn.body)
+    while stack:
+        stmt = stack.pop(0)
+        out.append(stmt)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.stmt):
+                stack.append(child)
+            elif isinstance(child, ast.excepthandler):
+                stack.extend(child.body)
+    return out
+
+
+class ProtocolRule(Rule):
+    id = "HVD005"
+    summary = ("collective reachable on some paths but not others "
+               "(except-arm skip, partial protocol, finally "
+               "reordering) or async handle never drained")
+
+    def __init__(self):
+        self.findings: List[Finding] = []
+        self._seen_anchor: Set[Tuple[str, int, int]] = set()
+
+    def report(self, sf: SourceFile, node: ast.AST,
+               message: str) -> None:
+        anchor = (sf.rel, node.lineno, node.col_offset + 1)
+        if anchor in self._seen_anchor:
+            return
+        self._seen_anchor.add(anchor)
+        self.findings.append(Finding(
+            self.id, sf.rel, node.lineno, node.col_offset + 1,
+            message, sf.context_of(node)))
+
+    # -- interprocedural summary --------------------------------------------
+    @staticmethod
+    def _summaries(project: Project,
+                   graph: CallGraph) -> Dict[str, str]:
+        """func key -> collective name it (transitively) submits."""
+        seeds: Dict[str, str] = {}
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            for fn, qual in sf.qualname.items():
+                for stmt in _own_stmts(fn):
+                    hit = None
+                    for call in _calls_in(owned_exprs(stmt)):
+                        d = _direct_site(call)
+                        if d:
+                            hit = d
+                            break
+                    if hit:
+                        seeds[f"{sf.rel}::{qual}"] = hit
+                        break
+        return graph.propagate_to_callers(seeds, INTERPROC_DEPTH)
+
+    # -- site collection -----------------------------------------------------
+    def _sites(self, sf: SourceFile, fn: ast.AST,
+               cfg: dataflow.CFG, graph: CallGraph,
+               summaries: Dict[str, str]) -> List[_Site]:
+        sites: List[_Site] = []
+        for stmt in _own_stmts(fn):
+            for call in _calls_in(owned_exprs(stmt)):
+                d = _direct_site(call)
+                display = None
+                if d:
+                    display = d
+                else:
+                    callee = graph.resolve_func_expr(sf, fn, call.func)
+                    if callee is not None and callee in summaries:
+                        coll = summaries[callee].rsplit(": ", 1)[-1]
+                        cn = call_name(call) or "<call>"
+                        display = f"{cn} [submits {coll}]"
+                if display is not None:
+                    sites.append(_Site(stmt, call, display,
+                                       cfg.nodes_of(stmt)))
+        sites.sort(key=lambda s: (s.line, s.call.col_offset))
+        return sites
+
+    # -- detectors -----------------------------------------------------------
+    def _check_except_swallow(self, sf: SourceFile, fn: ast.AST,
+                              sites: List[_Site]) -> None:
+        """Shapes 1 and 3: try/except swallow and finally-after-try."""
+        tries = [s for s in _own_stmts(fn) if isinstance(s, ast.Try)]
+        # innermost-try attribution: a site inside a nested try is that
+        # try's problem, not every enclosing one's
+        def innermost_try(node: ast.AST) -> Optional[ast.Try]:
+            cur = sf.parent.get(node)
+            while cur is not None and cur is not fn:
+                if isinstance(cur, ast.Try):
+                    return cur
+                if isinstance(cur, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    return None
+                cur = sf.parent.get(cur)
+            return None
+
+        def in_block(node: ast.AST, block: List[ast.stmt]) -> bool:
+            cur = node
+            block_ids = {id(s) for s in block}
+            while cur is not None and cur is not fn:
+                if id(cur) in block_ids:
+                    return True
+                cur = sf.parent.get(cur)
+            return False
+
+        for t in tries:
+            body_sites = [s for s in sites
+                          if innermost_try(s.call) is t
+                          and in_block(s.call, t.body)]
+            fin_sites = [s for s in sites
+                         if in_block(s.call, t.finalbody)]
+            swallowers = [h for h in t.handlers
+                          if not dataflow.always_raises(h.body)]
+            if body_sites and swallowers:
+                h = swallowers[0]
+                exc = (attr_chain(h.type) if h.type is not None
+                       else "BaseException")
+                self.report(
+                    sf, body_sites[0].call,
+                    f"collective '{body_sites[0].display}()' can be "
+                    f"skipped when '{exc}' is swallowed by the except "
+                    f"arm at line {h.lineno}: a rank taking the "
+                    f"exception path drops out of the gang schedule "
+                    f"mid-protocol while its peers block in "
+                    f"negotiation")
+            if fin_sites and body_sites:
+                self.report(
+                    sf, fin_sites[0].call,
+                    f"collective '{fin_sites[0].display}()' in a "
+                    f"finally block still runs when the try body's "
+                    f"'{body_sites[0].display}()' (line "
+                    f"{body_sites[0].line}) was cut short by an "
+                    f"exception — ranks observe reordered/mismatched "
+                    f"schedules")
+        # contextlib.suppress is an except-arm in a trenchcoat
+        for stmt in _own_stmts(fn):
+            if not isinstance(stmt, (ast.With, ast.AsyncWith)):
+                continue
+            sup = None
+            for item in stmt.items:
+                ce = item.context_expr
+                if isinstance(ce, ast.Call) and \
+                        call_name(ce) == "suppress":
+                    sup = ce
+            if sup is None:
+                continue
+            with_sites = [s for s in sites
+                          if in_block(s.call, stmt.body)]
+            if with_sites:
+                self.report(
+                    sf, with_sites[0].call,
+                    f"collective '{with_sites[0].display}()' inside "
+                    f"contextlib.suppress at line {stmt.lineno}: a "
+                    f"rank whose body raises silently skips the "
+                    f"collective the rest of the gang submitted")
+
+    def _check_early_exits(self, sf: SourceFile, fn: ast.AST,
+                           cfg: dataflow.CFG,
+                           sites: List[_Site]) -> None:
+        """Shape 2, straight-line half: a conditional return between
+        collectives."""
+        if not sites:
+            return
+        site_reach = {}
+        for s in sites:
+            acc: Set[int] = set()
+            for idx in s.idxs:
+                acc |= cfg.reachable(idx)
+            site_reach[id(s)] = acc
+        for node in cfg.nodes:
+            if node.kind != "return":
+                continue
+            before = [s for s in sites
+                      if node.idx in site_reach[id(s)]
+                      or node.stmt is s.stmt]
+            if not before:
+                continue
+            ret_reach = cfg.reachable(node.idx)
+            skipped = [s for s in sites
+                       if s not in before
+                       and not any(i in ret_reach for i in s.idxs)]
+            if not skipped:
+                continue
+            prev = before[-1]
+            nxt = skipped[0]
+            self.report(
+                sf, node.stmt,
+                f"conditional return skips collective "
+                f"'{nxt.display}()' (line {nxt.line}) after "
+                f"'{prev.display}()' (line {prev.line}) was already "
+                f"submitted on this path — ranks taking this exit "
+                f"leave the gang with a partial schedule")
+
+    def _check_loop_exits(self, sf: SourceFile, fn: ast.AST,
+                          sites: List[_Site]) -> None:
+        """Shape 2, loop half: conditional return/break inside a
+        collective-bearing loop."""
+        site_by_stmt = {}
+        for s in sites:
+            site_by_stmt.setdefault(id(s.stmt), s)
+        own = _own_stmts(fn)
+        loops = [s for s in own
+                 if isinstance(s, (ast.For, ast.AsyncFor, ast.While))]
+
+        def conditional_within(node: ast.AST, loop: ast.AST) -> bool:
+            cur = sf.parent.get(node)
+            while cur is not None and cur is not loop:
+                if isinstance(cur, (ast.If, ast.Try, ast.Match)):
+                    return True
+                cur = sf.parent.get(cur)
+            return False
+
+        for loop in loops:
+            loop_sites: List[_Site] = []
+            exits: List[Tuple[ast.stmt, str]] = []
+            stack: List[Tuple[ast.AST, bool]] = [(s, True)
+                                                 for s in loop.body]
+            while stack:
+                node, owns_break = stack.pop(0)
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                if id(node) in site_by_stmt:
+                    loop_sites.append(site_by_stmt[id(node)])
+                if isinstance(node, ast.Break) and owns_break:
+                    exits.append((node, "break"))
+                elif isinstance(node, ast.Return):
+                    exits.append((node, "return"))
+                inner_loop = isinstance(node, (ast.For, ast.AsyncFor,
+                                               ast.While))
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, ast.stmt):
+                        stack.append((child,
+                                      owns_break and not inner_loop))
+                    elif isinstance(child, ast.excepthandler):
+                        stack.extend((s, owns_break)
+                                     for s in child.body)
+            if not loop_sites:
+                continue
+            loop_sites.sort(key=lambda s: s.line)
+            for stmt, kind in exits:
+                if not conditional_within(stmt, loop):
+                    continue
+                self.report(
+                    sf, stmt,
+                    f"conditional {kind} exits a loop that submits "
+                    f"collective '{loop_sites[0].display}()' (line "
+                    f"{loop_sites[0].line}): ranks disagreeing on the "
+                    f"exit condition submit different iteration "
+                    f"counts and the gang deadlocks on the next "
+                    f"negotiation")
+
+    def _check_handle_leaks(self, sf: SourceFile, fn: ast.AST,
+                            cfg: dataflow.CFG) -> None:
+        """Shape 4: *_async handles that can die undrained."""
+        own = _own_stmts(fn)
+        for stmt in own:
+            # discarded result: `allreduce_async(x)` as a bare stmt
+            if isinstance(stmt, ast.Expr) \
+                    and isinstance(stmt.value, ast.Call):
+                name = call_name(stmt.value)
+                if name.endswith("_async"):
+                    self.report(
+                        sf, stmt.value,
+                        f"result of '{name}()' is discarded: the "
+                        f"async handle can never be synchronized and "
+                        f"the op is never drained (handle leak)")
+            if not (isinstance(stmt, ast.Assign)
+                    and len(stmt.targets) == 1
+                    and isinstance(stmt.targets[0], ast.Name)
+                    and isinstance(stmt.value, ast.Call)):
+                continue
+            name = call_name(stmt.value)
+            if not name.endswith("_async"):
+                continue
+            var = stmt.targets[0].id
+            mention: Set[int] = set()
+            rebind_sinks: Set[int] = set()
+            for other in own:
+                if other is stmt:
+                    continue
+                if (isinstance(other, ast.Assign)
+                        and any(isinstance(t, ast.Name) and t.id == var
+                                for t in other.targets)):
+                    uses_in_value = any(
+                        isinstance(n, ast.Name) and n.id == var
+                        for n in ast.walk(other.value))
+                    if uses_in_value:
+                        mention.update(cfg.nodes_of(other))
+                    else:
+                        rebind_sinks.update(cfg.nodes_of(other))
+                    continue
+                region = (list(ast.walk(other))
+                          if isinstance(other, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef,
+                                                ast.ClassDef))
+                          else [n for e in owned_exprs(other)
+                                for n in ast.walk(e)])
+                if any(isinstance(n, ast.Name) and n.id == var
+                       for n in region):
+                    mention.update(cfg.nodes_of(other))
+            starts: List[int] = []
+            for idx in cfg.nodes_of(stmt):
+                starts.extend(cfg.nodes[idx].succs)
+            leak = cfg.exit_reachable_avoiding(
+                starts, mention | rebind_sinks)
+            if not leak:
+                # a rebind reached before any mention abandons the
+                # previous handle just like a function exit would
+                leak = any(
+                    self._sink_reachable(cfg, starts, mention, snk)
+                    for snk in rebind_sinks)
+            if leak:
+                self.report(
+                    sf, stmt.value,
+                    f"async handle '{var}' from '{name}()' can reach "
+                    f"function exit without a synchronize()/poll() "
+                    f"drain on some path — the collective is never "
+                    f"awaited (handle leak)")
+
+    @staticmethod
+    def _sink_reachable(cfg: dataflow.CFG, starts: List[int],
+                        avoid: Set[int], sink: int) -> bool:
+        """A rebind reached before any mention abandons the previous
+        handle just like a function exit does."""
+        seen: Set[int] = set()
+        stack = [s for s in starts if s not in avoid]
+        while stack:
+            n = stack.pop()
+            if n == sink:
+                return True
+            if n < 0 or n in seen or n in avoid:
+                continue
+            seen.add(n)
+            node = cfg.nodes[n]
+            stack.extend(node.succs + node.esuccs)
+        return False
+
+    # -- entry ---------------------------------------------------------------
+    def run(self, project: Project) -> List[Finding]:
+        self.findings = []
+        self._seen_anchor = set()
+        graph = get_call_graph(project)
+        summaries = self._summaries(project, graph)
+        for sf in project.files:
+            if sf.tree is None or not project.in_focus(sf):
+                continue
+            for fn in sorted(sf.qualname, key=lambda n: n.lineno):
+                cfg = dataflow.build_cfg(fn)
+                sites = self._sites(sf, fn, cfg, graph, summaries)
+                if sites:
+                    self._check_except_swallow(sf, fn, sites)
+                    self._check_early_exits(sf, fn, cfg, sites)
+                    self._check_loop_exits(sf, fn, sites)
+                self._check_handle_leaks(sf, fn, cfg)
+        self.findings.sort(key=Finding.sort_key)
+        return self.findings

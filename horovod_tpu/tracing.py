@@ -406,8 +406,21 @@ class ClockCalibrator:
         self._probes = int(probes)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        self.offset_ns: Optional[int] = None
-        self.rtt_ns: Optional[int] = None
+        # (offset_ns, rtt_ns) published as ONE tuple: the re-sync
+        # thread and the main thread both write, and the pair is only
+        # meaningful together (min-RTT pairing) — two separate stores
+        # could hand a reader a new offset against a stale rtt.
+        self._calibration: Optional[Tuple[int, int]] = None
+
+    @property
+    def offset_ns(self) -> Optional[int]:
+        cal = self._calibration
+        return cal[0] if cal is not None else None
+
+    @property
+    def rtt_ns(self) -> Optional[int]:
+        cal = self._calibration
+        return cal[1] if cal is not None else None
 
     def _probe(self) -> int:
         reply = self._cli.request({"type": "time"}, retries=2)
@@ -419,7 +432,9 @@ class ClockCalibrator:
         except Exception as e:  # noqa: BLE001 — observability only
             hlog.debug("tracing: clock calibration failed: %s", e)
             return False
-        self.offset_ns, self.rtt_ns = off, rtt
+        # hvdlint: disable-next=HVD006 (single GIL-atomic store of an
+        # immutable tuple: readers always see a consistent pair)
+        self._calibration = (off, rtt)
         tl = self._timeline
         if tl is not None:
             tl.clock_sync(off, rtt)
@@ -706,17 +721,32 @@ def on_init(cfg, state) -> None:
     observability failures warn, never raise."""
     global _cfg
     _cfg = cfg
+    # Local wiring first, in its OWN guard: a per-rank failure here
+    # (ring resize, signal handler on a non-main thread) must not
+    # skip the clock-sync broadcast below — every other rank enters
+    # that broadcast unconditionally, so skipping it on one rank
+    # would hang their init (hvdlint HVD005 found the original
+    # single-try shape).
     try:
         _align_seq_epoch()
         if cfg.trace_ring_size != _ring_size:
             configure_ring(cfg.trace_ring_size)
         if cfg.trace_sigusr2:
             install_signal_handler()
-        if cfg.timeline_path and state.topology.size > 1:
-            _start_clock_sync(cfg, state.topology, state.timeline)
     except Exception as e:  # noqa: BLE001 — observability only
-        hlog.warning("tracing: init wiring failed (%s); continuing "
-                     "without clock calibration", e)
+        hlog.warning("tracing: init wiring failed (%s); continuing",
+                     e)
+    if cfg.timeline_path and state.topology.size > 1:
+        try:
+            # hvdlint: disable-next=HVD005 (rank-0 pre-broadcast
+            # failures are handled inside _start_clock_sync so every
+            # rank still reaches the broadcast; a failure of the
+            # broadcast itself is a control-plane error surfaced by
+            # wire timeouts on the peers, not a silent hang)
+            _start_clock_sync(cfg, state.topology, state.timeline)
+        except Exception as e:  # noqa: BLE001 — observability only
+            hlog.warning("tracing: clock calibration unavailable "
+                         "(%s); traces will merge uncalibrated", e)
 
 
 def rebind_timeline(timeline) -> None:

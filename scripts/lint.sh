@@ -1,11 +1,24 @@
 #!/usr/bin/env bash
 # Repo lint gate: ruff (style/pyflakes) + hvdlint (framework
 # invariants: SPMD divergence, knob registry, lock discipline, trace
-# purity) + the native core's -Werror compile check. Exit nonzero on
-# any finding — this is the CI entry point; tests/test_lint.py runs
-# the hvdlint half in-process as part of tier-1.
+# purity, collective-protocol consistency, lockset races) + the
+# native core's -Werror compile check. Exit nonzero on any finding —
+# this is the CI entry point; tests/test_lint.py runs the hvdlint
+# half in-process as part of tier-1.
+#
+# Pre-commit fast path: `scripts/lint.sh --changed-only [REF]` makes
+# hvdlint analyze only the files touched since REF (default HEAD)
+# plus their call-graph neighbors. CI runs the full pass (no args).
 set -u
 cd "$(dirname "$0")/.."
+
+HVDLINT_ARGS=()
+if [ "${1:-}" = "--changed-only" ]; then
+    HVDLINT_ARGS+=(--changed-only)
+    if [ -n "${2:-}" ]; then
+        HVDLINT_ARGS+=("$2")
+    fi
+fi
 
 rc=0
 
@@ -20,7 +33,8 @@ fi
 
 echo "== hvdlint =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-    python -m horovod_tpu.analysis horovod_tpu/ || rc=1
+    python -m horovod_tpu.analysis horovod_tpu/ \
+    ${HVDLINT_ARGS[@]+"${HVDLINT_ARGS[@]}"} || rc=1
 
 echo "== cc check (-Wall -Wextra -Werror) =="
 if command -v "${CXX:-g++}" >/dev/null 2>&1; then

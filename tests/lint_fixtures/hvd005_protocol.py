@@ -1,0 +1,149 @@
+"""HVD005 fixture: path-divergent collective schedules and async
+handle leaks — seeded positives (EXPECT-anchored) and negatives."""
+
+import contextlib
+
+import horovod_tpu as hvd
+from horovod_tpu.ops import collective_ops
+from jax import lax
+
+
+# -- positives --------------------------------------------------------------
+
+def except_arm_skip(x):
+    try:
+        x = preprocess(x)
+        x = hvd.allreduce(x)  # EXPECT: HVD005
+    except ValueError:
+        log("bad batch")
+    return x
+
+
+def suppress_is_an_except_arm(x):
+    with contextlib.suppress(KeyError):
+        x = hvd.allreduce(x)  # EXPECT: HVD005
+    return x
+
+
+def early_return_between_psums(x, flag):
+    y = lax.psum(x, "data")
+    if flag:
+        return y  # EXPECT: HVD005
+    return lax.psum(y * y, "data")
+
+
+def conditional_break_in_collective_loop(tensors):
+    out = []
+    for t in tensors:
+        if t is None:
+            break  # EXPECT: HVD005
+        out.append(hvd.allreduce(t))
+    return out
+
+
+def finally_reorders_schedule(x):
+    try:
+        x = hvd.allreduce(x)
+    finally:
+        hvd.barrier()  # EXPECT: HVD005
+    return x
+
+
+def abandoned_async_handle(x):
+    h = hvd.allreduce_async(x)  # EXPECT: HVD005
+    return x
+
+
+def discarded_async_result(x):
+    hvd.allreduce_async(x)  # EXPECT: HVD005
+    return x
+
+
+def drained_on_one_branch_only(x, fast):
+    h = hvd.allreduce_async(x)  # EXPECT: HVD005
+    if fast:
+        return x
+    return collective_ops.synchronize(h)
+
+
+def _helper_submits(x):
+    return hvd.allreduce(x, name="staged")
+
+
+def interprocedural_partial_protocol(x, flag):
+    x = _helper_submits(x)
+    if flag:
+        return x  # EXPECT: HVD005
+    return _helper_submits(x * 2)
+
+
+# -- negatives: none of these may be reported -------------------------------
+
+def uniform_loop(tensors):
+    out = []
+    for t in tensors:
+        out.append(hvd.allreduce(t))
+    return out
+
+
+def guard_before_any_collective(x, ready):
+    if not ready:
+        return x
+    return hvd.allreduce(x)
+
+
+def handler_reraises(x):
+    try:
+        return hvd.allreduce(x)
+    except ValueError:
+        log("propagating")
+        raise
+
+
+def handle_drained_in_finally(x):
+    h = hvd.allreduce_async(x)
+    try:
+        x = postprocess(x)
+    finally:
+        x = collective_ops.synchronize(h)
+    return x
+
+
+def handle_returned_to_caller(x):
+    h = hvd.allreduce_async(x)
+    return h
+
+
+def handle_stored_for_later(x, pending):
+    h = hvd.allreduce_async(x)
+    pending.append(h)
+    return x
+
+
+def handles_rebound_in_loop_then_drained(tensors):
+    out = []
+    for t in tensors:
+        h = hvd.allreduce_async(t)
+        out.append(collective_ops.synchronize(h))
+    return out
+
+
+def suppressed_with_reason(x, flag):
+    y = lax.psum(x, "data")
+    if flag:
+        # hvdlint: disable-next=HVD005 (fixture: flag is a static
+        # config constant, identical on every rank)
+        return y
+    return lax.psum(y + 1, "data")
+
+
+def preprocess(x):
+    return x
+
+
+def postprocess(x):
+    return x
+
+
+def log(msg):
+    return msg

@@ -1,0 +1,141 @@
+"""HVD006 fixture: lockset races on fields written from >=2 thread
+entry points — seeded positives (EXPECT-anchored) and negatives."""
+
+import signal
+import threading
+
+
+class DisjointLocks:
+    """The classic Eraser shape: both writers lock, but not the SAME
+    lock, so the locks protect nothing."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._io_lock = threading.Lock()
+        self.count = 0
+        self._thread = threading.Thread(target=self._pace,
+                                        daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _pace(self):
+        while True:
+            with self._io_lock:
+                self.count += 1  # EXPECT: HVD006
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+
+
+class UnlockedCounter:
+    """No lock at all on a field the drain thread and callers share."""
+
+    def __init__(self):
+        self.nbytes = 0
+        threading.Thread(target=self._drain, daemon=True).start()
+
+    def _drain(self):
+        while True:
+            self.nbytes += 10  # EXPECT: HVD006
+
+    def add(self, n):
+        self.nbytes += n
+
+
+_signal_flips = 0
+
+
+def _on_usr1(signum, frame):
+    global _signal_flips
+    _signal_flips += 1  # EXPECT: HVD006
+
+
+def install_handler():
+    signal.signal(signal.SIGUSR1, _on_usr1)
+
+
+def record_flip():
+    global _signal_flips
+    _signal_flips += 1
+
+
+# -- negatives: none of these may be reported -------------------------------
+
+class OneLockEverywhere:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.safe = 0
+        threading.Thread(target=self._pace, daemon=True).start()
+
+    def _pace(self):
+        while True:
+            with self._lock:
+                self.safe += 1
+
+    def bump(self):
+        with self._lock:
+            self.safe += 1
+
+
+class LockHeldAtEveryCallSite:
+    """Interprocedural: the helper writes with no lexical lock, but
+    every resolved call site holds the same one."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+        threading.Thread(target=self._pace, daemon=True).start()
+
+    def _pace(self):
+        while True:
+            with self._lock:
+                self._bump_locked()
+
+    def public(self):
+        with self._lock:
+            self._bump_locked()
+
+    def _bump_locked(self):
+        self.value += 1
+
+
+class InitOnlyThenThread:
+    """__init__ publication happens-before Thread.start(): the loop
+    is then the only writer."""
+
+    def __init__(self):
+        self.state = "ready"
+        self.ticks = 0
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            self.ticks += 1
+
+
+class MainOnly:
+    def __init__(self):
+        self.total = 0
+
+    def add(self, n):
+        self.total += n
+
+    def reset(self):
+        self.total = 0
+
+
+class SuppressedPublish:
+    def __init__(self):
+        self.flag = False
+        threading.Thread(target=self._watch, daemon=True).start()
+
+    def _watch(self):
+        while True:
+            # hvdlint: disable-next=HVD006 (fixture: GIL-atomic bool
+            # publish, single store, benign by design)
+            self.flag = True
+
+    def arm(self):
+        self.flag = False

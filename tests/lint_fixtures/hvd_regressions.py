@@ -1,0 +1,64 @@
+"""Historical-bug regression corpus: the three defects this repo
+actually shipped and later fixed, reconstructed in miniature, each
+asserting the analyzer would now catch it at lint time.
+
+  * PR 1 — the unlocked `_bytes_processed` accumulation raced between
+    the caller thread and the controller's dispatch worker (HVD006).
+  * PR 4 — `subprocess.Popen` spawned while holding `TaskService._lock`
+    serialized every contender behind process startup (HVD003).
+  * PR 6 — torch async handles submitted but never synchronized leaked
+    their engine entries for the life of the session (HVD005).
+"""
+
+import subprocess
+import threading
+
+import horovod_tpu as hvd
+from horovod_tpu.ops import collective_ops
+
+
+class Pr1BytesProcessedRace:
+    """PR 1: `self._bytes_processed += nbytes` from both the inline
+    caller path and the controller's background dispatch worker, no
+    lock — the fix made it a thread-safe Counter."""
+
+    def __init__(self):
+        self._bytes_processed = 0
+        self._worker = threading.Thread(target=self._dispatch_loop,
+                                        daemon=True)
+
+    def _dispatch_loop(self):
+        while True:
+            self._bytes_processed += 1024  # EXPECT: HVD006
+
+    def run_inline(self, nbytes):
+        self._bytes_processed += nbytes
+
+
+class Pr4PopenUnderLock:
+    """PR 4: claim-then-spawn was the fix; the bug held the service
+    lock across the process spawn."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._procs = []
+
+    def spawn(self, cmd):
+        with self._lock:
+            proc = subprocess.Popen(cmd)  # EXPECT: HVD003
+            self._procs.append(proc)
+        return proc
+
+
+class Pr6HandleLeak:
+    """PR 6: handles submitted on the skip_synchronize path were never
+    drained, so their engine entries (and torch meta) lived forever."""
+
+    def __init__(self):
+        self._should_sync = True
+
+    def step(self, grads):
+        h = hvd.grouped_allreduce_async(grads)  # EXPECT: HVD005
+        if self._should_sync:
+            return collective_ops.synchronize(h)
+        return grads

@@ -12,6 +12,7 @@ Layout:
     exit-code contract / config.env_value unit tests.
 """
 
+import ast
 import json
 import os
 import re
@@ -21,8 +22,12 @@ import pytest
 
 from horovod_tpu.analysis import run_analysis
 from horovod_tpu.analysis import baseline as baseline_mod
+from horovod_tpu.analysis import dataflow
+from horovod_tpu.analysis import graph as graph_mod
+from horovod_tpu.analysis import model as model_mod
 from horovod_tpu.analysis.cli import main as cli_main
-from horovod_tpu.analysis.model import Suppressions
+from horovod_tpu.analysis.model import (Project, Suppressions,
+                                        collect_files)
 from horovod_tpu.analysis.report import render_json, render_text
 from horovod_tpu.common import config as hconfig
 
@@ -86,11 +91,12 @@ class TestFixtureCorpus:
     def test_each_rule_has_positives(self):
         result = run_analysis([FIXTURES], cwd=REPO_ROOT)
         rules = {f.rule for f in result.findings}
-        assert rules == {"HVD001", "HVD002", "HVD003", "HVD004"}
+        assert rules == {"HVD001", "HVD002", "HVD003", "HVD004",
+                         "HVD005", "HVD006"}
 
     def test_fixture_suppressions_filtered(self):
         result = run_analysis([FIXTURES], cwd=REPO_ROOT)
-        assert result.suppressed == 4
+        assert result.suppressed == 6
 
 
 class TestDeterminism:
@@ -199,8 +205,196 @@ class TestCli:
     def test_list_rules(self, capsys):
         assert cli_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
-        for rid in ("HVD001", "HVD002", "HVD003", "HVD004"):
+        for rid in ("HVD001", "HVD002", "HVD003", "HVD004",
+                    "HVD005", "HVD006"):
             assert rid in out
+
+
+def _fixture_project():
+    return Project(collect_files([FIXTURES], cwd=REPO_ROOT))
+
+
+class TestCallGraph:
+    """analysis/graph.py: resolution and the thread-entry index, run
+    over the fixture corpus (no synthetic trees: the corpus is the
+    contract)."""
+
+    def test_self_method_resolution_and_thread_roots(self):
+        g = graph_mod.get_call_graph(_fixture_project())
+        rel = "tests/lint_fixtures/hvd006_lockset.py"
+        pace = f"{rel}::DisjointLocks._pace"
+        assert pace in g.funcs
+        assert pace in g.thread_roots
+        assert g.thread_roots[pace].kind == "thread"
+        # signal handlers are entry points too
+        sig = f"{rel}::_on_usr1"
+        assert sig in g.thread_roots
+        assert g.thread_roots[sig].kind == "signal"
+
+    def test_entries_fold_main_and_roots(self):
+        g = graph_mod.get_call_graph(_fixture_project())
+        rel = "tests/lint_fixtures/hvd006_lockset.py"
+        # the pacer body is thread-only; the public method is main-only
+        assert g.entries(f"{rel}::DisjointLocks._pace") == frozenset(
+            {f"{rel}::DisjointLocks._pace"})
+        assert graph_mod.MAIN_ENTRY in g.entries(
+            f"{rel}::DisjointLocks.bump")
+        # a helper called from both sides carries both entries
+        both = g.entries(f"{rel}::LockHeldAtEveryCallSite._bump_locked")
+        assert graph_mod.MAIN_ENTRY in both
+        assert f"{rel}::LockHeldAtEveryCallSite._pace" in both
+
+    def test_cross_module_import_resolution(self):
+        # hvd005 fixture calls collective_ops.synchronize through a
+        # `from horovod_tpu.ops import collective_ops` alias; the
+        # project must include that module for the edge to resolve.
+        proj = Project(collect_files(
+            [FIXTURES, os.path.join(PKG, "ops", "collective_ops.py")],
+            cwd=REPO_ROOT))
+        g = graph_mod.get_call_graph(proj)
+        caller = ("tests/lint_fixtures/hvd005_protocol.py"
+                  "::drained_on_one_branch_only")
+        callees = g.edges.get(caller, set())
+        assert ("horovod_tpu/ops/collective_ops.py::synchronize"
+                in callees)
+
+    def test_propagate_to_callers_is_bounded(self):
+        g = graph_mod.get_call_graph(_fixture_project())
+        rel = "tests/lint_fixtures/hvd005_protocol.py"
+        seeds = {f"{rel}::_helper_submits": "allreduce"}
+        out = g.propagate_to_callers(seeds, depth=2)
+        assert f"{rel}::interprocedural_partial_protocol" in out
+        assert out[f"{rel}::_helper_submits"] == "allreduce"
+
+
+class TestDataflow:
+    """CFG construction invariants the HVD005 detectors lean on."""
+
+    @staticmethod
+    def _fn(src):
+        tree = ast.parse(src)
+        return tree.body[0]
+
+    def test_finally_is_cloned_onto_return_route(self):
+        fn = self._fn(
+            "def f(h):\n"
+            "    try:\n"
+            "        return 1\n"
+            "    finally:\n"
+            "        drain(h)\n")
+        cfg = dataflow.build_cfg(fn)
+        drain_stmt = fn.body[0].finalbody[0]
+        # the finally body exists once on the normal path and once
+        # cloned onto the return route
+        assert len(cfg.nodes_of(drain_stmt)) >= 2
+
+    def test_exit_avoiding_blocks_on_mentions(self):
+        fn = self._fn(
+            "def f(x):\n"
+            "    h = go(x)\n"
+            "    sync(h)\n"
+            "    return x\n")
+        cfg = dataflow.build_cfg(fn)
+        assign, sync, ret = fn.body
+        starts = [s for i in cfg.nodes_of(assign)
+                  for s in cfg.nodes[i].succs]
+        avoid = set(cfg.nodes_of(sync))
+        assert not cfg.exit_reachable_avoiding(starts, avoid)
+        assert cfg.exit_reachable_avoiding(starts, set())
+
+    def test_while_true_has_no_fall_through(self):
+        fn = self._fn(
+            "def f():\n"
+            "    while True:\n"
+            "        step()\n"
+            "    after()\n")
+        cfg = dataflow.build_cfg(fn)
+        after = fn.body[1]
+        # `after()` is unreachable: no edges lead into it
+        targets = {s for n in cfg.nodes for s in n.succs}
+        assert all(i not in targets
+                   for i in cfg.nodes_of(after))
+
+    def test_always_raises(self):
+        h = ast.parse(
+            "try:\n    x()\nexcept E:\n    log()\n    raise\n")
+        handler = h.body[0].handlers[0]
+        assert dataflow.always_raises(handler.body)
+        h2 = ast.parse(
+            "try:\n    x()\nexcept E:\n    log()\n")
+        assert not dataflow.always_raises(h2.body[0].handlers[0].body)
+
+
+class TestHistoricalRegressions:
+    """The three bugs this repo actually shipped (PR 1 race, PR 4
+    Popen-under-lock, PR 6 handle leak) reconstructed in
+    tests/lint_fixtures/hvd_regressions.py must each be caught."""
+
+    def test_all_three_are_flagged(self):
+        result = run_analysis([FIXTURES], cwd=REPO_ROOT)
+        rel = "tests/lint_fixtures/hvd_regressions.py"
+        got = {(f.rule, f.context) for f in result.findings
+               if f.path == rel}
+        assert ("HVD006",
+                "Pr1BytesProcessedRace._dispatch_loop") in got
+        assert ("HVD003", "Pr4PopenUnderLock.spawn") in got
+        assert ("HVD005", "Pr6HandleLeak.step") in got
+
+
+class TestChangedOnly:
+    def test_focus_restricts_findings_to_neighbors(self):
+        changed = {"tests/lint_fixtures/hvd006_lockset.py"}
+        result = run_analysis([FIXTURES], cwd=REPO_ROOT,
+                              focus_from=changed)
+        assert result.findings  # the lockset positives survive
+        assert {f.path for f in result.findings} <= {
+            "tests/lint_fixtures/hvd006_lockset.py"}
+        full = run_analysis([FIXTURES], cwd=REPO_ROOT)
+        assert len(result.findings) < len(full.findings)
+
+    def test_neighbors_include_callees(self):
+        proj = _fixture_project()
+        out = graph_mod.focus_neighbors(
+            proj, {"tests/lint_fixtures/hvd005_protocol.py"})
+        assert "tests/lint_fixtures/hvd005_protocol.py" in out
+        # hvd006 fixture has no call edges to hvd005: not a neighbor
+        assert "tests/lint_fixtures/hvd006_lockset.py" not in out
+
+    def test_empty_changed_set_reports_nothing(self):
+        result = run_analysis([FIXTURES], cwd=REPO_ROOT,
+                              focus_from=set())
+        assert result.findings == []
+        assert result.file_count > 0
+
+
+class TestOverheadGuard:
+    """The interprocedural pass must not make the gate the slow step:
+    parsed modules and call graphs are cached on content hashes, so a
+    re-run over an unchanged tree re-parses nothing."""
+
+    def test_second_run_is_all_cache_hits(self):
+        run_analysis([FIXTURES], cwd=REPO_ROOT)  # warm
+        before = model_mod.cache_stats()
+        g_before = graph_mod.cache_stats()
+        result = run_analysis([FIXTURES], cwd=REPO_ROOT)
+        after = model_mod.cache_stats()
+        g_after = graph_mod.cache_stats()
+        assert after["misses"] == before["misses"], \
+            "unchanged sources were re-parsed"
+        assert after["hits"] >= before["hits"] + result.file_count
+        assert g_after["misses"] == g_before["misses"], \
+            "unchanged project re-indexed its call graph"
+
+    def test_repo_gate_budget_with_interprocedural_pass(self):
+        # cold-ish path is covered by TestRepoGate's <10 s assert;
+        # the warm path must be far cheaper than the budget
+        run_analysis([PKG], cwd=REPO_ROOT)  # warm
+        t0 = time.perf_counter()
+        result = run_analysis([PKG], cwd=REPO_ROOT)
+        elapsed = time.perf_counter() - t0
+        assert result.file_count > 0
+        assert elapsed < 5.0, (
+            f"warm interprocedural run took {elapsed:.1f}s")
 
 
 class TestEnvValue:
