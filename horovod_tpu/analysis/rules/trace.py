@@ -40,6 +40,18 @@ _WALLCLOCK = {
     "datetime.datetime.utcnow",
 }
 
+# Profiler-session mutations (profiling.py capture entry points):
+# starting/stopping a jax.profiler capture inside a traced function
+# opens the session ONCE at trace time — the capture window never
+# tracks execution again, and a with-block form leaks an open
+# session into every replay. Wrap the step LOOP, never the step.
+_PROFILER_CHAINS = {
+    "jax.profiler.trace", "jax.profiler.start_trace",
+    "jax.profiler.stop_trace", "jax.profiler.start_server",
+    "profiler.trace", "profiler.start_trace", "profiler.stop_trace",
+    "profiling.capture",
+}
+
 
 def _jit_decorated(fn: ast.AST) -> bool:
     for dec in fn.decorator_list:
@@ -106,6 +118,9 @@ def _side_effect(node: ast.AST) -> str:
     chain = attr_chain(node.func)
     if chain in _WALLCLOCK:
         return f"wall-clock call '{chain}()'"
+    if chain in _PROFILER_CHAINS or (
+            call_name(node) == "capture" and "profiling" in chain):
+        return f"profiler session mutation '{chain}()'"
     m = _metric_mutation(node)
     if m:
         return f"metrics mutation '{m}'"
@@ -127,8 +142,8 @@ def _side_effect(node: ast.AST) -> str:
 class TracePurityRule(Rule):
     id = "HVD004"
     summary = ("python side-effect (metrics/faults/environ/wall-"
-               "clock/trace-span) inside a jit/shard_map/pmap-traced "
-               "function")
+               "clock/trace-span/profiler-session) inside a "
+               "jit/shard_map/pmap-traced function")
 
     def run(self, project: Project) -> List[Finding]:
         findings: List[Finding] = []

@@ -8,16 +8,17 @@ before the VMA rename). One wrapper here keeps every kernel definition
 on the modern spelling while the whole suite still runs on the older
 runtime some fleets pin.
 
-Known legacy-jax limitation: without VMA typing (and with the legacy
+Known legacy-jax wrinkle: without VMA typing (and with the legacy
 replication tracker off — it false-rejects valid programs, see
 shard_map below), the AD transpose does not auto-psum replicated
-parameters' cotangents. parallel/train.py compensates with explicit
-complement-axis psums, which is EXACT for the Horovod-parity cases
-(pure data parallel, fsdp-gathered params) but over-counts parameters
-whose gradient paths are themselves replicated across a model axis
-(composed tp/sp obliviously-replicated layers). On legacy jax prefer
-pure-DP/fsdp `build_train_step` configs or the GSPMD builder; modern
-jax has no such caveat.
+parameters' cotangents, AND it transposes a psum as another psum —
+so a loss replicated across a model axis (tp's psum'd projections,
+sp's loss pmean) yields per-rank gradients exactly |axis|x too
+large. parallel/train.py compensates with explicit complement-axis
+psums plus one uniform 1/prod(model-axis sizes) correction (see its
+`legacy_fix`), which restores oracle-exact gradients for the
+composed tp/sp/fsdp cases too (pinned by test_transformer's
+step-vs-oracle tests). Modern jax has no such caveat.
 """
 
 from __future__ import annotations

@@ -47,7 +47,12 @@ KNOBS: List[Knob] = [
          "reduction under remaining backprop — the jit-path mirror "
          "of the eager fusion-buffer overlap. On by default; 0 "
          "restores the monolithic end-of-step reduction (byte-"
-         "identical HLO to the pre-overlap builder, test-pinned)."),
+         "identical HLO to the pre-overlap builder, test-pinned). "
+         "Leaves with no wire (reduce axes multiplying out to one "
+         "device — e.g. every leaf on a single-chip mesh) are never "
+         "bucketed: their psum is the identity, so the pack/unpack "
+         "round trip is pure overhead (elided since r08; "
+         "single-chip programs lower with no bucket machinery)."),
     Knob("HOROVOD_CYCLE_TIME", float, 1.0,
          "Background engine cycle time in milliseconds: how often the "
          "pending-tensor queue is drained and negotiated."),

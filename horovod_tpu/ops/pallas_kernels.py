@@ -52,9 +52,13 @@ def _partials_kernel(a_ref, b_ref, out_ref):
 
     @pl.when(i == 0)
     def _init():
-        out_ref[0, 0] = 0.0
-        out_ref[0, 1] = 0.0
-        out_ref[0, 2] = 0.0
+        # Literals must be dtype-exact: a weak-typed python 0.0
+        # becomes f64 under jax_enable_x64 and interpreter-mode
+        # discharge rejects the f64 store into the f32 SMEM ref.
+        zero = jnp.float32(0.0)
+        out_ref[0, 0] = zero
+        out_ref[0, 1] = zero
+        out_ref[0, 2] = zero
 
     a = a_ref[:].astype(jnp.float32)
     b = b_ref[:].astype(jnp.float32)

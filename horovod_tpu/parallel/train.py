@@ -264,10 +264,37 @@ def _make_bucket_tag(bucket_id: int, raxes: Tuple[str, ...],
         if guard and has_inexact:
             flag = _numerics.local_finite_flag(list(cts))
         for gi, positions in enumerate(groups):
+            rides = flag is not None and gi == flag_gi
+            if len(positions) == 1 and not rides:
+                # Single-leaf wire group with nothing riding it (the
+                # common shape for oversized leaves — the flagship's
+                # 134 MB embed gets a bucket of its own): psum the
+                # cotangent in its NATURAL shape. The packed path's
+                # reshape(-1) -> slice -> reshape round trip buys
+                # nothing here (there is no packing to do) and is
+                # pure layout traffic the trace bills to
+                # copy_reshape; this elides it.
+                p = positions[0]
+                ct = cts[p]
+                wire_nbytes = int(ct.size) * ct.dtype.itemsize
+                if probe is not None:
+                    jax.debug.callback(
+                        lambda _t, b=bucket_id, nb=wire_nbytes:
+                            probe(b, "ready", nb),
+                        ct.reshape(-1)[0])
+                red = _psum_r(ct)
+                if probe is not None:
+                    jax.debug.callback(
+                        lambda _t, b=bucket_id, nb=wire_nbytes:
+                            probe(b, "reduced", nb),
+                        red.reshape(-1)[0])
+                if scale is not None:
+                    red = red * jnp.asarray(scale, red.dtype)
+                outs[p] = red
+                continue
             flats = [cts[p].reshape(-1) for p in positions]
             concat = (jnp.concatenate(flats) if len(flats) > 1
                       else flats[0])
-            rides = flag is not None and gi == flag_gi
             if rides:
                 concat = jnp.concatenate(
                     [concat, flag.astype(concat.dtype).reshape(1)])
@@ -385,21 +412,53 @@ def build_train_step(
     # axes. The true data-parallel MEAN gradient is therefore that
     # psum divided by the batch-axis product; one uniform scale is
     # correct for replicated AND model-sharded parameters alike.
+    # Legacy-jax model-axis over-count (jax < 0.5, no VMA typing,
+    # check_rep off): the transpose of a psum is another psum there,
+    # so every backward pass through the model's OWN replicating
+    # collectives (tp's psum'd projections/vocab-parallel CE, sp's
+    # loss pmean) multiplies the cotangent by the axis size — the
+    # per-rank gradient of a loss replicated across a model axis
+    # arrives exactly |axis|x too large, uniformly for every leaf
+    # (sharded or not; measured 2.0x per live tp/sp axis, 4.0x for
+    # tp x sp). The canonical MODEL axes (tensor/seq/pipe — the axes
+    # whose in-loss collectives replicate the loss) are known by
+    # name; axes outside the framework vocabulary (ad-hoc test
+    # meshes) are treated as Horovod-parity batch axes and left
+    # alone. The correction is one uniform scale: 1/prod(model-axis
+    # sizes). Modern jax's VMA transpose has no such over-count
+    # (pbroadcast transposes to psum exactly once) — the fix is
+    # legacy-leg only.
+    from .mesh import PIPE_AXIS, SEQ_AXIS, TENSOR_AXIS
+    n_model = 1
+    for a in (TENSOR_AXIS, SEQ_AXIS, PIPE_AXIS):
+        if a in mesh.shape and a not in baxes:
+            n_model *= mesh.shape[a]
+    legacy_fix = (1.0 / n_model
+                  if not GRADS_PRE_SUMMED and n_model > 1 else None)
+
     def _sum_missing_axes(grads):
         """Legacy-jax leg: without VMA typing (and with the legacy
         replication checker off — see compat.shard_map) the transpose
         does NOT psum a replicated parameter's cotangent, so each
         device holds only its LOCAL contribution. Insert exactly the
         missing psums: every mesh axis the parameter's spec does not
-        name (the axes it is replicated across)."""
+        name (the axes it is replicated across) — then undo the
+        legacy model-axis over-count (see `legacy_fix` above)."""
         axis_names = tuple(mesh.shape.keys())
         spec_tree = _broadcast_specs(param_specs, grads)
 
         def one(g, spec):
             named = _spec_named_axes(spec)
             for a in axis_names:
-                if a not in named:
+                # psum over a size-1 axis is the identity — emitting
+                # it would only hand XLA dead collectives to elide
+                # (and kept the world-1 program from matching the
+                # wire-gated overlap build byte-for-byte).
+                if a not in named and mesh.shape[a] > 1:
                     g = lax.psum(g, a)
+            if legacy_fix is not None and jnp.issubdtype(
+                    g.dtype, jnp.inexact):
+                g = g * jnp.asarray(legacy_fix, g.dtype)
             return g
 
         return jax.tree.map(one, grads, spec_tree)
@@ -479,8 +538,17 @@ def build_train_step(
                else int(overlap_threshold))
     vma_leg = GRADS_PRE_SUMMED and hasattr(lax, "pvary")
     axis_names = tuple(mesh.shape.keys())
-    default_scale = (1.0 / n_batch
-                     if grad_reducer is None and n_batch != 1 else None)
+    # Bucketed-path scale: the 1/n_batch mean (when no custom reducer
+    # owns scaling) folded with the legacy model-axis correction —
+    # which applies EVEN under a custom reducer, so the reducer sees
+    # the same correctly-summed grads the monolithic path hands it.
+    _base_scale = (1.0 / n_batch
+                   if grad_reducer is None and n_batch != 1 else None)
+    if legacy_fix is not None:
+        default_scale = (_base_scale if _base_scale is not None
+                         else 1.0) * legacy_fix
+    else:
+        default_scale = _base_scale
 
     def _bucketed_value_and_grad(params, batch):
         """value_and_grad with per-bucket custom_vjp boundaries: each
@@ -497,12 +565,27 @@ def build_train_step(
         raxes_of = [tuple(a for a in axis_names
                           if a not in _spec_named_axes(s))
                     for s in spec_leaves]
-        # Leaves sharded over EVERY mesh axis need no reduction, and
+        # Leaves sharded over EVERY mesh axis need no reduction;
         # integer/bool leaves carry float0 cotangents (zero-size —
-        # nothing to pack or reduce); both stay outside the buckets
-        # and pass through exactly as on the monolithic path.
+        # nothing to pack or reduce); and a leaf whose reduce axes
+        # multiply out to ONE DEVICE has no wire at all — its psum is
+        # the identity, so packing it buys nothing and costs the full
+        # flatten/concat/psum/unpack round trip (the r08 attribution:
+        # +41 dead instructions incl. 5 pack all-reduces on the
+        # world-1 transformer step, +5.4% jit ResNet throughput from
+        # eliding them — benchmarks/PROFILE_transformer_r08.json,
+        # BENCH_wiregate_ab_r08.json). All three stay outside the
+        # buckets and pass through exactly as on the monolithic path;
+        # a single-chip program therefore lowers with no bucket
+        # machinery whatsoever.
+        def _wire(raxes):
+            n = 1
+            for a in raxes:
+                n *= mesh.shape[a]
+            return n > 1
+
         bucketable = [i for i in range(len(leaves))
-                      if raxes_of[i]
+                      if raxes_of[i] and _wire(raxes_of[i])
                       and jnp.issubdtype(leaves[i].dtype, jnp.inexact)]
         parts = partition_buckets(
             [leaves[i] for i in bucketable], bthresh,

@@ -1,0 +1,441 @@
+"""Trace-backed time attribution for the jit benches.
+
+`jax.profiler.trace` writes an XSpace protobuf
+(`plugins/profile/<run>/<host>.xplane.pb`) holding every profiler
+plane: device op streams on TPU (`/device:TPU:N` planes, "XLA Ops"
+lines), the host executor stream on CPU, plus python/TSL host spans
+(which is where tracing.py's TraceAnnotations land — the PR-5
+profiler gating). This module turns that capture into the number the
+flat headline needs: WHERE the step time goes, by op category.
+
+No tensorflow/tensorboard dependency: the container bakes neither, so
+the XSpace is read with a minimal protobuf wire-format parser (~50
+lines — varint + length-delimited is all the XPlane schema uses).
+Only the fields the breakdown needs are decoded; unknown fields are
+skipped by wire type, so schema growth cannot break parsing.
+
+The breakdown is BYTE-DETERMINISTIC for a given .pb: category totals
+come from exact picosecond sums, orderings break ties by name, and
+every float is rounded once at the edge (`_r9`). tests/test_profiling
+pins a committed tiny fixture to a committed golden digest.
+
+Categories (the MFU decomposition's denominator terms):
+
+  mxu           dot / convolution / matmul-shaped fusions — the only
+                ops the MFU numerator credits
+  vector        every other on-device compute op (reductions,
+                elementwise fusions, BN statistics, softmax, ...)
+  copy_reshape  layout traffic: copy/transpose/reshape/bitcast/pad/
+                slice/concatenate/convert — pure HBM bandwidth, the
+                packed-bucket unpack tax lives here
+  collective    all-reduce / all-gather / reduce-scatter /
+                collective-permute / all-to-all (+ -start/-done)
+  infeed_outfeed host<->device transfers
+  host_gap      wall span of the op stream minus time covered by ops
+                — dispatch stalls, python overhead between launches
+
+Entry points: `capture(dir)` (the context manager `bench.py
+--profile` uses — a PROFILER SESSION MUTATION, never call it inside
+a jitted function; hvdlint HVD004 flags that), `digest_trace(dir)`
+(newest capture under dir -> digest dict), `breakdown(bytes)`,
+`sink_table_md(digest)` for docs, and `python -m
+horovod_tpu.profiling <dir-or-pb>` printing the digest JSON.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import struct
+import sys
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+__all__ = [
+    "capture", "parse_xspace", "breakdown", "digest_trace",
+    "latest_xplane", "sink_table_md", "profile_digest_block",
+]
+
+
+# ---------------------------------------------------------------------------
+# Minimal protobuf wire-format reader
+# ---------------------------------------------------------------------------
+
+def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
+    val = 0
+    shift = 0
+    while True:
+        b = buf[i]
+        i += 1
+        val |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return val, i
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint overflow (corrupt .pb)")
+
+
+def _fields(buf: bytes) -> Dict[int, List[Any]]:
+    """Decode one message's fields: {field_number: [values...]}.
+    Varint fields decode to int, length-delimited to bytes, fixed64/
+    fixed32 to int — callers pick the interpretation per field."""
+    out: Dict[int, List[Any]] = {}
+    i, n = 0, len(buf)
+    while i < n:
+        tag, i = _read_varint(buf, i)
+        fnum, wtype = tag >> 3, tag & 7
+        if wtype == 0:
+            v, i = _read_varint(buf, i)
+        elif wtype == 1:
+            v = struct.unpack_from("<Q", buf, i)[0]
+            i += 8
+        elif wtype == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        elif wtype == 5:
+            v = struct.unpack_from("<I", buf, i)[0]
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wtype}")
+        out.setdefault(fnum, []).append(v)
+    return out
+
+
+def _parse_event(buf: bytes, event_names: Dict[int, str]):
+    """Specialized XEvent decoder — the parser's hot loop (a CPU
+    thunk-level capture holds tens of millions of events; the generic
+    dict-building _fields() costs ~5x more here). Reads metadata_id/
+    offset_ps/duration_ps, skips everything else by wire type."""
+    mid = off = dur = 0
+    i, n = 0, len(buf)
+    while i < n:
+        tag, i = _read_varint(buf, i)
+        fnum, wtype = tag >> 3, tag & 7
+        if wtype == 0:
+            v, i = _read_varint(buf, i)
+            if fnum == 1:
+                mid = v
+            elif fnum == 2:
+                off = v
+            elif fnum == 3:
+                dur = v
+        elif wtype == 2:
+            ln, i = _read_varint(buf, i)
+            i += ln
+        elif wtype == 1:
+            i += 8
+        elif wtype == 5:
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wtype}")
+    return (event_names.get(mid, f"#{mid}"), off, dur)
+
+
+def _utf8(v: List[Any]) -> str:
+    return v[0].decode("utf-8", "replace") if v else ""
+
+
+def _map_names(entries: List[bytes]) -> Dict[int, str]:
+    """Decode a map<int64, XEventMetadata|XStatMetadata> into
+    {id: name} (both metadata messages carry name in field 2)."""
+    out: Dict[int, str] = {}
+    for raw in entries:
+        kv = _fields(raw)
+        key = kv.get(1, [0])[0]
+        meta = _fields(kv.get(2, [b""])[0])
+        out[key] = _utf8(meta.get(2, []))
+    return out
+
+
+def parse_xspace(data: bytes) -> Dict[str, Any]:
+    """XSpace bytes -> {"planes": [{"name", "lines": [{"name",
+    "timestamp_ns", "events": [(name, offset_ps, dur_ps)]}]}]}.
+    Event names are resolved through the plane's event-metadata
+    table; zero-duration and counter events are kept (duration 0)."""
+    space = _fields(data)
+    planes = []
+    for praw in space.get(1, []):
+        p = _fields(praw)
+        event_names = _map_names(p.get(4, []))
+        lines = []
+        for lraw in p.get(3, []):
+            ln = _fields(lraw)
+            events = []
+            for eraw in ln.get(4, []):
+                events.append(_parse_event(eraw, event_names))
+            lines.append({
+                "name": _utf8(ln.get(2, [])) or _utf8(ln.get(11, [])),
+                "timestamp_ns": ln.get(3, [0])[0],
+                "events": events,
+            })
+        planes.append({"name": _utf8(p.get(2, [])), "lines": lines})
+    return {"planes": planes}
+
+
+# ---------------------------------------------------------------------------
+# Op categorization
+# ---------------------------------------------------------------------------
+
+_COLLECTIVE = (
+    "all-reduce", "all-gather", "all-to-all", "reduce-scatter",
+    "collective-permute", "collective-broadcast", "send", "recv",
+)
+_COPY = (
+    "copy", "transpose", "reshape", "bitcast", "pad", "slice",
+    "dynamic-slice", "dynamic-update-slice", "concatenate", "reverse",
+    "broadcast", "convert", "gather",
+)
+_MXU = ("dot", "convolution", "einsum", "cublas", "gemm", "matmul")
+_INFEED = ("infeed", "outfeed", "host-transfer")
+
+
+def categorize(name: str) -> str:
+    """HLO-instruction-name heuristic (TPU traces name XLA Ops events
+    after the HLO instruction; fusions keep their producer hint in
+    the name, e.g. 'convolution_fusion' / 'loop_convert_fusion')."""
+    base = name.lstrip("%").lower()
+    # strip the HLO instance suffix: "all-reduce-start.1" -> keep the
+    # dashed base; "fusion.130" -> "fusion"
+    head = base.split(".")[0]
+    for pat in _COLLECTIVE:
+        # all-gather must not be eaten by the "gather" copy rule, so
+        # collectives are tested first, on the full dashed head.
+        if head == pat or head.startswith(pat + "-"):
+            return "collective"
+    for pat in _INFEED:
+        if pat in base:
+            return "infeed_outfeed"
+    for pat in _MXU:
+        # substring match so fusion names carrying the producer hint
+        # ('convolution_fusion') land right; no bare "conv" pattern —
+        # it would eat "convert" (the BN bandwidth fusions, which are
+        # copy_reshape)
+        if pat in base:
+            return "mxu"
+    for pat in _COPY:
+        if head == pat or head.startswith(pat + "-") or \
+                (pat in base and "fusion" in base):
+            return "copy_reshape"
+    return "vector"
+
+
+def _is_op_line(plane_name: str, line_name: str) -> bool:
+    """Lines carrying the XLA op stream: TPU device planes' 'XLA Ops'
+    lanes, or (CPU fallback — this container) the TfrtCpuClient
+    executor threads on the host plane, where the CPU backend lands
+    its per-op events."""
+    if plane_name.startswith("/device:"):
+        return "xla ops" in line_name.lower() or not line_name
+    if plane_name == "/host:CPU":
+        return "cpuclient" in line_name.lower()
+    return False
+
+
+def _r9(x: float) -> float:
+    return round(x, 9)
+
+
+def breakdown(data: bytes, top: int = 5) -> Dict[str, Any]:
+    """Deterministic per-category time breakdown of one .pb capture.
+
+    Totals are summed per op NAME first (picosecond integers), then
+    per category; `host_gap` is the op-stream wall span minus the
+    union of op intervals (merged, so overlapping lanes cannot go
+    negative). Fractions are of busy (op) time; host_gap's fraction
+    is of the wall span."""
+    space = parse_xspace(data)
+    per_op: Dict[str, List[int]] = {}       # name -> [total_ps, count]
+    intervals: List[Tuple[int, int]] = []   # absolute ps
+    planes_used: List[str] = []
+    span_lo: Optional[int] = None
+    span_hi: Optional[int] = None
+    for plane in space["planes"]:
+        used = False
+        for line in plane["lines"]:
+            if not _is_op_line(plane["name"], line["name"]):
+                continue
+            base_ps = line["timestamp_ns"] * 1000
+            for name, off, dur in line["events"]:
+                used = True
+                # Executor scaffolding (ThunkExecutor::Execute,
+                # ThreadpoolListener::*, $python frames) wraps the
+                # real op events on the same lane: keep it in the
+                # busy-span union (it IS activity) but out of the
+                # per-op categories (it would double-count its
+                # children as 'vector').
+                if "::" not in name and not name.startswith("$"):
+                    acc = per_op.setdefault(name, [0, 0])
+                    acc[0] += dur
+                    acc[1] += 1
+                lo = base_ps + off
+                hi = lo + dur
+                intervals.append((lo, hi))
+                span_lo = lo if span_lo is None else min(span_lo, lo)
+                span_hi = hi if span_hi is None else max(span_hi, hi)
+        if used:
+            planes_used.append(plane["name"])
+
+    busy_ps = 0
+    if intervals:
+        intervals.sort()
+        cur_lo, cur_hi = intervals[0]
+        for lo, hi in intervals[1:]:
+            if lo > cur_hi:
+                busy_ps += cur_hi - cur_lo
+                cur_lo, cur_hi = lo, hi
+            else:
+                cur_hi = max(cur_hi, hi)
+        busy_ps += cur_hi - cur_lo
+    span_ps = (span_hi - span_lo) if intervals else 0
+    gap_ps = max(0, span_ps - busy_ps)
+
+    cats: Dict[str, List[int]] = {}
+    op_ps_total = 0
+    for name, (ps, cnt) in per_op.items():
+        acc = cats.setdefault(categorize(name), [0, 0])
+        acc[0] += ps
+        acc[1] += cnt
+        op_ps_total += ps
+
+    categories = {}
+    for cat in sorted(cats):
+        ps, cnt = cats[cat]
+        categories[cat] = {
+            "time_s": _r9(ps / 1e12),
+            "fraction": _r9(ps / op_ps_total
+                            if op_ps_total else 0.0),
+            "events": cnt,
+        }
+    categories["host_gap"] = {
+        "time_s": _r9(gap_ps / 1e12),
+        "fraction_of_span": _r9(gap_ps / span_ps if span_ps else 0.0),
+        "events": 0,
+    }
+
+    sinks = sorted(per_op.items(),
+                   key=lambda kv: (-kv[1][0], kv[0]))[:top]
+    top_sinks = [{
+        "name": name,
+        "category": categorize(name),
+        "time_s": _r9(ps / 1e12),
+        "fraction": _r9(ps / op_ps_total
+                        if op_ps_total else 0.0),
+        "count": cnt,
+    } for name, (ps, cnt) in sinks]
+
+    return {
+        "source_planes": sorted(planes_used),
+        "wall_span_s": _r9(span_ps / 1e12),
+        "busy_s": _r9(busy_ps / 1e12),
+        "op_time_s": _r9(op_ps_total / 1e12),
+        "host_gap_s": _r9(gap_ps / 1e12),
+        "categories": categories,
+        "top_sinks": top_sinks,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Capture + digest plumbing
+# ---------------------------------------------------------------------------
+
+def latest_xplane(trace_dir: str) -> Optional[str]:
+    """Newest run's .xplane.pb under a jax.profiler trace dir (runs
+    are timestamp-named subdirs; lexicographic max == newest)."""
+    pbs = glob.glob(os.path.join(
+        trace_dir, "plugins", "profile", "*", "*.xplane.pb"))
+    return max(pbs) if pbs else None
+
+
+def digest_trace(trace_dir_or_pb: str, top: int = 5) -> Dict[str, Any]:
+    """Digest of a capture: accepts the trace dir bench.py wrote or a
+    direct .xplane.pb path. Raises FileNotFoundError when no capture
+    exists (a silently-empty digest would read as 'no time anywhere')."""
+    path = trace_dir_or_pb
+    if os.path.isdir(path):
+        found = latest_xplane(path)
+        if found is None:
+            raise FileNotFoundError(
+                f"no .xplane.pb under {path!r} (profiler capture "
+                f"missing or still open)")
+        path = found
+    with open(path, "rb") as f:
+        out = breakdown(f.read(), top=top)
+    out["xplane"] = os.path.basename(path)
+    return out
+
+
+@contextmanager
+def capture(trace_dir: str) -> Iterator[str]:
+    """Profiler capture for a bench window: `with capture(d):` wraps
+    `jax.profiler.trace` (host + device planes; tracing.py's
+    TraceAnnotations land in the capture because profiler_active()
+    flips true inside). This MUTATES GLOBAL PROFILER SESSION STATE —
+    calling it inside a jit/shard_map-traced function would start the
+    session once at trace time and never again (hvdlint HVD004 flags
+    exactly that); wrap the step LOOP, never the step."""
+    import jax
+    with jax.profiler.trace(trace_dir):
+        yield trace_dir
+
+
+def profile_digest_block(trace_dir: str,
+                         top: int = 3) -> Dict[str, Any]:
+    """The compact `profile` block every bench JSON artifact carries:
+    top-`top` sinks + category fractions, or an `error` field when
+    the capture is unreadable (self-describing beats crashing a
+    finished bench run)."""
+    try:
+        d = digest_trace(trace_dir, top=top)
+    except (OSError, ValueError) as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    return {
+        "xplane": d["xplane"],
+        "source_planes": d["source_planes"],
+        "busy_s": d["busy_s"],
+        "host_gap_s": d["host_gap_s"],
+        "categories": {k: v["time_s"]
+                       for k, v in d["categories"].items()},
+        "top_sinks": d["top_sinks"],
+    }
+
+
+def sink_table_md(digest: Dict[str, Any]) -> str:
+    """docs/benchmarks.md rendering of a digest: the top-sink table
+    plus the category row — regenerate with
+    `python -m horovod_tpu.profiling <trace>`."""
+    lines = ["| rank | op | category | time (s) | % of op time |",
+             "|---|---|---|---|---|"]
+    for i, s in enumerate(digest["top_sinks"], 1):
+        lines.append(
+            f"| {i} | `{s['name']}` | {s['category']} | "
+            f"{s['time_s']:.6f} | {100 * s['fraction']:.1f}% |")
+    cats = digest["categories"]
+    order = [c for c in ("mxu", "vector", "copy_reshape", "collective",
+                         "infeed_outfeed", "host_gap") if c in cats]
+    parts = []
+    for c in order:
+        frac = cats[c].get("fraction",
+                           cats[c].get("fraction_of_span", 0.0))
+        parts.append(f"{c} {100 * frac:.1f}%")
+    lines.append("")
+    lines.append("Category split: " + ", ".join(parts) + ".")
+    return "\n".join(lines)
+
+
+def main(argv: List[str]) -> int:
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m horovod_tpu.profiling "
+              "<trace-dir-or-xplane.pb> [--top N]", file=sys.stderr)
+        return 2
+    top = 5
+    if "--top" in argv:
+        top = int(argv[argv.index("--top") + 1])
+    digest = digest_trace(argv[0], top=top)
+    print(json.dumps(digest, indent=1, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - CLI shim
+    raise SystemExit(main(sys.argv[1:]))

@@ -127,3 +127,47 @@ def suppressed_effect(x):
     # hvdlint: disable-next=HVD004 (fixture: deliberate trace-time brand)
     _m_steps.inc()
     return x
+
+
+# -- profiler-session mutations (profiling.py capture entry points) --------
+
+@jax.jit
+def decorated_profiler_capture(x):
+    from horovod_tpu import profiling
+    with profiling.capture("/tmp/fixture_trace"):  # EXPECT: HVD004
+        y = x * 2
+    return y
+
+
+@jax.jit
+def decorated_profiler_start(x):
+    jax.profiler.start_trace("/tmp/fixture_trace")  # EXPECT: HVD004
+    y = x + 1
+    jax.profiler.stop_trace()  # EXPECT: HVD004
+    return y
+
+
+def profile_outside_tracing(x):
+    # the intended use: the capture wraps the step LOOP, the jitted
+    # step runs inside it
+    from horovod_tpu import profiling
+
+    @jax.jit
+    def kernel(v):
+        return v * 2
+
+    with profiling.capture("/tmp/fixture_trace"):
+        for _ in range(3):
+            x = kernel(x)
+    return x
+
+
+@jax.jit
+def lookalike_capture(x):
+    # a .capture() on a non-profiling receiver is NOT a session
+    # mutation
+    class _Sink:
+        def capture(self, *a):
+            return None
+    _Sink().capture(x)
+    return x

@@ -173,6 +173,38 @@ class TestBucketedTrainStep:
         np.testing.assert_allclose(float(m_on["loss"]),
                                    float(m_off["loss"]), rtol=1e-6)
 
+    def test_world1_wire_gate_no_buckets(self, monkeypatch):
+        """r08 wire gate: on a single-device mesh every leaf's reduce
+        axes multiply out to 1 — the psum is the identity — so
+        overlap-ON must build ZERO buckets and lower byte-identically
+        to the monolithic program. This pins the fix for the
+        single-chip copy tax the r08 attribution caught (+41 dead
+        pack/psum/unpack instructions on the world-1 transformer
+        step, benchmarks/PROFILE_transformer_r08.json): the bucket
+        machinery may never again ship wire-less copies."""
+        from horovod_tpu.parallel.train import (build_train_step,
+                                                last_overlap_info)
+        monkeypatch.delenv("HOROVOD_NUMERICS_GUARD", raising=False)
+        mesh = Mesh(np.array(jax.devices()[:1]), axis_names=("proc",))
+        opt = optax.sgd(0.1)
+        params = _params()
+        st = opt.init(params)
+        batch = jnp.arange(8.0)
+        s_on = build_train_step(_loss, opt, mesh, donate=False,
+                                overlap=True, overlap_threshold=16)
+        on = s_on.lower(params, st, batch).as_text()
+        info = last_overlap_info()
+        assert info["enabled"] and info["buckets"] == 0, info
+        s_off = build_train_step(_loss, opt, mesh, donate=False,
+                                 overlap=False)
+        off = s_off.lower(params, st, batch).as_text()
+        assert on == off
+        # and on a REAL multi-device mesh the gate must NOT fire
+        s_multi = build_train_step(_loss, opt, _mesh(), donate=False,
+                                   overlap=True, overlap_threshold=16)
+        s_multi.lower(params, st, batch).as_text()
+        assert last_overlap_info()["buckets"] >= 2
+
     def test_default_on_and_knob_off(self, monkeypatch):
         from horovod_tpu.parallel import train as T
         monkeypatch.delenv("HOROVOD_JIT_OVERLAP", raising=False)

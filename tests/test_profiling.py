@@ -1,0 +1,291 @@
+"""XPlane parser + time-attribution digest (horovod_tpu/profiling.py).
+
+The committed fixture `tests/data/tiny_trace.xplane.pb` is a
+SYNTHETIC TPU-shaped XSpace (device plane + XLA Ops line + host
+executor line + an ignored python line) built by `_build_fixture()`
+below — synthesized, because this CPU container cannot capture a TPU
+device plane, and the parser must be pinned against the TPU shape it
+will meet on silicon. Three things are pinned byte-exactly:
+
+  * the fixture bytes themselves (encoder drift shows up as a diff),
+  * the parsed digest vs `tests/data/tiny_trace_golden.json`,
+  * digest determinism (same bytes -> same JSON, twice).
+
+The end-to-end smoke captures a REAL `jax.profiler` trace of a toy
+jitted model through `profiling.capture` and digests it — the same
+path `bench.py --profile` drives — inside the tier-1 budget.
+"""
+
+import json
+import os
+import struct
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu import profiling
+
+DATA_DIR = os.path.join(os.path.dirname(__file__), "data")
+FIXTURE = os.path.join(DATA_DIR, "tiny_trace.xplane.pb")
+GOLDEN = os.path.join(DATA_DIR, "tiny_trace_golden.json")
+
+
+# ---------------------------------------------------------------------------
+# Minimal protobuf wire ENCODER (test-only; the module ships only the
+# decoder) — enough to synthesize an XSpace.
+# ---------------------------------------------------------------------------
+
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _field_varint(fnum: int, v: int) -> bytes:
+    return _varint(fnum << 3 | 0) + _varint(v)
+
+
+def _field_bytes(fnum: int, payload: bytes) -> bytes:
+    return _varint(fnum << 3 | 2) + _varint(len(payload)) + payload
+
+
+def _field_str(fnum: int, s: str) -> bytes:
+    return _field_bytes(fnum, s.encode())
+
+
+def _event(metadata_id: int, offset_ps: int, dur_ps: int) -> bytes:
+    return (_field_varint(1, metadata_id)
+            + _field_varint(2, offset_ps)
+            + _field_varint(3, dur_ps))
+
+
+def _line(name: str, timestamp_ns: int, events) -> bytes:
+    payload = _field_str(2, name) + _field_varint(3, timestamp_ns)
+    for ev in events:
+        payload += _field_bytes(4, _event(*ev))
+    return payload
+
+
+def _event_metadata(mid: int, name: str) -> bytes:
+    # map<int64, XEventMetadata> entry: key=1, value=2
+    meta = _field_varint(1, mid) + _field_str(2, name)
+    return _field_varint(1, mid) + _field_bytes(2, meta)
+
+
+def _plane(name: str, metadata, lines) -> bytes:
+    payload = _field_str(2, name)
+    for raw in lines:
+        payload += _field_bytes(3, raw)
+    for mid, mname in metadata:
+        payload += _field_bytes(4, _event_metadata(mid, mname))
+    return payload
+
+
+def _build_fixture() -> bytes:
+    """One TPU device plane (XLA Ops lane: dot / fusion / all-reduce /
+    copy / convert, with a deliberate 1 us host gap) + the host plane
+    (one executor lane whose scaffolding event must be excluded from
+    per-op accounting, one python lane that must be ignored)."""
+    device = _plane(
+        "/device:TPU:0",
+        metadata=[(1, "dot.5"), (2, "fusion.1"), (3, "all-reduce.1"),
+                  (4, "copy.2"), (5, "convert.7")],
+        lines=[_line("XLA Ops", 1000, [
+            (1, 0, 2_000_000),           # dot: 2 us          (mxu)
+            (2, 2_000_000, 1_000_000),   # fusion: 1 us       (vector)
+            # 1 us gap here — the host_gap the digest must report
+            (3, 4_000_000, 500_000),     # all-reduce: 0.5 us (coll.)
+            (4, 4_500_000, 250_000),     # copy: 0.25 us      (copy)
+            (5, 4_750_000, 250_000),     # convert: 0.25 us   (copy)
+        ])])
+    host = _plane(
+        "/host:CPU",
+        metadata=[(1, "ThunkExecutor::Execute"), (2, "reduce.3"),
+                  (3, "$python_frame")],
+        lines=[
+            _line("tf_XLATfrtCpuClient/-42", 9_000_000, [
+                (1, 0, 1_000_000),       # scaffolding: busy, not an op
+                (2, 100_000, 400_000),   # reduce: 0.4 us     (vector)
+            ]),
+            _line("python", 9_000_000, [(3, 0, 5_000_000)]),
+        ])
+    return _field_bytes(1, device) + _field_bytes(1, host)
+
+
+# ---------------------------------------------------------------------------
+# Fixture + golden pins
+# ---------------------------------------------------------------------------
+
+def test_committed_fixture_matches_encoder():
+    with open(FIXTURE, "rb") as f:
+        assert f.read() == _build_fixture(), \
+            "tests/data/tiny_trace.xplane.pb no longer matches " \
+            "_build_fixture(); regenerate BOTH fixture and golden"
+
+
+def test_breakdown_matches_committed_golden():
+    with open(FIXTURE, "rb") as f:
+        digest = profiling.breakdown(f.read(), top=5)
+    with open(GOLDEN) as f:
+        want = json.load(f)
+    assert digest == want, json.dumps(digest, indent=1, sort_keys=True)
+
+
+def test_breakdown_byte_deterministic():
+    data = _build_fixture()
+    a = json.dumps(profiling.breakdown(data), sort_keys=True)
+    b = json.dumps(profiling.breakdown(data), sort_keys=True)
+    assert a == b
+
+
+def test_fixture_semantics():
+    """The numbers the golden encodes, asserted as semantics so a
+    legitimate golden regeneration still has to satisfy them."""
+    d = profiling.breakdown(_build_fixture())
+    cats = d["categories"]
+    assert cats["mxu"]["time_s"] == pytest.approx(2e-6)
+    assert cats["collective"]["time_s"] == pytest.approx(0.5e-6)
+    assert cats["copy_reshape"]["time_s"] == pytest.approx(0.5e-6)
+    # vector = fusion (1 us) + host reduce (0.4 us); the executor
+    # scaffolding event is NOT an op
+    assert cats["vector"]["time_s"] == pytest.approx(1.4e-6)
+    # the deliberate 1 us hole in the device lane, plus the host
+    # lane's 8 ms standoff between the two planes' windows
+    assert d["host_gap_s"] > 0
+    assert d["top_sinks"][0]["name"] == "dot.5"
+    assert d["top_sinks"][0]["category"] == "mxu"
+    assert d["source_planes"] == ["/device:TPU:0", "/host:CPU"]
+
+
+# ---------------------------------------------------------------------------
+# Parser / categorizer units
+# ---------------------------------------------------------------------------
+
+def test_varint_roundtrip():
+    for v in (0, 1, 127, 128, 300, 2 ** 32, 2 ** 56 + 17):
+        buf = _varint(v)
+        got, idx = profiling._read_varint(buf, 0)
+        assert got == v and idx == len(buf)
+
+
+def test_unknown_fields_skipped():
+    # A message with an extra fixed64 field the schema doesn't know
+    # must parse (forward compatibility with XPlane schema growth).
+    extra = _varint(99 << 3 | 1) + struct.pack("<Q", 7)
+    plane = _plane("/device:TPU:0", [(1, "dot.1")],
+                   [_line("XLA Ops", 0, [(1, 0, 10)])])
+    data = _field_bytes(1, plane + extra)
+    space = profiling.parse_xspace(data)
+    assert space["planes"][0]["name"] == "/device:TPU:0"
+
+
+@pytest.mark.parametrize("name,want", [
+    ("dot.17", "mxu"),
+    ("%convolution.3", "mxu"),
+    ("loop_convolution_fusion.2", "mxu"),
+    ("convert.1318", "copy_reshape"),       # NOT mxu: convert != conv
+    ("loop_convert_fusion", "copy_reshape"),
+    ("copy-start.1", "copy_reshape"),
+    ("transpose.9", "copy_reshape"),
+    ("all-reduce-start.1", "collective"),
+    ("all-gather.2", "collective"),         # not eaten by "gather"
+    ("gather.4", "copy_reshape"),
+    ("collective-permute-done.1", "collective"),
+    ("reduce.8", "vector"),
+    ("reduce-window.1", "vector"),
+    ("fusion.130", "vector"),
+    ("infeed.1", "infeed_outfeed"),
+])
+def test_categorize(name, want):
+    assert profiling.categorize(name) == want
+
+
+def test_digest_trace_missing_capture_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        profiling.digest_trace(str(tmp_path))
+
+
+def test_profile_digest_block_shape():
+    with open(FIXTURE, "rb") as f:
+        data = f.read()
+    # route through a fake trace-dir layout
+    import tempfile
+    with tempfile.TemporaryDirectory() as td:
+        run = os.path.join(td, "plugins", "profile", "2026_01_01")
+        os.makedirs(run)
+        with open(os.path.join(run, "host.xplane.pb"), "wb") as f:
+            f.write(data)
+        block = profiling.profile_digest_block(td, top=3)
+    assert len(block["top_sinks"]) == 3
+    assert set(block["categories"]) == {
+        "mxu", "vector", "copy_reshape", "collective", "host_gap"}
+    assert block["xplane"] == "host.xplane.pb"
+
+
+def test_sink_table_md_renders():
+    with open(FIXTURE, "rb") as f:
+        digest = profiling.breakdown(f.read())
+    md = profiling.sink_table_md(digest)
+    assert "| 1 | `dot.5` | mxu |" in md
+    assert "Category split:" in md
+
+
+# ---------------------------------------------------------------------------
+# End-to-end smoke: real capture -> digest (the bench --profile path)
+# ---------------------------------------------------------------------------
+
+def test_capture_toy_model_end_to_end(tmp_path):
+    """profiling.capture around a toy jitted train-ish step, then the
+    digest — the exact pipeline bench.py --profile runs, on a model
+    small enough for the tier-1 budget."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(w, x):
+        h = jnp.tanh(x @ w)
+        return w - 0.1 * jax.grad(
+            lambda w: jnp.sum((x @ w - h) ** 2))(w)
+
+    w = jnp.ones((128, 128), jnp.float32)
+    x = jnp.ones((32, 128), jnp.float32)
+    w = step(w, x)
+    jax.block_until_ready(w)
+    with profiling.capture(str(tmp_path)):
+        for _ in range(3):
+            w = step(w, x)
+        jax.block_until_ready(w)
+    digest = profiling.digest_trace(str(tmp_path))
+    assert digest["op_time_s"] > 0
+    assert digest["categories"]["mxu"]["time_s"] > 0, digest
+    assert digest["top_sinks"], digest
+    # the compact block bench.py embeds
+    block = profiling.profile_digest_block(str(tmp_path))
+    assert "error" not in block and block["top_sinks"]
+
+
+@pytest.mark.slow
+def test_bench_profile_cli(tmp_path):
+    """Full CLI: bench.py --profile on the reduced model emits a JSON
+    artifact whose profile block carries top-3 sinks and the schema's
+    mfu/compiled_gflop_per_img keys."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update(JAX_PLATFORMS="cpu", BENCH_RESNET_STAGES="1",
+               BENCH_BATCH="4", BENCH_IMAGE="32", BENCH_STEPS="4",
+               BENCH_WARMUP="1", BENCH_PROFILE=str(tmp_path))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(here, "bench.py"), "--profile"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert "mfu" in doc and "compiled_gflop_per_img" in doc
+    assert doc["profile"]["top_sinks"]
+    assert len(doc["profile"]["top_sinks"]) <= 3
