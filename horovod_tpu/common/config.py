@@ -133,6 +133,33 @@ KNOBS: List[Knob] = [
     Knob("HOROVOD_CONTROLLER", str, "auto",
          "Control-plane implementation: 'native' (C++ core), 'python' "
          "(pure-python fallback), or 'auto' (native if built)."),
+    Knob("HOROVOD_CONTROL_TREE_ARITY", int, 0,
+         "Hierarchical control-plane fan-out: with N >= 2, non-root "
+         "ranks attach to an intermediate aggregator instead of the "
+         "rank-0 coordinator (contiguous-interval N-ary tree, "
+         "core/cc/tree.h); aggregators merge readiness bitsets and "
+         "request metadata upward and relay agreed batches downward, "
+         "so every node's per-cycle control work is O(arity) instead "
+         "of the root's O(world). 0 (default) keeps the flat star — "
+         "measured fine through a few hundred ranks "
+         "(benchmarks/control_plane_scale.md); 32 is the measured "
+         "sweet spot at 1024. Aggregator rank r listens on the "
+         "control port + r (every rank must agree on the topology, "
+         "so set this identically across the job — hvdrun forwards "
+         "it like every HOROVOD_* knob)."),
+    Knob("HOROVOD_CONTROL_TREE_LINGER_US", int, 200,
+         "Aggregator forward window (tree mode): after the first "
+         "upward wake an aggregator holds its merged frame until "
+         "every connected child has reported or this many "
+         "microseconds passed, so a steady-state submission storm "
+         "goes upward as ONE merged frame per tier. 0 forwards "
+         "eagerly (more, smaller frames at the root)."),
+    Knob("HOROVOD_CONTROL_HOSTS", str, "",
+         "Comma-separated per-rank host list (rank-indexed), exported "
+         "by the launcher so tree-mode workers can resolve their "
+         "aggregator parent's address. Empty = every rank assumed on "
+         "the coordinator host (correct for single-host jobs; "
+         "multi-host tree mode needs the launcher's export)."),
     Knob("HOROVOD_CPU_OPERATIONS", str, "xla",
          "CPU data plane. Only 'xla' is supported: XLA CPU collectives "
          "(the reference's gloo/mpi analog for tests)."),
@@ -425,6 +452,9 @@ class Config:
         "shutdown_barrier_timeout": "HOROVOD_SHUTDOWN_BARRIER_TIMEOUT",
         "hierarchical_allreduce": "HOROVOD_HIERARCHICAL_ALLREDUCE",
         "controller": "HOROVOD_CONTROLLER",
+        "control_tree_arity": "HOROVOD_CONTROL_TREE_ARITY",
+        "control_tree_linger_us": "HOROVOD_CONTROL_TREE_LINGER_US",
+        "control_hosts": "HOROVOD_CONTROL_HOSTS",
         "metrics_port": "HOROVOD_METRICS_PORT",
         "metrics_summary_seconds": "HOROVOD_METRICS_SUMMARY_SECONDS",
         "timeline_path": "HOROVOD_TIMELINE",

@@ -39,7 +39,10 @@ void* hvd_core_create(int rank, int size, const char* coord_host,
                       int coord_port, long long fusion_threshold,
                       double cycle_time_ms, double stall_warn_s,
                       double stall_kill_s, double connect_timeout_s,
-                      int cache_capacity, const char* auth_secret) {
+                      int cache_capacity, const char* auth_secret,
+                      int tree_arity, const char* parent_host,
+                      int parent_port, int listen_port,
+                      int agg_linger_us) {
   ControllerOptions o;
   o.rank = rank;
   o.size = size;
@@ -52,6 +55,11 @@ void* hvd_core_create(int rank, int size, const char* coord_host,
   o.connect_timeout_s = connect_timeout_s;
   o.cache_capacity = cache_capacity;
   o.auth_secret = auth_secret ? auth_secret : "";
+  o.tree_arity = tree_arity;
+  o.parent_host = parent_host ? parent_host : "";
+  o.parent_port = parent_port;
+  o.listen_port = listen_port;
+  o.agg_linger_us = agg_linger_us;
   return new CoreHandle(o);
 }
 
@@ -153,6 +161,33 @@ void hvd_core_set_quiescence(void* h, int cycles) {
 
 void hvd_core_set_cycle_time(void* h, double ms) {
   static_cast<CoreHandle*>(h)->ctrl.SetCycleTime(ms);
+}
+
+// This rank's control-tree tier (0 = root/coordinator; every worker
+// is 1 in the flat star).
+int hvd_core_tree_tier(void* h) {
+  return static_cast<CoreHandle*>(h)->ctrl.tree_tier();
+}
+
+// Stateless topology arithmetic (tree.h), exposed so the Python
+// wiring derives parent addresses/ports from the SAME placement the
+// C++ core uses — duplicated arithmetic would drift.
+int hvd_tree_parent(int rank, int size, int arity) {
+  return hvdtpu::TreePlaceOf(rank, size, arity).parent;
+}
+
+int hvd_tree_tier(int rank, int size, int arity) {
+  return hvdtpu::TreePlaceOf(rank, size, arity).tier;
+}
+
+int hvd_tree_depth(int size, int arity) {
+  return hvdtpu::TreeDepthOf(size, arity);
+}
+
+// Whether a rank fronts a subtree (needs a listen port).
+int hvd_tree_has_children(int rank, int size, int arity) {
+  return hvdtpu::TreePlaceOf(rank, size, arity).children.empty() ? 0
+                                                                 : 1;
 }
 
 }  // extern "C"

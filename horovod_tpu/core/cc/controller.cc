@@ -59,13 +59,43 @@ std::string CoordMac(const std::string& secret,
                      const std::string& worker_nonce) {
   return HmacSha256(secret, worker_nonce + "|coord");
 }
+
+// RAII accumulator for the per-node control-plane work counter
+// (Controller::control_work_ns): brackets parse/ingest/merge/cut/
+// fan-out sections so the stress harness can report per-NODE work
+// per round — the number that must stay sub-cycle on a pod, where
+// each node owns its core. THREAD CPU time, not wall: on an
+// oversubscribed stress host a wall clock would charge this node
+// for every other thread the scheduler ran inside the bracket.
+struct WorkTimer {
+  explicit WorkTimer(std::atomic<int64_t>* acc) : acc_(acc) {
+    clock_gettime(CLOCK_THREAD_CPUTIME_ID, &t0_);
+  }
+  ~WorkTimer() {
+    struct timespec t1;
+    clock_gettime(CLOCK_THREAD_CPUTIME_ID, &t1);
+    acc_->fetch_add((t1.tv_sec - t0_.tv_sec) * 1000000000ll +
+                    (t1.tv_nsec - t0_.tv_nsec));
+  }
+  std::atomic<int64_t>* acc_;
+  struct timespec t0_;
+};
 }  // namespace
 
 Controller::Controller(const ControllerOptions& opts) : opts_(opts) {
   fusion_threshold_.store(opts.fusion_threshold);
   cycle_time_ms_.store(opts.cycle_time_ms);
+  place_ = TreePlaceOf(opts_.rank, opts_.size, opts_.tree_arity);
+  children_set_.insert(place_.children.begin(), place_.children.end());
+  agg_reported_ = RankSet(0, opts_.size);
   if (opts_.size > 1) {
-    if (opts_.rank == 0) {
+    if (!children_set_.empty()) {
+      // This node fronts a subtree (the root always; aggregator
+      // ranks in tree mode): listen for the children BEFORE any
+      // upward connect, so tiers come up concurrently instead of
+      // serializing down the tree.
+      int lport = opts_.rank == 0 ? opts_.coord_port
+                                  : opts_.listen_port;
       // Bounded bind retry: the launcher probes the port before
       // handing it out (TOCTOU), and elastic restarts can race the
       // previous epoch's listener tearing down. Workers retry their
@@ -75,13 +105,14 @@ Controller::Controller(const ControllerOptions& opts) : opts_(opts) {
       double deadline =
           NowSeconds() + std::min(opts_.connect_timeout_s / 2.0, 10.0);
       do {
-        listen_fd_ = ListenOn(opts_.coord_port, opts_.size + 4);
+        listen_fd_ = ListenOn(lport,
+                              static_cast<int>(children_set_.size()) + 4);
         if (listen_fd_ < 0) usleep(200000);
       } while (listen_fd_ < 0 && NowSeconds() < deadline &&
                !shutdown_.load());
       if (listen_fd_ < 0) {
         SetError("failed to listen on control port " +
-                 std::to_string(opts_.coord_port));
+                 std::to_string(lport));
         return;
       }
       worker_fds_.assign(opts_.size, -1);
@@ -90,13 +121,21 @@ Controller::Controller(const ControllerOptions& opts) : opts_(opts) {
       pump_inflight_.assign(opts_.size, 0);
       threads_.emplace_back(&Controller::ServerAcceptLoop, this);
       threads_.emplace_back(&Controller::PumpLoop, this);
-    } else {
-      coord_fd_ = ConnectTo(opts_.coord_host, opts_.coord_port,
-                            opts_.connect_timeout_s);
+    }
+    if (opts_.rank != 0) {
+      const std::string& phost = opts_.parent_host.empty()
+                                     ? opts_.coord_host
+                                     : opts_.parent_host;
+      int pport = opts_.parent_port > 0 ? opts_.parent_port
+                                        : opts_.coord_port;
+      coord_fd_ = ConnectTo(phost, pport, opts_.connect_timeout_s);
       if (coord_fd_ < 0) {
-        SetError("failed to connect to controller at " +
-                 opts_.coord_host + ":" +
-                 std::to_string(opts_.coord_port));
+        SetError("failed to connect to controller at " + phost + ":" +
+                 std::to_string(pport) +
+                 (place_.parent > 0
+                      ? " (tree parent rank " +
+                            std::to_string(place_.parent) + ")"
+                      : ""));
         return;
       }
       // Mutual challenge-response (see ControllerOptions.auth_secret):
@@ -162,14 +201,16 @@ void Controller::SetError(const std::string& msg) {
 void Controller::Abort() {
   bool expected = false;
   if (!aborting_.compare_exchange_strong(expected, true)) return;
-  // Coordinator: tell workers this is a clean teardown before the
-  // sockets drop, so their reader loops don't report a lost
-  // connection. The frame rides the pump like every post-handshake
-  // worker write (a direct send here could interleave with a pump
-  // write mid-frame); it is enqueued BEFORE shutdown_ is raised so
-  // the pump cannot observe empty outboxes + shutdown and exit
-  // early — it flushes these frames and THEN severs the worker fds.
-  if (opts_.rank == 0 && !worker_fds_.empty())
+  // Subtree front (root, or an aggregator in tree mode): tell the
+  // children this is a clean teardown before the sockets drop, so
+  // their reader loops don't report a lost connection — aggregators
+  // relay the shutdown down their own subtree the same way. The
+  // frame rides the pump like every post-handshake child write (a
+  // direct send here could interleave with a pump write mid-frame);
+  // it is enqueued BEFORE shutdown_ is raised so the pump cannot
+  // observe empty outboxes + shutdown and exit early — it flushes
+  // these frames and THEN severs the child fds.
+  if (!children_set_.empty() && !worker_fds_.empty())
     EnqueueToWorkers(BuildFrame(MsgType::kShutdown, ""));
   shutdown_.store(true);
   {
@@ -179,6 +220,10 @@ void Controller::Abort() {
   {
     std::lock_guard<std::mutex> lk(ready_mu_);
     ready_cv_.notify_all();
+  }
+  {
+    std::lock_guard<std::mutex> lk(submit_mu_);
+    cycle_cv_.notify_all();
   }
   if (coord_fd_ >= 0) ::shutdown(coord_fd_, SHUT_RDWR);
   if (listen_fd_ >= 0) ::shutdown(listen_fd_, SHUT_RDWR);
@@ -233,22 +278,36 @@ void Controller::Submit(const std::string& name, const std::string& sig,
     r.nbytes = nbytes;
     r.meta = meta;
   }
-  std::lock_guard<std::mutex> lk(submit_mu_);
-  pending_.push_back(std::move(r));
+  {
+    std::lock_guard<std::mutex> lk(submit_mu_);
+    pending_.push_back(std::move(r));
+  }
+  cycle_cv_.notify_one();
 }
 
 void Controller::Join() {
-  std::lock_guard<std::mutex> lk(submit_mu_);
-  Request r;
-  r.join = true;
-  pending_.push_back(std::move(r));
+  {
+    std::lock_guard<std::mutex> lk(submit_mu_);
+    Request r;
+    r.join = true;
+    pending_.push_back(std::move(r));
+  }
+  cycle_cv_.notify_one();
 }
 
 bool Controller::NextBatch(double timeout_s, std::vector<Entry>* out) {
   out->clear();
   std::unique_lock<std::mutex> lk(ready_mu_);
-  if (!ready_cv_.wait_for(
-          lk, std::chrono::duration<double>(timeout_s),
+  // system_clock wait_until, not wait_for: libstdc++ lowers
+  // steady-clock waits to pthread_cond_clockwait, which this
+  // toolchain's ThreadSanitizer cannot see through (phantom
+  // double-lock reports in the TSAN stress). A clock step stretches
+  // one timeout; the caller re-polls, so that is harmless.
+  if (!ready_cv_.wait_until(
+          lk,
+          std::chrono::system_clock::now() +
+              std::chrono::microseconds(
+                  static_cast<int64_t>(timeout_s * 1e6)),
           [&] { return !ready_.empty() || shutdown_.load(); }))
     return true;  // timeout: empty batch, caller re-polls
   if (ready_.empty()) return false;  // shutdown
@@ -268,23 +327,86 @@ int Controller::AllJoined() {
 // --------------------------------------------------------------------------
 // cycle loop (all ranks): drain local queue, feed the coordinator
 // (reference: BackgroundThreadLoop / RunLoopOnce)
+//
+// Round-9 pacing model: the ROOT keeps the cycle_time_ms cadence
+// (batch cuts, quiescence, and stall checks are defined in cycles);
+// every other rank is event-driven — it sleeps until a Submit/Join
+// or (aggregators) a child frame wakes it, then drains and forwards
+// immediately. Idle ranks cost ZERO wakeups; at 1024 simulated ranks
+// the old 1 ms sleep-poll per rank was ~1e6 wakeups/s of scheduler
+// load on the stress host, drowning the protocol (see
+// benchmarks/control_plane_scale.md round 9).
 // --------------------------------------------------------------------------
 
 void Controller::CycleLoop() {
+  const bool paced = (opts_.rank == 0);
+  const bool aggregator = (opts_.rank != 0 && !children_set_.empty());
   while (!shutdown_.load()) {
     std::vector<Request> mine;
     {
-      std::lock_guard<std::mutex> lk(submit_mu_);
-      mine.swap(pending_);
-    }
-    if (!mine.empty()) {
-      if (opts_.rank == 0 || opts_.size == 1) {
-        CoordinatorIngest(0, std::move(mine));
+      std::unique_lock<std::mutex> lk(submit_mu_);
+      if (paced) {
+        // system_clock wait_until, NOT wait_for: libstdc++ lowers
+        // steady-clock waits to pthread_cond_clockwait, which this
+        // toolchain's ThreadSanitizer does not intercept (it then
+        // misses the unlock inside the wait and reports phantom
+        // double-locks/races). An NTP step can stretch or shrink ONE
+        // pacing tick; the loop re-checks, so that is harmless.
+        cycle_cv_.wait_until(
+            lk,
+            std::chrono::system_clock::now() +
+                std::chrono::microseconds(static_cast<int64_t>(
+                    cycle_time_ms_.load() * 1000.0)),
+            [&] { return shutdown_.load(); });
       } else {
-        std::string payload = SerializeRequests(mine);
+        cycle_cv_.wait(lk, [&] {
+          return shutdown_.load() || !pending_.empty() || agg_wake_;
+        });
+      }
+      if (shutdown_.load()) return;
+      mine.swap(pending_);
+      agg_wake_ = false;
+    }
+    if (aggregator && opts_.agg_linger_us > 0) {
+      // Aggregation window: hold the forward until every CONNECTED
+      // child has reported since the last one (the steady-state
+      // submission storm then goes upward as exactly ONE merged
+      // frame per tier per burst), capped at agg_linger_us so a
+      // quiet child cannot delay its siblings' negotiation.
+      // (system_clock for the same TSAN-interception reason as the
+      // paced wait above.)
+      auto deadline = std::chrono::system_clock::now() +
+                      std::chrono::microseconds(opts_.agg_linger_us);
+      std::unique_lock<std::mutex> lk(submit_mu_);
+      cycle_cv_.wait_until(lk, deadline, [&] {
+        return shutdown_.load() || AllChildrenReported();
+      });
+      for (auto& r : pending_) mine.push_back(std::move(r));
+      pending_.clear();
+      agg_wake_ = false;
+    }
+    if (opts_.rank == 0 || opts_.size == 1) {
+      if (!mine.empty()) {
+        WorkTimer wt(&work_ns_);
+        CoordinatorIngest(0, std::move(mine));
+      }
+    } else if (aggregator) {
+      // Merge own submissions with the children's folded frames into
+      // ONE upward kReadyAgg (rank-attributed bitsets; tree.h).
+      WorkTimer wt(&work_ns_);
+      AggMap out;
+      {
+        std::lock_guard<std::mutex> alk(agg_mu_);
+        out.swap(agg_pending_);
+        agg_reported_ = RankSet(0, opts_.size);
+      }
+      for (auto& r : mine)
+        MergeRequest(&out, opts_.size, opts_.rank, r);
+      if (!out.empty()) {
+        std::string payload = SerializeAgg(out);
         control_bytes_sent_.fetch_add(
             static_cast<int64_t>(payload.size()));
-        if (!SendMsg(coord_fd_, MsgType::kReady, payload) &&
+        if (!SendMsg(coord_fd_, MsgType::kReadyAgg, payload) &&
             !shutdown_.load()) {
           HVD_LOG(kError, "lost connection to controller");
           SetError("lost connection to controller");
@@ -292,17 +414,65 @@ void Controller::CycleLoop() {
           return;
         }
       }
+    } else if (!mine.empty()) {
+      std::string payload = SerializeRequests(mine);
+      control_bytes_sent_.fetch_add(
+          static_cast<int64_t>(payload.size()));
+      if (!SendMsg(coord_fd_, MsgType::kReady, payload) &&
+          !shutdown_.load()) {
+        HVD_LOG(kError, "lost connection to controller");
+        SetError("lost connection to controller");
+        Abort();  // never Shutdown() from our own thread
+        return;
+      }
     }
     if (opts_.rank == 0) RunCoordinatorCycle();
     cycles_.fetch_add(1);
-    std::this_thread::sleep_for(std::chrono::duration<double>(
-        cycle_time_ms_.load() / 1000.0));
   }
 }
 
 // --------------------------------------------------------------------------
 // coordinator (rank 0)
 // --------------------------------------------------------------------------
+
+Controller::TensorState& Controller::UpsertTensor(
+    const std::string& name, const std::string& sig, int64_t nbytes,
+    int reporting_rank, double now) {
+  auto it = tensors_.find(name);
+  if (it == tensors_.end()) {
+    TensorState st;
+    // Consistency is checked WITHIN a negotiation round only:
+    // re-submitting a name with new metadata next round (e.g. a
+    // changed prescale from dynamic loss scaling) renegotiates
+    // cleanly, like the reference's ResponseCache miss path.
+    st.sig = sig;
+    st.nbytes = nbytes;
+    st.first_seen = now;
+    st.ready_ranks = RankSet(0, opts_.size);
+    it = tensors_.emplace(name, std::move(st)).first;
+  } else if (it->second.sig != sig && it->second.error.empty()) {
+    it->second.error =
+        "tensor '" + name +
+        "' has mismatched signatures across ranks: '" +
+        it->second.sig + "' vs rank " +
+        std::to_string(reporting_rank) + "'s '" + sig + "'";
+  }
+  return it->second;
+}
+
+void Controller::MarkReady(const std::string& name, TensorState& st,
+                           double now) {
+  // Ready when every non-joined rank has submitted. Joined ranks
+  // still execute the collective (SPMD requires all participants)
+  // with zero contributions, decided Python-side.
+  size_t needed =
+      static_cast<size_t>(opts_.size) - joined_ranks_.size();
+  if (st.fully_ready_at == 0.0 &&
+      static_cast<size_t>(st.ready_ranks.count()) >= needed) {
+    st.fully_ready_at = now;
+    ready_order_.push_back(name);
+  }
+}
 
 void Controller::CoordinatorIngest(int rank, std::vector<Request> reqs) {
   std::lock_guard<std::mutex> lk(coord_mu_);
@@ -326,59 +496,102 @@ void Controller::CoordinatorIngest(int rank, std::vector<Request> reqs) {
       if (joined_ranks_.insert(rank).second) last_joined_rank_ = rank;
       continue;
     }
-    auto it = tensors_.find(r.name);
-    if (it == tensors_.end()) {
-      TensorState st;
-      // Consistency is checked WITHIN a negotiation round only:
-      // re-submitting a name with new metadata next round (e.g. a
-      // changed prescale from dynamic loss scaling) renegotiates
-      // cleanly, like the reference's ResponseCache miss path.
-      st.sig = r.sig;
-      st.nbytes = r.nbytes;
-      st.first_seen = now;
-      st.ready_ranks.insert(rank);
-      if (!r.meta.empty()) st.metas[rank] = r.meta;
-      tensors_.emplace(r.name, std::move(st));
-    } else {
-      TensorState& st = it->second;
-      if (st.sig != r.sig && st.error.empty()) {
-        st.error = "tensor '" + r.name +
-                   "' has mismatched signatures across ranks: '" +
-                   st.sig + "' vs rank " + std::to_string(rank) +
-                   "'s '" + r.sig + "'";
-      }
-      st.ready_ranks.insert(rank);
-      if (!r.meta.empty()) st.metas[rank] = r.meta;
-    }
-    TensorState& st = tensors_[r.name];
-    // Ready when every non-joined rank has submitted. Joined ranks
-    // still execute the collective (SPMD requires all participants)
-    // with zero contributions, decided Python-side.
-    size_t needed = static_cast<size_t>(opts_.size) - joined_ranks_.size();
-    bool was_ready = st.fully_ready_at > 0.0;
-    if (!was_ready && st.ready_ranks.size() >= needed) {
-      st.fully_ready_at = now;
-      ready_order_.push_back(r.name);
-    }
+    TensorState& st = UpsertTensor(r.name, r.sig, r.nbytes, rank, now);
+    st.ready_ranks.set(rank);
+    if (!r.meta.empty()) st.metas[rank] = r.meta;
+    MarkReady(r.name, st, now);
   }
+}
+
+void Controller::CoordinatorIngestAgg(std::vector<AggEntry> entries) {
+  // Tree mode: a child aggregator's merged frame — each entry is one
+  // announcement with a rank BITSET instead of one frame per rank.
+  // Root-side work per burst is O(distinct tensors x arity), not
+  // O(world): the unions are word-ops on dense sets.
+  std::lock_guard<std::mutex> lk(coord_mu_);
+  double now = NowSeconds();
+  for (auto& e : entries) {
+    if (e.ranks.lo() < 0 || e.ranks.hi() > opts_.size ||
+        e.ranks.count() == 0) {
+      HVD_LOG(kWarning, "dropping malformed agg entry (ranks [%d,%d))",
+              e.ranks.lo(), e.ranks.hi());
+      continue;
+    }
+    if (e.cache_id != 0) {
+      auto ct = coord_cache_.find(e.cache_id);
+      if (ct == coord_cache_.end()) {
+        HVD_LOG(kWarning, "agg frame carries unknown cache id %u",
+                e.cache_id);
+        continue;
+      }
+      e.name = ct->second.name;
+      e.sig = ct->second.sig;
+      e.nbytes = ct->second.nbytes;
+    }
+    if (e.join) {
+      e.ranks.ForEach([&](int r) {
+        if (joined_ranks_.insert(r).second) last_joined_rank_ = r;
+      });
+      continue;
+    }
+    int first_rank = -1;
+    e.ranks.ForEach([&](int r) {
+      if (first_rank < 0) first_rank = r;
+    });
+    TensorState& st =
+        UpsertTensor(e.name, e.sig, e.nbytes, first_rank, now);
+    st.ready_ranks.OrWith(e.ranks);
+    for (auto& kv : e.metas) st.metas[kv.first] = std::move(kv.second);
+    MarkReady(e.name, st, now);
+  }
+}
+
+// --- aggregator side (tree mode, non-root ranks with children) ------------
+
+void Controller::WakeCycleForAgg() {
+  {
+    std::lock_guard<std::mutex> lk(submit_mu_);
+    agg_wake_ = true;
+  }
+  cycle_cv_.notify_one();
+}
+
+void Controller::MergeChildRequests(int rank, std::vector<Request> reqs) {
+  {
+    std::lock_guard<std::mutex> lk(agg_mu_);
+    for (auto& r : reqs) MergeRequest(&agg_pending_, opts_.size, rank, r);
+    agg_reported_.set(rank);
+  }
+  WakeCycleForAgg();
+}
+
+void Controller::MergeChildAgg(int rank, std::vector<AggEntry> entries) {
+  {
+    std::lock_guard<std::mutex> lk(agg_mu_);
+    for (auto& e : entries)
+      if (!MergeAgg(&agg_pending_, opts_.size, e))
+        HVD_LOG(kWarning, "dropping malformed agg entry from child");
+    agg_reported_.set(rank);
+  }
+  WakeCycleForAgg();
+}
+
+bool Controller::AllChildrenReported() {
+  std::lock_guard<std::mutex> lk(agg_mu_);
+  return agg_reported_.count() >= connected_children_.load();
 }
 
 void Controller::RunCoordinatorCycle() {
   std::vector<Entry> out;
   {
+    // Work accounting scoped to the cut itself; BroadcastEntries'
+    // fan-out is timed inside EnqueueToWorkers (no double count).
+    WorkTimer wt(&work_ns_);
     std::lock_guard<std::mutex> lk(coord_mu_);
     double now = NowSeconds();
     // Re-check readiness: a rank joining can make earlier tensors
     // eligible (their missing submitters are gone).
-    size_t needed =
-        static_cast<size_t>(opts_.size) - joined_ranks_.size();
-    for (auto& kv : tensors_) {
-      TensorState& st = kv.second;
-      if (st.fully_ready_at == 0.0 && st.ready_ranks.size() >= needed) {
-        st.fully_ready_at = now;
-        ready_order_.push_back(kv.first);
-      }
-    }
+    for (auto& kv : tensors_) MarkReady(kv.first, kv.second, now);
     // Quiescence gate (see SetQuiescence): while the fully-ready set
     // is still growing, hold the cut so a submission storm agrees as
     // ONE stable-composition batch — unless some single fuse key has
@@ -526,7 +739,7 @@ void Controller::CheckStalls(double now) {
     if (waited > opts_.stall_warn_s) {
       std::ostringstream missing;
       for (int r = 0; r < opts_.size; ++r) {
-        if (!st.ready_ranks.count(r) && !joined_ranks_.count(r))
+        if (!st.ready_ranks.test(r) && !joined_ranks_.count(r))
           missing << r << " ";
       }
       HVD_LOG(kWarning,
@@ -556,6 +769,7 @@ void Controller::BroadcastEntries(const std::vector<Entry>& entries) {
 }
 
 void Controller::EnqueueToWorkers(const std::string& frame) {
+  WorkTimer wt(&work_ns_);
   // Only CONNECTED workers receive this broadcast (same semantics as
   // the old direct loop): a rank that connects later re-announces and
   // renegotiates, it must not replay batches it never took part in.
@@ -577,7 +791,7 @@ void Controller::EnqueueToWorkers(const std::string& frame) {
   std::vector<int> severed;
   {
     std::lock_guard<std::mutex> lk(pump_mu_);
-    for (int r = 1; r < static_cast<int>(fds.size()); ++r) {
+    for (int r : place_.children) {
       if (fds[r] < 0) continue;
       if (pump_buf_[r].size() + pump_inflight_[r] + frame.size() >
           kPumpCap) {
@@ -632,30 +846,35 @@ void Controller::PumpLoop() {
   // flushes what it can within a bounded window, then severs the
   // worker fds (which unblocks their reader threads).
   constexpr double kFlushWindowS = 2.0;
-  const int n = static_cast<int>(pump_buf_.size());
+  // Children only (in the flat star that is every rank but 0; in
+  // tree mode, this node's direct subtree roots).
+  const std::vector<int>& kids = place_.children;
+  const int n = static_cast<int>(kids.size());
   double shutdown_seen_at = 0.0;
   std::string local;
-  int rr = 1;                      // next rank to consider
+  int rr = 0;                      // next child INDEX to consider
   int stall_anchor = -1;           // first rank of a no-progress run
   while (true) {
     int r_next = -1;
     {
       std::unique_lock<std::mutex> lk(pump_mu_);
-      for (int k = 0; k < n - 1; ++k) {
-        int r = 1 + (rr - 1 + k) % (n - 1);
-        if (!pump_buf_[r].empty()) { r_next = r; break; }
+      for (int k = 0; k < n; ++k) {
+        int r = kids[(rr + k) % n];
+        if (!pump_buf_[r].empty()) { r_next = r; rr = (rr + k) % n;
+                                     break; }
       }
       if (r_next < 0) {
         if (shutdown_.load()) break;  // fully drained
         stall_anchor = -1;
-        pump_cv_.wait_for(lk, std::chrono::milliseconds(50));
+        pump_cv_.wait_until(lk, std::chrono::system_clock::now() +
+                                    std::chrono::milliseconds(50));
         continue;
       }
       local.clear();
       local.swap(pump_buf_[r_next]);
       pump_inflight_[r_next] = local.size();
     }
-    rr = (r_next % (n - 1)) + 1;   // resume AFTER this rank
+    rr = (rr + 1) % n;             // resume AFTER this child
     if (shutdown_.load()) {
       if (shutdown_seen_at == 0.0) shutdown_seen_at = NowSeconds();
       if (NowSeconds() - shutdown_seen_at > kFlushWindowS) {
@@ -708,7 +927,8 @@ void Controller::PumpLoop() {
         // spinning on EAGAIN (with ONE stuck rank this sleeps after
         // a single futile revisit, not after n-1 of them).
         stall_anchor = -1;
-        pump_cv_.wait_for(lk, std::chrono::milliseconds(1));
+        pump_cv_.wait_until(lk, std::chrono::system_clock::now() +
+                                    std::chrono::milliseconds(1));
       } else if (stall_anchor < 0) {
         stall_anchor = r_next;
       }
@@ -820,7 +1040,11 @@ void Controller::HandshakeConn(int fd) {
   rd.GetU32(&rank);
   rd.GetStr(&worker_nonce);
   rd.GetStr(&mac);
-  if (rank == 0 || rank >= static_cast<uint32_t>(opts_.size)) {
+  if (rank == 0 || rank >= static_cast<uint32_t>(opts_.size) ||
+      !children_set_.count(static_cast<int>(rank))) {
+    // In tree mode only this node's DIRECT children may attach here;
+    // a rank claiming someone else's slot (misconfigured parent
+    // address) is rejected before it can claim a slot.
     ::close(fd);
     return;
   }
@@ -859,23 +1083,47 @@ void Controller::HandshakeConn(int fd) {
     std::lock_guard<std::mutex> lk(coord_mu_);
     worker_fds_[rank] = fd;
   }
+  connected_children_.fetch_add(1);
   HVD_LOG(kDebug, "rank %u connected", rank);
   // This thread is now the rank's reader.
   ReaderLoop(static_cast<int>(rank), fd);
 }
 
 void Controller::ReaderLoop(int rank, int fd) {
+  // Parent side of a child connection: the root ingests directly;
+  // an aggregator folds the child's announcements into its own
+  // upward frame. A child disconnect ends only THIS loop — the rest
+  // of the subtree (and every other subtree) keeps negotiating,
+  // which is what bounds a failure's blast radius to its own branch.
   MsgType t;
   std::string payload;
+  const bool root = opts_.rank == 0;
   while (!shutdown_.load() && RecvMsg(fd, &t, &payload)) {
     if (t == MsgType::kReady) {
+      WorkTimer wt(&work_ns_);
+      frames_in_.fetch_add(1);
       std::vector<Request> reqs;
-      if (ParseRequests(payload, &reqs))
-        CoordinatorIngest(rank, std::move(reqs));
+      if (ParseRequests(payload, &reqs)) {
+        if (root)
+          CoordinatorIngest(rank, std::move(reqs));
+        else
+          MergeChildRequests(rank, std::move(reqs));
+      }
+    } else if (t == MsgType::kReadyAgg) {
+      WorkTimer wt(&work_ns_);
+      frames_in_.fetch_add(1);
+      std::vector<AggEntry> entries;
+      if (ParseAgg(payload, &entries)) {
+        if (root)
+          CoordinatorIngestAgg(std::move(entries));
+        else
+          MergeChildAgg(rank, std::move(entries));
+      }
     } else if (t == MsgType::kShutdown) {
       break;
     }
   }
+  connected_children_.fetch_sub(1);
   if (!shutdown_.load())
     HVD_LOG(kDebug, "rank %d control connection closed", rank);
 }
@@ -887,7 +1135,15 @@ void Controller::WorkerReaderLoop() {
   while (!shutdown_.load() && RecvMsg(coord_fd_, &t, &payload)) {
     if (t == MsgType::kResponses) {
       std::vector<Entry> entries;
-      if (ParseEntries(payload, &entries)) DeliverEntries(entries);
+      if (ParseEntries(payload, &entries)) {
+        // Tree mode: relay the agreed batch down this subtree FIRST
+        // (one re-framed memcpy + the pump's non-blocking sends —
+        // the deeper tiers' latency rides on it), then deliver
+        // locally.
+        if (!children_set_.empty())
+          EnqueueToWorkers(BuildFrame(MsgType::kResponses, payload));
+        DeliverEntries(entries);
+      }
     } else if (t == MsgType::kShutdown) {
       clean = true;
       break;

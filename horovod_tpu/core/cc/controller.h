@@ -43,6 +43,7 @@
 #include <vector>
 
 #include "common.h"
+#include "tree.h"
 #include "wire.h"
 
 namespace hvdtpu {
@@ -69,6 +70,21 @@ struct ControllerOptions {
   // batches. Empty = unauthenticated (single-user runs without a
   // launcher secret), matching runner/secret.py verify() semantics.
   std::string auth_secret;
+  // Hierarchical control tree (HOROVOD_CONTROL_TREE_ARITY; tree.h):
+  // < 2 keeps the flat star. With a tree, non-root ranks connect to
+  // their TreePlaceOf parent instead of rank 0, aggregator ranks
+  // (those with children) listen for their subtree on listen_port,
+  // merge readiness bitsets upward (kReadyAgg) and relay agreed
+  // batches downward through the same broadcast pump the root uses.
+  int tree_arity = 0;
+  std::string parent_host;  // empty = coord_host
+  int parent_port = 0;      // 0 = coord_port (the flat default)
+  int listen_port = 0;      // aggregator ranks only (root: coord_port)
+  // Aggregation window: after the first upward wake an aggregator
+  // lingers this long so sibling subtrees' frames land in the SAME
+  // forwarded frame (one kReadyAgg per tier per burst instead of one
+  // per child). 0 forwards eagerly.
+  int agg_linger_us = 200;
 };
 
 // Sentinel entry name broadcast when every rank has joined
@@ -124,6 +140,20 @@ class Controller {
   // Control-plane bytes this rank sent for ready announcements —
   // observable proof the response cache shrinks steady-state traffic.
   int64_t control_bytes_sent() const { return control_bytes_sent_; }
+  // This rank's control-tree tier: 0 = root/coordinator, 1 = attached
+  // directly to it (every worker in the flat star), 2+ = below an
+  // aggregator. Surfaces in Python as the hvd_control_tree_depth
+  // gauge and on NEGOTIATE trace spans.
+  int tree_tier() const { return place_.tier; }
+  // Per-NODE control-plane accounting: CPU nanoseconds this node
+  // spent doing coordinator/aggregator work (ingest + merge + cut +
+  // fan-out enqueue) and upward/child frames it ingested. This is
+  // the number the hierarchical tree exists to bound: on a pod each
+  // node owns its own core, so the per-node work — not the
+  // shared-core gang wall-clock a 1-core stress host measures — is
+  // what must stay under the cycle budget as the world grows.
+  int64_t control_work_ns() const { return work_ns_.load(); }
+  int64_t frames_ingested() const { return frames_in_.load(); }
 
  private:
   void CycleLoop();
@@ -135,6 +165,19 @@ class Controller {
   void Abort();
   void SetError(const std::string& msg);
   void CoordinatorIngest(int rank, std::vector<Request> reqs);
+  void CoordinatorIngestAgg(std::vector<AggEntry> entries);
+  struct TensorState;
+  // Shared ingest helpers (coord_mu_ held by the caller).
+  TensorState& UpsertTensor(const std::string& name,
+                            const std::string& sig, int64_t nbytes,
+                            int reporting_rank, double now);
+  void MarkReady(const std::string& name, TensorState& st, double now);
+  // Aggregator side: fold a child's frame into agg_pending_ and wake
+  // the cycle thread to forward it upward.
+  void MergeChildRequests(int rank, std::vector<Request> reqs);
+  void MergeChildAgg(int rank, std::vector<AggEntry> entries);
+  void WakeCycleForAgg();
+  bool AllChildrenReported();
   void RunCoordinatorCycle();
   void BroadcastEntries(const std::vector<Entry>& entries);
   void DeliverEntries(const std::vector<Entry>& entries);
@@ -155,9 +198,40 @@ class Controller {
   std::atomic<int64_t> cycles_{0};
   std::atomic<int64_t> control_bytes_sent_{0};
 
+  // --- tree placement (flat star when tree_arity < 2) ---
+  TreePlace place_;
+  std::set<int> children_set_;  // fast membership for handshakes
+
   // --- frontend pending queue (reference: TensorQueue) ---
+  //
+  // cycle_cv_ (round-9): the cycle threads are EVENT-DRIVEN, not
+  // sleep-polled. The old 1 ms sleep per rank per cycle meant N
+  // idle wakeups/ms across an N-rank gang — pure scheduler load that
+  // dominated the measured agreement latency well before protocol
+  // work did (the 128-worker wall in control_plane_scale.md). Now
+  // workers/aggregators block until Submit/Join or child data wakes
+  // them (idle ranks cost zero wakeups); ONLY the root keeps the
+  // cycle_time_ms pacing, which is what preserves fusion batching
+  // and quiescence semantics (a cut still collects everything that
+  // arrived in the window).
   std::mutex submit_mu_;
+  std::condition_variable cycle_cv_;
+  bool agg_wake_ = false;  // child data pending (under submit_mu_)
   std::vector<Request> pending_;
+
+  // --- aggregator merge state (non-root ranks with children) ---
+  std::mutex agg_mu_;
+  AggMap agg_pending_;
+  // Direct children that have reported since the last upward
+  // forward: when every CONNECTED child has, the cycle forwards
+  // immediately (steady state = exactly one merged frame per tier
+  // per burst); otherwise the agg_linger_us cap bounds the wait.
+  RankSet agg_reported_;
+  std::atomic<int> connected_children_{0};
+
+  // --- per-node control-plane accounting (see control_work_ns) ---
+  std::atomic<int64_t> work_ns_{0};
+  std::atomic<int64_t> frames_in_{0};
 
   // --- response cache, worker side (reference: response_cache.cc) ---
   // name -> (coordinator-assigned id, signature). Populated from
@@ -180,7 +254,11 @@ class Controller {
   struct TensorState {
     std::string sig;
     int64_t nbytes = 0;
-    std::set<int> ready_ranks;
+    // Readiness as a dense bitset (tree.h RankSet): child
+    // aggregators' merged bitsets OR in at O(words), and the flat
+    // path's per-rank insert stops costing a red-black allocation
+    // per (tensor, rank) per cycle.
+    RankSet ready_ranks;
     std::map<int, std::string> metas;  // per-rank request metadata
     double first_seen = 0.0;
     double fully_ready_at = 0.0;
@@ -213,9 +291,13 @@ class Controller {
   int quiesce_stable_ = 0;
 
   // --- sockets ---
+  // "coordinator side" below means ANY node with children — the root
+  // in the flat star, the root plus every aggregator in tree mode
+  // (each tier reuses the same accept/handshake/pump machinery for
+  // its own subtree).
   int listen_fd_ = -1;
-  int coord_fd_ = -1;                 // worker->coordinator connection
-  std::vector<int> worker_fds_;       // coordinator: fd per rank (idx)
+  int coord_fd_ = -1;                 // upward connection (to parent)
+  std::vector<int> worker_fds_;       // fd per CHILD rank (idx = rank)
   // Severed-for-cap-breach fds: unlinked from worker_fds_ (so
   // broadcasts stop paying for the dead rank) but kept open until
   // Shutdown() — the pump may still hold the raw fd mid-write, and

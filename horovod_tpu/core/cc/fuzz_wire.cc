@@ -16,12 +16,18 @@
 #include <string>
 #include <vector>
 
+#include "tree.h"
 #include "wire.h"
 
+using hvdtpu::AggEntry;
+using hvdtpu::AggMap;
 using hvdtpu::Entry;
+using hvdtpu::MergeRequest;
+using hvdtpu::ParseAgg;
 using hvdtpu::ParseEntries;
 using hvdtpu::ParseRequests;
 using hvdtpu::Request;
+using hvdtpu::SerializeAgg;
 using hvdtpu::SerializeEntries;
 using hvdtpu::SerializeRequests;
 
@@ -72,6 +78,28 @@ std::string ValidEntries() {
   return SerializeEntries(es);
 }
 
+std::string ValidAgg() {
+  // Build through the same merge path the aggregators use, so the
+  // fuzzer covers the real serializer including rank bitsets and
+  // per-rank metas (tree.h kReadyAgg format).
+  AggMap m;
+  size_t n = rng() % 6;
+  for (size_t i = 0; i < n; ++i) {
+    Request r;
+    switch (rng() % 3) {
+      case 0: r.cache_id = static_cast<uint32_t>(rng() | 1); break;
+      case 1: r.join = true; break;
+      default:
+        r.name = RandomBytes(rng() % 40);
+        r.sig = RandomBytes(rng() % 40);
+        r.nbytes = static_cast<int64_t>(rng());
+        r.meta = RandomBytes(rng() % 20);
+    }
+    MergeRequest(&m, 1024, static_cast<int>(rng() % 1024), r);
+  }
+  return SerializeAgg(m);
+}
+
 void Mutate(std::string* s) {
   if (s->empty()) return;
   switch (rng() % 4) {
@@ -102,14 +130,16 @@ int main(int argc, char** argv) {
   long iters = argc > 1 ? atol(argv[1]) : 20000;
   std::vector<Request> reqs;
   std::vector<Entry> es;
+  std::vector<AggEntry> aggs;
   long accepted = 0;
   for (long i = 0; i < iters; ++i) {
     std::string buf;
-    switch (i % 4) {
+    switch (i % 5) {
       case 0: buf = RandomBytes(rng() % 256); break;
       case 1: buf = ValidRequests(); Mutate(&buf); break;
       case 2: buf = ValidEntries(); Mutate(&buf); break;
-      case 3: {  // adversarial header: huge declared count, tiny body
+      case 3: buf = ValidAgg(); Mutate(&buf); break;
+      case 4: {  // adversarial header: huge declared count, tiny body
         hvdtpu::Buf b;
         b.PutU32(0xffffffffu);
         buf = b.data() + RandomBytes(rng() % 16);
@@ -118,6 +148,7 @@ int main(int argc, char** argv) {
     }
     if (ParseRequests(buf, &reqs)) accepted++;
     if (ParseEntries(buf, &es)) accepted++;
+    if (ParseAgg(buf, &aggs)) accepted++;
     // Round-trips of untouched valid data must always parse.
     if (i % 100 == 0) {
       std::string v = ValidRequests();
@@ -128,6 +159,11 @@ int main(int argc, char** argv) {
       v = ValidEntries();
       if (!ParseEntries(v, &es)) {
         fprintf(stderr, "valid Entries failed to parse\n");
+        return 1;
+      }
+      v = ValidAgg();
+      if (!ParseAgg(v, &aggs)) {
+        fprintf(stderr, "valid AggEntries failed to parse\n");
         return 1;
       }
     }

@@ -16,9 +16,17 @@
 //      over many rounds.
 //
 // Usage: stress_scale <workers> [rounds] [tensors_per_round]
+//                     [--tree[=ARITY]]
+// --tree builds the hierarchical control plane (tree.h; default
+// arity 32): non-root ranks attach to their TreePlaceOf parent,
+// aggregator ranks listen on their own loopback port, merge
+// readiness bitsets upward and relay agreed batches downward — the
+// flat-vs-tree A/B this binary exists to measure at 256/512/1024
+// simulated ranks (benchmarks/control_plane_scale.md round 9).
 // Prints ONE JSON line:
-//   {"workers":N,"connect_s":...,"round_p50_ms":...,"round_p95_ms":
-//    ...,"rounds":R,"tensors":T}
+//   {"workers":N,"mode":"flat|tree","arity":A,"depth":D,
+//    "connect_s":...,"round_p50_ms":...,"round_p95_ms":...,
+//    "rounds":R,"tensors":T}
 // Exits non-zero on any controller error or order divergence.
 
 #include <pthread.h>
@@ -52,22 +60,73 @@ using hvdtpu_stress::now_s;
 }  // namespace
 
 int main(int argc, char** argv) {
-  const int n = argc > 1 ? atoi(argv[1]) : 32;
-  const int rounds = argc > 2 ? atoi(argv[2]) : 50;
-  const int tensors = argc > 3 ? atoi(argv[3]) : 8;
+  int n = 32, rounds = 50, tensors = 8, arity = 0, pos = 0;
+  int linger_us = 200;
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    if (a.rfind("--tree", 0) == 0) {
+      auto eq = a.find('=');
+      arity = eq == std::string::npos ? 32 : atoi(a.c_str() + eq + 1);
+      if (arity < 2) {
+        fprintf(stderr, "--tree arity must be >= 2\n");
+        return 2;
+      }
+      continue;
+    }
+    if (a.rfind("--linger=", 0) == 0) {
+      linger_us = atoi(a.c_str() + 9);
+      continue;
+    }
+    int v = atoi(a.c_str());
+    if (pos == 0) n = v;
+    else if (pos == 1) rounds = v;
+    else if (pos == 2) tensors = v;
+    ++pos;
+  }
   const std::string secret = "stress-scale-secret";
-  const int port = free_port();
+
+  // Tree placement + per-aggregator loopback ports. The probe
+  // sockets are held OPEN until every port is assigned — probing and
+  // closing one at a time lets the kernel hand the same ephemeral
+  // port out twice (observed at arity 64: two aggregators bound the
+  // same port and one rank died with 'failed to listen').
+  std::vector<hvdtpu::TreePlace> places(n);
+  std::vector<int> ports(n, 0);
+  {
+    std::vector<int> held;
+    for (int r = 0; r < n; ++r) {
+      places[r] = hvdtpu::TreePlaceOf(r, n, arity);
+      if (r == 0 || !places[r].children.empty()) {
+        int fd = socket(AF_INET, SOCK_STREAM, 0);
+        sockaddr_in addr{};
+        addr.sin_family = AF_INET;
+        addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+        addr.sin_port = 0;
+        bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr));
+        socklen_t len = sizeof(addr);
+        getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+        ports[r] = ntohs(addr.sin_port);
+        held.push_back(fd);
+      }
+    }
+    for (int fd : held) close(fd);
+  }
 
   auto mkopts = [&](int rank) {
     ControllerOptions o;
     o.rank = rank;
     o.size = n;
     o.coord_host = "127.0.0.1";
-    o.coord_port = port;
+    o.coord_port = ports[0];
     o.cycle_time_ms = 1.0;
     o.stall_warn_s = 60.0;
     o.connect_timeout_s = 60.0;
     o.auth_secret = secret;
+    o.tree_arity = arity;
+    o.listen_port = ports[rank];
+    o.agg_linger_us = linger_us;
+    if (places[rank].parent >= 0)
+      o.parent_port = ports[places[rank].parent];
     return o;
   };
 
@@ -114,6 +173,16 @@ int main(int argc, char** argv) {
   const double connect_s = now_s() - t0;
 
   // --- phase 2: steady-state agreement latency --------------------------
+  // Per-NODE work baseline (ns spent in ingest/merge/cut/fan-out
+  // since startup): the steady-state delta over the timed rounds is
+  // the number a real pod cares about — each node owns its core
+  // there, so per-node work, not this host's shared-core gang
+  // wall-clock, is what must stay under the cycle budget.
+  std::vector<long long> work0(n), frames0(n);
+  for (int r = 0; r < n; ++r) {
+    work0[r] = ctl[r]->control_work_ns();
+    frames0[r] = ctl[r]->frames_ingested();
+  }
   pthread_barrier_t barrier;
   pthread_barrier_init(&barrier, nullptr, n);
   std::vector<std::vector<double>> lat(n);
@@ -167,11 +236,31 @@ int main(int argc, char** argv) {
   const double p50 = worst[worst.size() / 2];
   const double p95 = worst[(worst.size() * 95) / 100];
 
+  // Per-node steady-state work: the root, the busiest non-root node
+  // (an aggregator in tree mode), and root frames ingested — all per
+  // round.
+  double root_work_ms =
+      (ctl[0]->control_work_ns() - work0[0]) / 1e6 / rounds;
+  double root_frames =
+      static_cast<double>(ctl[0]->frames_ingested() - frames0[0]) /
+      rounds;
+  double agg_work_ms = 0;
+  for (int r = 1; r < n; ++r)
+    agg_work_ms = std::max(
+        agg_work_ms,
+        (ctl[r]->control_work_ns() - work0[r]) / 1e6 / rounds);
+
   for (int r = 0; r < n; ++r) ctl[r]->Shutdown();
 
   printf(
-      "{\"workers\":%d,\"connect_s\":%.3f,\"round_p50_ms\":%.2f,"
-      "\"round_p95_ms\":%.2f,\"rounds\":%d,\"tensors\":%d}\n",
-      n, connect_s, p50, p95, rounds, tensors);
+      "{\"workers\":%d,\"mode\":\"%s\",\"arity\":%d,\"depth\":%d,"
+      "\"connect_s\":%.3f,\"round_p50_ms\":%.2f,"
+      "\"round_p95_ms\":%.2f,\"root_work_ms_per_round\":%.3f,"
+      "\"root_frames_per_round\":%.1f,"
+      "\"max_nonroot_work_ms_per_round\":%.3f,"
+      "\"rounds\":%d,\"tensors\":%d}\n",
+      n, arity >= 2 ? "tree" : "flat", arity,
+      hvdtpu::TreeDepthOf(n, arity), connect_s, p50, p95,
+      root_work_ms, root_frames, agg_work_ms, rounds, tensors);
   return 0;
 }

@@ -1,0 +1,400 @@
+// Unit suite for the hierarchical control plane (tree.h +
+// controller.cc tree mode), run by tests/test_scale_stress.py in
+// tier-1 (seconds, no sanitizers, loopback only):
+//
+//   1. topology invariants of TreePlaceOf/TreeDepthOf over a grid of
+//      (size, arity) — unique parents, consistent children/tiers,
+//      contiguous subtrees, depth == max tier;
+//   2. RankSet bitset semantics: set/test/count, word-aligned union,
+//      wire round-trip, malformed rejects;
+//   3. AggEntry merge: same-announcement dedup into one entry with a
+//      rank bitset, per-rank meta attribution, cache-id merging,
+//      join folding, serialize/parse round-trip;
+//   4. mini in-process trees over loopback: cross-tier metadata
+//      aggregation, a deep-tier signature mismatch becoming an error
+//      entry on EVERY rank (partial-tier failure propagates to the
+//      root and back down), and severing an aggregator's subtree
+//      leaving the remaining ranks negotiating (blast radius is the
+//      subtree, nothing more).
+//
+// Prints "TREE UNIT OK" and exits 0 on success; any failed CHECK
+// prints the site and exits 1.
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <memory>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "controller.h"
+#include "stress_common.h"
+#include "tree.h"
+
+using hvdtpu::AggEntry;
+using hvdtpu::AggMap;
+using hvdtpu::Controller;
+using hvdtpu::ControllerOptions;
+using hvdtpu::MergeAgg;
+using hvdtpu::MergeRequest;
+using hvdtpu::ParseAgg;
+using hvdtpu::RankSet;
+using hvdtpu::Request;
+using hvdtpu::SerializeAgg;
+using hvdtpu::TreeDepthOf;
+using hvdtpu::TreePlace;
+using hvdtpu::TreePlaceOf;
+
+#define CHECK(cond)                                                  \
+  do {                                                               \
+    if (!(cond)) {                                                   \
+      fprintf(stderr, "CHECK failed at %s:%d: %s\n", __FILE__,       \
+              __LINE__, #cond);                                      \
+      exit(1);                                                       \
+    }                                                                \
+  } while (0)
+
+static void TestTopology() {
+  const int sizes[] = {1, 2, 3, 4, 7, 8, 9, 33, 64, 100, 256, 1024};
+  const int arities[] = {0, 2, 3, 4, 8, 32, 1000};
+  for (int size : sizes) {
+    for (int arity : arities) {
+      std::vector<TreePlace> p(size);
+      int max_tier = 0;
+      for (int r = 0; r < size; ++r) {
+        p[r] = TreePlaceOf(r, size, arity);
+        if (p[r].tier > max_tier) max_tier = p[r].tier;
+      }
+      CHECK(p[0].parent == -1 && p[0].tier == 0);
+      CHECK(p[0].lo == 0 && p[0].hi == size);
+      int child_slots = 0;
+      for (int r = 0; r < size; ++r) {
+        // Children ascend, live inside the subtree, and agree that r
+        // is their parent, one tier down.
+        int prev = r;
+        for (int c : p[r].children) {
+          CHECK(c > prev && c < p[r].hi);
+          prev = c;
+          CHECK(p[c].parent == r);
+          CHECK(p[c].tier == p[r].tier + 1);
+          ++child_slots;
+        }
+        if (arity >= 2)
+          CHECK(static_cast<int>(p[r].children.size()) <= arity);
+        if (r > 0) {
+          // Subtree nesting: a rank's interval sits inside its
+          // parent's, past the parent itself.
+          CHECK(p[r].lo >= p[p[r].parent].lo + 1);
+          CHECK(p[r].hi <= p[p[r].parent].hi);
+          CHECK(p[r].lo <= r && r < p[r].hi);
+        }
+      }
+      // Every non-root rank is someone's child exactly once.
+      CHECK(child_slots == size - 1);
+      CHECK(TreeDepthOf(size, arity) == max_tier);
+    }
+  }
+}
+
+static void TestRankSet() {
+  RankSet s(0, 200);
+  CHECK(s.count() == 0 && !s.test(0));
+  CHECK(s.set(3) && s.set(64) && s.set(199));
+  CHECK(!s.set(3));              // idempotent
+  CHECK(!s.set(200) && !s.set(-1));  // out of range rejected
+  CHECK(s.count() == 3 && s.test(64) && !s.test(65));
+  std::vector<int> seen;
+  s.ForEach([&](int r) { seen.push_back(r); });
+  CHECK((seen == std::vector<int>{3, 64, 199}));
+
+  RankSet t(0, 200);
+  t.set(64);
+  t.set(70);
+  CHECK(s.OrWith(t));
+  CHECK(s.count() == 4 && s.test(70));
+  RankSet wide(0, 300);
+  wide.set(250);
+  CHECK(!s.OrWith(wide));  // does not fit -> rejected, unchanged
+  CHECK(s.count() == 4);
+
+  // Wire round-trip.
+  hvdtpu::Buf b;
+  s.PutTo(&b);
+  hvdtpu::Reader rd(b.data());
+  RankSet back;
+  CHECK(back.GetFrom(&rd));
+  CHECK(back == s && back.count() == 4);
+
+  // Malformed: truncated words, oversized widths, stray tail bits.
+  {
+    hvdtpu::Buf bad;
+    bad.PutU32(0);
+    bad.PutU32(128);  // claims 2 words, provides none
+    hvdtpu::Reader r2(bad.data());
+    RankSet x;
+    CHECK(!x.GetFrom(&r2));
+  }
+  {
+    hvdtpu::Buf bad;
+    bad.PutU32(0);
+    bad.PutU32(3);               // 3 bits
+    bad.PutU64(0xFFull);         // bits past nbits set
+    hvdtpu::Reader r2(bad.data());
+    RankSet x;
+    CHECK(!x.GetFrom(&r2));
+  }
+  {
+    hvdtpu::Buf bad;
+    bad.PutU32(0);
+    bad.PutU32(2u << 20);  // absurd width
+    hvdtpu::Reader r2(bad.data());
+    RankSet x;
+    CHECK(!x.GetFrom(&r2));
+  }
+}
+
+static Request Full(const std::string& name, const std::string& sig,
+                    int64_t nbytes, const std::string& meta = "") {
+  Request r;
+  r.name = name;
+  r.sig = sig;
+  r.nbytes = nbytes;
+  r.meta = meta;
+  return r;
+}
+
+static void TestMerge() {
+  const int world = 64;
+  AggMap m;
+  // Identical announcements from three ranks dedup into ONE entry
+  // with a rank bitset; per-rank metas stay attributed.
+  MergeRequest(&m, world, 3, Full("t", "f32|sum|#8", 32, "3"));
+  MergeRequest(&m, world, 5, Full("t", "f32|sum|#8", 32, "5"));
+  MergeRequest(&m, world, 9, Full("t", "f32|sum|#8", 32, "9"));
+  CHECK(m.size() == 1);
+  {
+    const AggEntry& e = m.begin()->second;
+    CHECK(e.ranks.count() == 3 && e.ranks.test(5));
+    CHECK(e.metas.size() == 3 && e.metas.at(9) == "9");
+  }
+  // A DIFFERENT sig for the same name must NOT merge — the root's
+  // cross-rank mismatch check needs to see both.
+  MergeRequest(&m, world, 7, Full("t", "f32|max|#8", 32, "7"));
+  CHECK(m.size() == 2);
+  // Cached announcements merge by id; joins fold into one entry.
+  Request c;
+  c.cache_id = 42;
+  MergeRequest(&m, world, 11, c);
+  MergeRequest(&m, world, 12, c);
+  Request j;
+  j.join = true;
+  MergeRequest(&m, world, 13, j);
+  MergeRequest(&m, world, 14, j);
+  CHECK(m.size() == 4);
+
+  // Wire round-trip, then re-merge into a parent map (tier 2 -> 1).
+  std::string wire = SerializeAgg(m);
+  std::vector<AggEntry> parsed;
+  CHECK(ParseAgg(wire, &parsed));
+  CHECK(parsed.size() == m.size());
+  AggMap up;
+  for (const auto& e : parsed) CHECK(MergeAgg(&up, world, e));
+  CHECK(up.size() == m.size());
+  int join_ranks = 0, cached = 0;
+  for (const auto& kv : up) {
+    if (kv.second.join) join_ranks = kv.second.ranks.count();
+    if (kv.second.cache_id == 42) cached = kv.second.ranks.count();
+  }
+  CHECK(join_ranks == 2 && cached == 2);
+  // An entry whose rank interval exceeds the world is rejected.
+  AggEntry bad;
+  bad.name = "x";
+  bad.sig = "s";
+  bad.ranks = RankSet(0, world + 64);
+  bad.ranks.set(world + 1);
+  CHECK(!MergeAgg(&up, world, bad));
+  // Truncated wire bytes are rejected, not misparsed.
+  for (size_t cut = 1; cut < wire.size(); cut += 7) {
+    std::vector<AggEntry> out;
+    ParseAgg(wire.substr(0, wire.size() - cut), &out);  // must not crash
+  }
+}
+
+// --- mini end-to-end trees over loopback ----------------------------------
+
+struct MiniTree {
+  int n;
+  std::vector<std::unique_ptr<Controller>> ctl;
+
+  MiniTree(int n_, int arity, const std::string& secret) : n(n_) {
+    std::vector<TreePlace> places(n);
+    std::vector<int> ports(n, 0);
+    for (int r = 0; r < n; ++r) {
+      places[r] = TreePlaceOf(r, n, arity);
+      if (r == 0 || !places[r].children.empty())
+        ports[r] = hvdtpu_stress::free_port();
+    }
+    ctl.resize(n);
+    auto mk = [&](int rank) {
+      ControllerOptions o;
+      o.rank = rank;
+      o.size = n;
+      o.coord_host = "127.0.0.1";
+      o.coord_port = ports[0];
+      o.cycle_time_ms = 1.0;
+      o.stall_warn_s = 60.0;
+      o.connect_timeout_s = 30.0;
+      o.auth_secret = secret;
+      o.tree_arity = arity;
+      o.listen_port = ports[rank];
+      if (places[rank].parent >= 0)
+        o.parent_port = ports[places[rank].parent];
+      return o;
+    };
+    ctl[0] = std::make_unique<Controller>(mk(0));
+    std::vector<std::thread> ctors;
+    for (int r = 1; r < n; ++r)
+      ctors.emplace_back(
+          [&, r] { ctl[r] = std::make_unique<Controller>(mk(r)); });
+    for (auto& t : ctors) t.join();
+    for (int r = 0; r < n; ++r) CHECK(ctl[r]->ok());
+  }
+};
+
+static void TestTreeMetaAggregation() {
+  MiniTree tree(7, 2, "tree-unit");
+  // Every rank announces the same generic op with per-rank metadata;
+  // the agreed entry's meta must come back ';'-joined by WORLD rank
+  // on every rank — tier-2 metas crossed two aggregation hops.
+  std::vector<std::thread> th;
+  std::atomic<bool> fail{false};
+  for (int r = 0; r < tree.n; ++r)
+    th.emplace_back([&, r] {
+      tree.ctl[r]->Submit("meta_op", "g|meta_op#", 4,
+                          "m" + std::to_string(r));
+      std::vector<hvdtpu::Entry> got;
+      int have = 0;
+      while (have < 1) {
+        std::vector<hvdtpu::Entry> batch;
+        if (!tree.ctl[r]->NextBatch(5.0, &batch)) {
+          fail = true;
+          return;
+        }
+        for (auto& e : batch)
+          if (e.name == "meta_op") {
+            got.push_back(e);
+            ++have;
+          }
+      }
+      if (got[0].meta != "m0;m1;m2;m3;m4;m5;m6") fail = true;
+      if (!got[0].error.empty()) fail = true;
+    });
+  for (auto& t : th) t.join();
+  CHECK(!fail);
+  for (auto& c : tree.ctl) c->Shutdown();
+}
+
+static void TestDeepTierMismatchPropagates() {
+  MiniTree tree(7, 2, "tree-unit");
+  // Rank at the DEEPEST tier submits a conflicting signature: the
+  // partial-tier failure must surface as the same error entry on
+  // every rank (root detected it from two merged agg entries that
+  // refused to fuse), not as a hang and not as a subtree-local view.
+  int deep = -1;
+  for (int r = 0; r < tree.n; ++r)
+    if (TreePlaceOf(r, tree.n, 2).tier == TreeDepthOf(tree.n, 2))
+      deep = r;
+  CHECK(deep > 0);
+  std::vector<std::thread> th;
+  std::atomic<int> errors{0};
+  for (int r = 0; r < tree.n; ++r)
+    th.emplace_back([&, r] {
+      const char* sig = r == deep ? "f32|max|#8" : "f32|sum|#8";
+      tree.ctl[r]->Submit("clash", sig, 32, "");
+      while (true) {
+        std::vector<hvdtpu::Entry> batch;
+        if (!tree.ctl[r]->NextBatch(5.0, &batch)) return;
+        for (auto& e : batch)
+          if (e.name == "clash") {
+            if (e.error.find("mismatched") != std::string::npos)
+              errors.fetch_add(1);
+            return;
+          }
+      }
+    });
+  for (auto& t : th) t.join();
+  CHECK(errors.load() == tree.n);
+  for (auto& c : tree.ctl) c->Shutdown();
+}
+
+static void TestSubtreeSeverBlastRadius() {
+  MiniTree tree(7, 2, "tree-unit");
+  // Find an aggregator under the root (a rank with children) and its
+  // subtree interval.
+  int agg = -1;
+  TreePlace ap;
+  for (int r = 1; r < tree.n; ++r) {
+    TreePlace p = TreePlaceOf(r, tree.n, 2);
+    if (!p.children.empty()) {
+      agg = r;
+      ap = p;
+      break;
+    }
+  }
+  CHECK(agg > 0);
+  auto in_subtree = [&](int r) { return r >= ap.lo && r < ap.hi; };
+
+  // The subtree's ranks join (their readiness is no longer required),
+  // riding the merged join path up through the aggregator...
+  for (int r = 0; r < tree.n; ++r)
+    if (in_subtree(r)) tree.ctl[r]->Join();
+
+  // ...then the REMAINING ranks negotiate a fresh allreduce-style
+  // tensor to completion (join-aware readiness: size - joined).
+  auto negotiate = [&](const std::string& name) {
+    std::vector<std::thread> th;
+    std::atomic<int> delivered{0};
+    for (int r = 0; r < tree.n; ++r) {
+      if (in_subtree(r)) continue;
+      th.emplace_back([&, r] {
+        tree.ctl[r]->Submit(name, "ar|f32|0|0|1.0|1.0#f32:8", 32, "");
+        double deadline = hvdtpu_stress::now_s() + 20.0;
+        while (hvdtpu_stress::now_s() < deadline) {
+          std::vector<hvdtpu::Entry> batch;
+          if (!tree.ctl[r]->NextBatch(1.0, &batch)) return;
+          for (auto& e : batch)
+            if (e.name == name && e.error.empty()) {
+              delivered.fetch_add(1);
+              return;
+            }
+        }
+      });
+    }
+    for (auto& t : th) t.join();
+    return delivered.load();
+  };
+  int outside = tree.n - (ap.hi - ap.lo);
+  CHECK(negotiate("before_sever") == outside);
+
+  // Sever the whole subtree (aggregator first — its children lose
+  // their parent). The blast radius must be the subtree alone: every
+  // outside rank keeps negotiating, ok() everywhere outside.
+  for (int r = 0; r < tree.n; ++r)
+    if (in_subtree(r)) tree.ctl[r]->Shutdown();
+  CHECK(negotiate("after_sever") == outside);
+  for (int r = 0; r < tree.n; ++r)
+    if (!in_subtree(r)) CHECK(tree.ctl[r]->ok());
+  for (auto& c : tree.ctl) c->Shutdown();
+}
+
+int main() {
+  TestTopology();
+  TestRankSet();
+  TestMerge();
+  TestTreeMetaAggregation();
+  TestDeepTierMismatchPropagates();
+  TestSubtreeSeverBlastRadius();
+  printf("TREE UNIT OK\n");
+  return 0;
+}

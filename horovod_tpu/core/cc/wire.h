@@ -35,6 +35,8 @@ enum class MsgType : uint8_t {
   kShutdown = 4,   // either direction
   kChallenge = 5,  // coord -> new connection: {nonce}
   kWelcome = 6,    // coord -> worker: {mac over the worker's nonce}
+  kReadyAgg = 7,   // aggregator -> parent: merged AggEntry list
+                   // (tree mode; see tree.h)
 };
 
 // One pending-tensor announcement (reference: Request).

@@ -126,7 +126,9 @@ def load():
                 ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
                 ctypes.c_int, ctypes.c_longlong, ctypes.c_double,
                 ctypes.c_double, ctypes.c_double, ctypes.c_double,
-                ctypes.c_int, ctypes.c_char_p]
+                ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+                ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int]
             lib.hvd_core_destroy.argtypes = [ctypes.c_void_p]
             lib.hvd_core_ok.argtypes = [ctypes.c_void_p]
             lib.hvd_core_ok.restype = ctypes.c_int
@@ -154,8 +156,41 @@ def load():
                 ctypes.c_void_p, ctypes.c_int]
             lib.hvd_core_control_bytes.argtypes = [ctypes.c_void_p]
             lib.hvd_core_control_bytes.restype = ctypes.c_longlong
+            lib.hvd_core_tree_tier.argtypes = [ctypes.c_void_p]
+            lib.hvd_core_tree_tier.restype = ctypes.c_int
+            for fn in ("hvd_tree_parent", "hvd_tree_tier",
+                       "hvd_tree_depth", "hvd_tree_has_children"):
+                f = getattr(lib, fn)
+                f.argtypes = [ctypes.c_int] * (2 if fn ==
+                                               "hvd_tree_depth" else 3)
+                f.restype = ctypes.c_int
             _lib = lib
     return _lib
+
+
+# --- stateless control-tree topology (tree.h arithmetic) -------------------
+# Exposed through the SAME C++ placement the core uses, so the Python
+# wiring (parent address / listen port derivation in ops/controller.py)
+# can never drift from the native topology.
+
+def tree_parent(rank: int, size: int, arity: int) -> int:
+    """Parent rank in the control tree (-1 for the root)."""
+    return load().hvd_tree_parent(rank, size, arity)
+
+
+def tree_tier(rank: int, size: int, arity: int) -> int:
+    """This rank's tier: 0 = root, 1 = attached to it, 2+ = deeper."""
+    return load().hvd_tree_tier(rank, size, arity)
+
+
+def tree_depth(size: int, arity: int) -> int:
+    """Total tiers below the root (1 for the flat star)."""
+    return load().hvd_tree_depth(size, arity)
+
+
+def tree_has_children(rank: int, size: int, arity: int) -> bool:
+    """Whether this rank fronts a subtree (needs a listen port)."""
+    return bool(load().hvd_tree_has_children(rank, size, arity))
 
 
 def available() -> bool:
@@ -195,7 +230,10 @@ class NativeCore:
                  coord_port: int, fusion_threshold: int,
                  cycle_time_ms: float, stall_warn_s: float,
                  stall_kill_s: float, connect_timeout_s: float = 30.0,
-                 cache_capacity: int = 1024, auth_secret: str = ""):
+                 cache_capacity: int = 1024, auth_secret: str = "",
+                 tree_arity: int = 0, parent_host: str = "",
+                 parent_port: int = 0, listen_port: int = 0,
+                 agg_linger_us: int = 200):
         lib = load()
         if lib is None:
             raise RuntimeError("native core not built")
@@ -204,7 +242,8 @@ class NativeCore:
             rank, size, coord_host.encode(), coord_port,
             fusion_threshold, cycle_time_ms, stall_warn_s,
             stall_kill_s, connect_timeout_s, cache_capacity,
-            auth_secret.encode())
+            auth_secret.encode(), tree_arity, parent_host.encode(),
+            parent_port, listen_port, agg_linger_us)
         self._buf = ctypes.create_string_buffer(self.BUF_SIZE)
         if not lib.hvd_core_ok(self._h):
             err = self.last_error()
@@ -279,6 +318,10 @@ class NativeCore:
     def control_bytes(self) -> int:
         """Ready-announcement bytes this rank sent (0 on rank 0)."""
         return self._lib.hvd_core_control_bytes(self._h)
+
+    def tree_tier(self) -> int:
+        """This rank's control-tree tier (0 = root/coordinator)."""
+        return self._lib.hvd_core_tree_tier(self._h)
 
     def shutdown(self) -> None:
         if self._h is not None:

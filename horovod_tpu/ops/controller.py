@@ -373,6 +373,22 @@ class NegotiatedController:
             "hvd_stall_max_age_seconds",
             "Age of the oldest currently-stalled pending collective "
             "(0 when nothing is stalled).")
+        # Control-tree observability (HOROVOD_CONTROL_TREE_ARITY):
+        # this rank's tier in the hierarchical control plane and the
+        # coordinator-measured agreement round latency — the curve
+        # benchmarks/control_plane_scale.md tracks offline, scrapeable
+        # at runtime.
+        self._m_tree_depth = _METRICS.gauge(
+            "hvd_control_tree_depth",
+            "This rank's control-tree tier: 0 = root/coordinator, "
+            "1 = attached directly to it (every worker in the flat "
+            "star), 2+ = below an aggregator.")
+        self._m_round = _METRICS.histogram(
+            "hvd_control_round_seconds",
+            "Coordinator-measured negotiation round latency per "
+            "agreed batch (slowest entry's submit-to-agreement; must "
+            "stay under the cycle budget).", buckets=LATENCY_BUCKETS)
+        self._tree_tier = 0
 
         if cfg.controller == "python" and topology.size > 1 and \
                 core is None:
@@ -395,6 +411,7 @@ class NegotiatedController:
                 host, port = self._control_endpoint(cfg)
             else:
                 host, port = "127.0.0.1", 0  # size 1: no sockets
+            tree_kwargs = self._tree_endpoint(cfg, topology, host, port)
             self.core = native.NativeCore(
                 rank=topology.rank, size=topology.size,
                 coord_host=host, coord_port=port,
@@ -405,7 +422,9 @@ class NegotiatedController:
                 stall_kill_s=cfg.stall_shutdown_time,
                 connect_timeout_s=cfg.start_timeout,
                 cache_capacity=cfg.cache_capacity,
-                auth_secret=control_plane_secret())
+                auth_secret=control_plane_secret(),
+                **tree_kwargs)
+            self._tree_tier = self.core.tree_tier()
         elif topology.size == 1:
             self.core = PythonCore(cfg.fusion_threshold,
                                    cfg.cycle_time_ms)
@@ -416,6 +435,7 @@ class NegotiatedController:
 
         if getattr(cfg, "batch_quiescence", 0):
             self.core.set_quiescence(cfg.batch_quiescence)
+        self._m_tree_depth.set(self._tree_tier)
 
         self._worker = threading.Thread(
             target=self._worker_loop, name="hvdtpu-controller",
@@ -433,6 +453,40 @@ class NegotiatedController:
                 "HOROVOD_COORDINATOR_ADDR (set by the launcher)")
         host, port = cfg.coordinator_addr.rsplit(":", 1)
         return host, int(port) + 1
+
+    @staticmethod
+    def _tree_endpoint(cfg, topology, coord_host, coord_port):
+        """Hierarchical-control-plane placement for this rank
+        (HOROVOD_CONTROL_TREE_ARITY >= 2; core/cc/tree.h): parent
+        address and listen port derived from the SAME C++ topology
+        arithmetic the core uses (native.tree_parent), with the
+        deterministic port scheme `control_port + rank` for
+        aggregator listeners and the per-rank host list the launcher
+        exports as HOROVOD_CONTROL_HOSTS. Every rank computes this
+        from identical inputs, so the topology cannot diverge across
+        the job."""
+        arity = getattr(cfg, "control_tree_arity", 0)
+        if arity < 2 or topology.size <= 2:
+            return {}
+        rank, size = topology.rank, topology.size
+        parent = native.tree_parent(rank, size, arity)
+        hosts = [h.strip() for h in
+                 (cfg.control_hosts or "").split(",") if h.strip()]
+        parent_host = (hosts[parent]
+                       if 0 <= parent < len(hosts) else coord_host)
+        listen_port = 0
+        if rank != 0 and native.tree_has_children(rank, size, arity):
+            listen_port = coord_port + rank
+        parent_port = coord_port + parent if parent > 0 else coord_port
+        for p in (listen_port, parent_port):
+            if p > 65535:
+                raise RuntimeError(
+                    f"control-tree port {p} exceeds 65535 (base "
+                    f"control port {coord_port} + rank); pick a lower "
+                    "HOROVOD_CONTROL_ADDR port for tree mode")
+        return {"tree_arity": arity, "parent_host": parent_host,
+                "parent_port": parent_port, "listen_port": listen_port,
+                "agg_linger_us": cfg.control_tree_linger_us}
 
     # ------------------------------------------------------------------
     # submission (any thread)
@@ -702,6 +756,12 @@ class NegotiatedController:
         with self._mu:
             local = {e.name: self._pending[e.name] for e in batch
                      if e.name in self._pending}
+        # Coordinator-measured round latency for the whole agreed
+        # batch (slowest entry): the runtime form of the control-plane
+        # scale curve, one observation per batch.
+        self._m_round.observe(
+            max((getattr(e, "negotiate_us", 0) or 0)
+                for e in batch) / 1e6)
         for e in batch:
             p = local.get(e.name)
             if p is None:
@@ -730,7 +790,10 @@ class NegotiatedController:
                         e.name, negotiate_us=e.negotiate_us,
                         seq=seqs[e.name], step=step,
                         arrival_us=tl.to_trace_us(
-                            int(p.submitted * 1e9)))
+                            int(p.submitted * 1e9)),
+                        tier=(self._tree_tier
+                              if getattr(self.cfg, "control_tree_arity",
+                                         0) >= 2 else -1))
         # error entries: deliver and drop (all ranks got the same ones)
         live = []
         for e in batch:

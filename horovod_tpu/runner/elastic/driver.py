@@ -152,11 +152,18 @@ class ElasticDriver:
         coord_host = "localhost" if rank0.is_local else rank0.host
         coordinator = f"{coord_host}:{free_port()}"
         control = f"{coord_host}:{free_port()}"
+        # Rank-indexed host list for hierarchical-control-plane parent
+        # lookup (HOROVOD_CONTROL_TREE_ARITY; see common/config.py
+        # HOROVOD_CONTROL_HOSTS) — recomputed per epoch so resizes
+        # keep the tree topology consistent across the new world.
+        control_hosts = ",".join(
+            "localhost" if i.is_local else i.host for i in infos)
         table = {}
         for info in infos:
             env = info.env()
             env["HOROVOD_COORDINATOR_ADDR"] = coordinator
             env["HOROVOD_CONTROL_ADDR"] = control
+            env["HOROVOD_CONTROL_HOSTS"] = control_hosts
             env["HOROVOD_HOSTNAME"] = info.host
             env["HOROVOD_RENDEZVOUS_ADDR"] = \
                 f"{self._my_addr(info)}:{self.rendezvous.port}"

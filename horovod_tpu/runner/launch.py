@@ -166,10 +166,16 @@ def run(command: List[str], np_: int = 1, hosts: Optional[str] = None,
     # driver/task/rendezvous RPCs).
     job_secret = _secret.make_secret()
     try:
+        # Rank-indexed host list: tree-mode workers
+        # (HOROVOD_CONTROL_TREE_ARITY) resolve their aggregator
+        # parent's address from it.
+        control_hosts = ",".join(
+            "localhost" if i.is_local else i.host for i in infos)
         for info in infos:
             child_env = build_env(info, coordinator, env,
                                   per_chip=per_chip, all_infos=infos)
             child_env["HOROVOD_CONTROL_ADDR"] = control
+            child_env["HOROVOD_CONTROL_HOSTS"] = control_hosts
             child_env["HOROVOD_START_TIMEOUT"] = str(start_timeout)
             child_env[_secret.ENV_VAR] = job_secret
             if info.is_local:
@@ -320,6 +326,8 @@ def run_with_driver(command: List[str], np_: int = 1,
         control = f"{coord_addr}:{free_port()}"
         base = {k: v for k, v in (env or os.environ).items()
                 if k.startswith(FORWARD_PREFIXES)}
+        control_hosts = ",".join(
+            "localhost" if i.is_local else i.host for i in infos)
         by_host: Dict[str, list] = {}
         for info in infos:
             child = dict(base)
@@ -328,6 +336,7 @@ def run_with_driver(command: List[str], np_: int = 1,
                 child.update(per_chip_env(info, infos))
             child["HOROVOD_COORDINATOR_ADDR"] = coordinator
             child["HOROVOD_CONTROL_ADDR"] = control
+            child["HOROVOD_CONTROL_HOSTS"] = control_hosts
             child["HOROVOD_START_TIMEOUT"] = str(start_timeout)
             # No HOROVOD_SECRET here: the run RPC crosses the network
             # unencrypted; each task service injects its own copy
@@ -445,6 +454,13 @@ def make_parser() -> argparse.ArgumentParser:
     tune.add_argument("--cache-capacity", type=int, default=None,
                       help="response-cache entries, 0 disables "
                            "(HOROVOD_CACHE_CAPACITY)")
+    tune.add_argument("--control-tree-arity", type=int, default=None,
+                      help="hierarchical control-plane fan-out: "
+                           "workers attach to intermediate "
+                           "aggregators instead of the rank-0 "
+                           "coordinator (HOROVOD_CONTROL_TREE_ARITY; "
+                           "0 = flat star, 32 = measured sweet spot "
+                           "at O(1k) ranks)")
     tune.add_argument("--hierarchical-allreduce", action="store_true",
                       default=None,
                       help="ICI reduce-scatter + DCN allreduce + ICI "
@@ -507,6 +523,7 @@ _FLAG_ENV_MAP = [
     ("fusion_threshold", "HOROVOD_FUSION_THRESHOLD", str),
     ("cycle_time_ms", "HOROVOD_CYCLE_TIME", str),
     ("cache_capacity", "HOROVOD_CACHE_CAPACITY", str),
+    ("control_tree_arity", "HOROVOD_CONTROL_TREE_ARITY", str),
     ("hierarchical_allreduce", "HOROVOD_HIERARCHICAL_ALLREDUCE",
      lambda v: "1"),
     ("timeline_filename", "HOROVOD_TIMELINE", str),

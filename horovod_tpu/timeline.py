@@ -113,7 +113,8 @@ class Timeline:
 
     def negotiate_end(self, name: str, negotiate_us: int = 0,
                       seq: int = -1, step: int = -1,
-                      arrival_us: float = None) -> None:
+                      arrival_us: float = None,
+                      tier: int = -1) -> None:
         """Closes the NEGOTIATE span. negotiate_us (if provided) is
         the coordinator-measured submit->agreed duration carried on
         the batch entry wire format — the lane itself uses this
@@ -123,7 +124,12 @@ class Timeline:
         sequence id — identical on every rank by construction — and
         the training step); arrival_us is this rank's local submit
         time on the trace axis. Together they are what the merge step
-        keys its cross-rank arrival-delta attribution on."""
+        keys its cross-rank arrival-delta attribution on.
+
+        tier >= 0 records this rank's control-tree tier
+        (HOROVOD_CONTROL_TREE_ARITY; 0 = root) on the span, so a
+        merged trace shows which aggregation hop a rank's
+        negotiation rode through."""
         if self._closed:
             return
         ev = {"name": "NEGOTIATE", "ph": "E", "pid": 0,
@@ -131,6 +137,8 @@ class Timeline:
         args = {}
         if negotiate_us:
             args["coordinator_negotiate_us"] = negotiate_us
+        if tier >= 0:
+            args["tier"] = tier
         if seq >= 0:
             args.update(seq=seq, step=step, tensor=name)
             if arrival_us is not None:

@@ -42,6 +42,50 @@ def hvd_single():
     hvd.shutdown()
 
 
+_NO_MULTIPROC = ("this jaxlib's CPU backend cannot run cross-process "
+                 "collectives (affects every multiprocess data-plane "
+                 "integration test; the control plane — negotiation, "
+                 "timelines, launchers — still runs and stays tested)")
+_multiproc_probe_result = None
+
+
+@pytest.fixture(scope="session")
+def multiproc_data_plane():
+    """Session-scoped capability probe for the cross-process DATA
+    plane: one tiny 2-rank allreduce through the real launcher. On
+    jaxlibs whose CPU backend cannot run multiprocess computations
+    (this CI image), every data-plane mp test skips here with one
+    shared reason instead of each failing identically — the same gate
+    test_chaos.py/test_numerics.py apply module-locally, hoisted so
+    the controller/runner/span/callbacks mp tests share one probe
+    (and one subprocess) per session."""
+    global _multiproc_probe_result
+    if _multiproc_probe_result is None:
+        import subprocess
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("XLA_FLAGS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + \
+            env.get("PYTHONPATH", "")
+        r = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner", "-np", "2",
+             sys.executable, "-c",
+             "import jax.numpy as jnp; import horovod_tpu as hvd; "
+             "hvd.init(); hvd.allreduce(jnp.ones(4), name='probe'); "
+             "hvd.shutdown()"],
+            cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+            timeout=180)
+        out = r.stdout + r.stderr
+        if "Multiprocess computations aren't implemented" in out:
+            _multiproc_probe_result = "incapable"
+        else:
+            assert r.returncode == 0, out
+            _multiproc_probe_result = "ok"
+    if _multiproc_probe_result == "incapable":
+        pytest.skip(_NO_MULTIPROC)
+
+
 @pytest.fixture(scope="session")
 def eight_device_mesh():
     from jax.sharding import Mesh
@@ -201,6 +245,10 @@ _NIGHTLY = {
     # C++ control-plane scale/TSAN stress binaries
     "tests/test_scale_stress.py::test_control_plane_scales_to_64_workers",
     "tests/test_scale_stress.py::test_slow_worker_does_not_stall_healthy_ranks",
+    # flat-vs-tree A/B at 256 simulated ranks (two 256-rank gangs;
+    # the cheap tree representatives — tree_unit, 16-rank tree row,
+    # 4-proc wiring — stay in tier-1)
+    "tests/test_scale_stress.py::test_flat_vs_tree_256_root_work",
     "tests/test_tsan_stress.py::test_controller_stress_under_tsan",
     # wide-span multi-proc variants beyond the 2-proc representative
     "tests/test_span_devices.py::test_eager_span_devices[3-2]",

@@ -475,7 +475,12 @@ class TestNativeCoreUnit:
 @pytest.mark.integration
 class TestNegotiationMultiProcess:
     @pytest.mark.parametrize("np_", [2, 4])
-    def test_negotiation(self, np_):
+    def test_negotiation(self, np_, multiproc_data_plane):
+        # multiproc_data_plane: the worker runs real eager allreduces
+        # whose DISPATCH needs cross-process XLA collectives — absent
+        # on this image's jaxlib CPU backend (negotiation itself is
+        # covered without that backend by test_tree_wiring below and
+        # the C++ harnesses).
         env = dict(os.environ)
         env.pop("PALLAS_AXON_POOL_IPS", None)
         env.pop("XLA_FLAGS", None)
@@ -492,11 +497,19 @@ class TestNegotiationMultiProcess:
 
 
 @pytest.mark.integration
-def test_eager_cache_microbench_traffic_ratio():
+def test_eager_cache_microbench_traffic_ratio(multiproc_data_plane):
     """The benchmarks/ microbench's headline claim, asserted: the
     response cache shrinks steady-state control traffic severalfold
     (reference: response_cache.cc's bit-vector motivation; here
-    5-byte id announcements)."""
+    5-byte id announcements). Gated on the mp data plane (the
+    microbench job runs 2-proc eager allreduces) AND on a quiet box:
+    its per-iteration byte ratio is deterministic, but the 2x200-iter
+    subprocess jobs stall into their timeouts when the host is
+    already saturated."""
+    if os.getloadavg()[0] > 4 * (os.cpu_count() or 1):
+        pytest.skip(f"box too loaded for the timed microbench "
+                    f"(load {os.getloadavg()[0]:.1f} on "
+                    f"{os.cpu_count()} cpus)")
     import importlib.util
     import os as _os
     spec = importlib.util.spec_from_file_location(

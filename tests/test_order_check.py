@@ -64,9 +64,11 @@ def test_disabled_raises(tmp_path):
 
 
 @pytest.mark.integration
-def test_two_proc_opposite_submission_order():
+def test_two_proc_opposite_submission_order(multiproc_data_plane):
     """Ranks submit in opposite orders; the agreed execution order is
-    still identical — the coordinator's core contract, asserted."""
+    still identical — the coordinator's core contract, asserted.
+    (multiproc_data_plane: the worker's collectives dispatch through
+    cross-process XLA, absent on this image's jaxlib.)"""
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)
     env.pop("XLA_FLAGS", None)

@@ -174,7 +174,7 @@ def run_launcher(np_, script, extra_env=None, timeout=240):
 @pytest.mark.integration
 class TestRealLaunch:
     @pytest.mark.parametrize("np_", [2, 4])
-    def test_two_process_collectives(self, np_):
+    def test_two_process_collectives(self, np_, multiproc_data_plane):
         # np=4 additionally exercises a live 2-member SUBSET process
         # set (inline dispatch path) alongside the world controller.
         r = run_launcher(np_, os.path.join("tests", "mp_worker.py"))

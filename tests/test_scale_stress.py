@@ -29,10 +29,19 @@ CCDIR = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "horovod_tpu", "core", "cc")
 
 
-def _run(workers: int, rounds: int = 15, tensors: int = 8) -> dict:
+def _build(target: str) -> None:
+    if shutil.which("g++") is None or shutil.which("make") is None:
+        pytest.skip("no C++ toolchain")
+    build = subprocess.run(["make", "-C", CCDIR, target],
+                           capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, build.stderr[-2000:]
+
+
+def _run(workers: int, rounds: int = 15, tensors: int = 8,
+         extra: tuple = ()) -> dict:
     r = subprocess.run(
         [os.path.join(CCDIR, "stress_scale"), str(workers),
-         str(rounds), str(tensors)],
+         str(rounds), str(tensors), *extra],
         capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, (r.returncode, r.stdout, r.stderr[-2000:])
     return json.loads(r.stdout.strip().splitlines()[-1])
@@ -40,12 +49,7 @@ def _run(workers: int, rounds: int = 15, tensors: int = 8) -> dict:
 
 @pytest.mark.integration
 def test_control_plane_scales_to_64_workers():
-    if shutil.which("g++") is None or shutil.which("make") is None:
-        pytest.skip("no C++ toolchain")
-    build = subprocess.run(["make", "-C", CCDIR, "stress_scale"],
-                           capture_output=True, text=True, timeout=300)
-    assert build.returncode == 0, build.stderr[-2000:]
-
+    _build("stress_scale")
     for workers in (32, 64):
         rec = _run(workers)
         # Concurrent connect storm: N-1 simultaneous mutual
@@ -54,6 +58,91 @@ def test_control_plane_scales_to_64_workers():
         # Steady-state agreement: every rank sees every batch within
         # a loose bound (single-core CI scheduling noise included).
         assert rec["round_p95_ms"] < 2000.0, rec
+
+
+@pytest.mark.integration
+def test_tree_unit_suite():
+    """The hierarchical-control-plane unit suite (core/cc/tree_unit):
+    topology arithmetic, RankSet bitset union + wire round-trips,
+    AggEntry merge/meta dedup, and the mini loopback trees — deep-tier
+    sig mismatch propagating to every rank as an error entry, subtree
+    sever leaving outside ranks negotiating. Tier-1: it runs in well
+    under a second."""
+    _build("tree_unit")
+    r = subprocess.run([os.path.join(CCDIR, "tree_unit")],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, (r.returncode, r.stdout,
+                               r.stderr[-2000:])
+    assert "TREE UNIT OK" in r.stdout, r.stdout
+
+
+@pytest.mark.integration
+def test_tree_mode_small_world():
+    """stress_scale --tree at a small world (tier-1 smoke for the
+    hierarchical path end-to-end: handshakes to per-aggregator
+    listeners, merged kReadyAgg upward, relayed responses downward,
+    identical agreed order — the binary exits non-zero on
+    divergence)."""
+    _build("stress_scale")
+    rec = _run(16, rounds=10, extra=("--tree=4",))
+    assert rec["mode"] == "tree" and rec["depth"] == 2, rec
+    assert rec["connect_s"] < 30.0, rec
+    assert rec["round_p95_ms"] < 2000.0, rec
+
+
+@pytest.mark.integration
+def test_flat_vs_tree_256_root_work():
+    """The tree's load-bearing claim at 256 simulated ranks: the
+    ROOT's per-round control-plane work (thread-CPU ns in
+    parse/ingest/cut/fan-out — the term that must stay sub-cycle on a
+    pod, where each node owns its core) drops by severalfold vs the
+    flat star, and no aggregator inherits the root's burden. Gang
+    wall-clock is deliberately NOT asserted tight here: on a 1-core
+    CI host it measures the scheduler, not the protocol (see
+    benchmarks/control_plane_scale.md round 9). Nightly: two 256-rank
+    gangs are minutes of load on the CI box."""
+    _build("stress_scale")
+    flat = _run(256, rounds=15)
+    tree = _run(256, rounds=15, extra=("--tree=32", "--linger=5000"))
+    assert tree["mode"] == "tree" and tree["depth"] == 2, tree
+    # Loose CI bounds (measured: flat ~0.9-1.3 ms/round, tree
+    # ~0.22-0.35 ms/round, ratio ~3.7-5x on this host).
+    assert tree["root_work_ms_per_round"] < \
+        flat["root_work_ms_per_round"] / 1.5, (flat, tree)
+    # Aggregators must not become the new hotspot: the busiest
+    # non-root node stays well under the root it relieved.
+    assert tree["max_nonroot_work_ms_per_round"] < \
+        flat["root_work_ms_per_round"], (flat, tree)
+    # The merge is real: the root ingests a small multiple of the
+    # aggregator count, not one frame per worker.
+    assert tree["root_frames_per_round"] < \
+        flat["root_frames_per_round"] / 2, (flat, tree)
+
+
+@pytest.mark.integration
+def test_tree_wiring_4proc():
+    """The Python wiring end-to-end through the real launcher:
+    HOROVOD_CONTROL_TREE_ARITY=2 at 4 ranks places rank 2 UNDER the
+    rank-1 aggregator; negotiated generic ops with per-rank metadata
+    cross the two-hop aggregation path and come back correctly
+    aggregated, tiers match native.tree_tier, and the
+    hvd_control_tree_depth gauge / hvd_control_round_seconds
+    histogram are live. Control-plane only — runs on jaxlibs without
+    the cross-process data plane."""
+    import sys
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "horovod_tpu.runner", "-np", "4",
+         sys.executable, os.path.join("tests", "mp_worker_tree.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stdout + "\n" + r.stderr
+    assert r.stdout.count("TREE WIRE OK") == 4, r.stdout
+    assert "tier=2" in r.stdout, r.stdout  # rank 2 really sat deeper
 
 
 @pytest.mark.integration

@@ -1,0 +1,128 @@
+"""Round-9 committed-artifact consistency: the scaling dossier
+(benchmarks/SCALING_projection_r09.json) and the steady-state
+composed timeline (benchmarks/TIMELINE_steady_2proc_r09.json) are
+CLAIMS the repo ships — these tests keep them honest against drift:
+every assumption source named in the dossier must exist, the
+projection must still follow from its own stated inputs, and the
+dossier must regenerate byte-identically from `bench.py
+--scaling-report` (no silent hand edits)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOSSIER = os.path.join(REPO, "benchmarks",
+                       "SCALING_projection_r09.json")
+STEADY = os.path.join(REPO, "benchmarks",
+                      "TIMELINE_steady_2proc_r09.json")
+
+
+@pytest.fixture(scope="module")
+def dossier():
+    with open(DOSSIER) as f:
+        return json.load(f)
+
+
+def test_every_assumption_source_exists(dossier):
+    """The falsifiability contract rests on traceability: each
+    sourced assumption and rate names a committed artifact — a
+    renamed or deleted artifact must fail loudly here, not rot the
+    dossier."""
+    paths = []
+    for block in dossier["assumptions"].values():
+        src = block.get("source", "")
+        if ":" in src and "/" in src.split(":")[0]:
+            paths.append(src.split(":")[0])
+    for m in dossier["projection"].values():
+        paths.append(m["rate_source"].split(":")[0])
+    sub = dossier["assumptions"]["control_plane"]
+    paths.append(sub["steady_negotiate_p50_ms"]["source"].split(":")[0])
+    assert paths, "dossier names no sources at all?"
+    for p in set(paths):
+        assert os.path.exists(os.path.join(REPO, p)), \
+            f"dossier cites missing artifact {p}"
+
+
+def test_projection_follows_from_stated_inputs(dossier):
+    """Recompute one curve point from the dossier's OWN stated
+    method and inputs; a drift between the formulas documented and
+    the numbers committed is a lying artifact."""
+    a = dossier["assumptions"]
+    eff_bw = (a["ici_gbps_per_chip"]["value"] / 8 * 1e9 *
+              a["ici_utilization"]["value"])
+    h = a["overlap_hidden_schedule_fraction"]["value"]
+    bwd = a["backward_window_fraction"]["value"]
+    for name, m in dossier["projection"].items():
+        step = m["step_time_ms_1chip"] / 1e3
+        for n_s, row in m["curve"].items():
+            n = int(n_s)
+            t_wire = m["wire_bytes_per_step"] * 2 * (n - 1) / n / eff_bw
+            hidden = min(h * t_wire, bwd * step)
+            eff = step / (step + (t_wire - hidden))
+            assert abs(eff - row["efficiency"]) < 5e-4, (name, n_s)
+            floor = step / (step + t_wire)
+            assert abs(floor -
+                       row["efficiency_no_overlap_floor"]) < 5e-4, \
+                (name, n_s)
+
+
+def test_headline_claim_holds(dossier):
+    """>=90% at 32 chips for all three models, even at the
+    zero-overlap floor — the dossier's headline, asserted from its
+    own numbers."""
+    floors = dossier["headline"]["no_overlap_floor_32chip"]
+    assert set(floors) == {"resnet50", "vgg16",
+                           "flagship_transformer"}
+    for model, floor in floors.items():
+        assert floor >= 0.90, (model, floor)
+
+
+@pytest.mark.integration
+def test_dossier_regenerates_byte_identical(tmp_path):
+    """`bench.py --scaling-report` is pure arithmetic over committed
+    inputs (eval_shape wire bytes, artifact reads — no timestamps,
+    no randomness), so regeneration must reproduce the committed
+    dossier EXACTLY; a mismatch means either a hand edit or an
+    input drifted without re-emitting."""
+    out = tmp_path / "dossier.json"
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)
+    env.pop("BENCH_ICI_GBPS", None)
+    env.pop("BENCH_ICI_UTILIZATION", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_SCALING_OUT"] = str(out)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--scaling-report"],
+        cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert out.read_bytes() == open(DOSSIER, "rb").read(), \
+        "regenerated dossier differs from the committed one"
+
+
+def test_steady_timeline_claims():
+    """The round-9 steady-state composed artifact's headline
+    (VERDICT 'What's missing' 1): NEGOTIATE p50 below the cycle
+    budget once the compile cycle is excluded, both ranks present,
+    provenance stated."""
+    with open(STEADY) as f:
+        doc = json.load(f)
+    neg = doc["metadata"]["negotiate_ms"]
+    assert neg["steady_p50"] < neg["cycle_budget_ms"]
+    assert neg["steady_p95"] < neg["cycle_budget_ms"]
+    prov = doc["metadata"]["provenance"]
+    assert prov["compile_cycles_excluded"] == [0]
+    assert doc["metadata"]["ranks"] == [0, 1]
+    # The spans the claim is computed from are really in the trace.
+    neg_ends = [e for e in doc["traceEvents"]
+                if e.get("name") == "NEGOTIATE"
+                and e.get("ph") == "E"
+                and "coordinator_negotiate_us" in e.get("args", {})]
+    steady = [e for e in neg_ends if e["args"].get("step", 0) > 0]
+    assert len(steady) >= neg["steady_count"] // 2

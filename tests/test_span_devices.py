@@ -15,7 +15,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 @pytest.mark.integration
 @pytest.mark.parametrize("np_,devs", [(2, 2), (3, 2), (8, 2)])
-def test_eager_span_devices(np_, devs):
+def test_eager_span_devices(np_, devs, multiproc_data_plane):
     """`hvd.allreduce` reduces over (processes x local devices): the
     wide mesh covers every device and the summed payload is exact."""
     env = dict(os.environ)
