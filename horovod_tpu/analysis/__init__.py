@@ -20,6 +20,11 @@ registry drift and jit-trace impurity fail CI before they reach a pod:
           reordering) and async handles never drained.
   HVD006  lockset races: fields written from >=2 thread entry points
           with an empty common lockset (static Eraser).
+  HVD007  jaxpr-tier SPMD collective verifier (SEMANTIC tier, run
+          via `--jaxpr`): traces the repo's real step builders across
+          a config matrix and checks the traced programs — mesh-axis
+          validity, no size-1-axis reduces, no dead or double
+          reductions, bucket-plan agreement, numerics flag contract.
 
 HVD005/HVD006 run on a whole-repo call graph + per-function CFGs
 (analysis/graph.py, analysis/dataflow.py) with bounded
@@ -30,9 +35,13 @@ touched since a git ref plus their call-graph neighbors.
 Per-rule suppression: `# hvdlint: disable=HVD00x (reason)` on the
 flagged line (or `disable-next=` on the line above, `disable-file=`
 anywhere). A committed baseline file (`hvdlint-baseline.json`) filters
-known findings so only NEW ones fail. The analyzer is pure AST — it
-never imports or executes the code under analysis — and its reports
-are byte-deterministic.
+known findings so only NEW ones fail. The AST analyzer is pure AST —
+it never imports or executes the code under analysis — and its
+reports are byte-deterministic. The HVD007 semantic tier is the one
+deliberate exception: it exists to inspect what `jax.jit` tracing
+produces, so it imports jax and the builders (in its own `--jaxpr`
+run, never inside the AST pass) and caches trace results on a
+source-hash key (analysis/jaxpr_verify.py).
 """
 
 from __future__ import annotations

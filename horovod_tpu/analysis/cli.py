@@ -16,7 +16,7 @@ from typing import List, Optional, Set
 from . import baseline as baseline_mod
 from . import run_analysis
 from .report import RENDERERS
-from .rules import ALL_RULES
+from .rules import ALL_RULES, SEMANTIC_RULES
 
 DEFAULT_BASELINE = "hvdlint-baseline.json"
 
@@ -54,6 +54,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "untracked) plus their call-graph neighbors; "
                         "the pre-commit fast path — CI runs the full "
                         "pass")
+    p.add_argument("--jaxpr", action="store_true",
+                   help="run the SEMANTIC tier instead of the AST "
+                        "rules: trace the repo's real step builders "
+                        "across the config matrix and verify the "
+                        "HVD007 collective invariants on the traced "
+                        "jaxprs (imports jax + the code under "
+                        "analysis; source-hash-keyed cache in "
+                        ".hvdlint-jaxpr-cache.json)")
+    p.add_argument("--no-jaxpr-cache", action="store_true",
+                   help="with --jaxpr: ignore and do not write the "
+                        "trace cache")
     return p
 
 
@@ -89,7 +100,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
 
     if args.list_rules:
-        for rule in ALL_RULES:
+        for rule in ALL_RULES + SEMANTIC_RULES:
             print(f"{rule.id}  {rule.summary}")
         return 0
 
@@ -100,12 +111,14 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     # A gate that scans nothing must fail loudly, not report clean:
     # a mistyped path (or a CI job run from the wrong cwd) would
-    # otherwise stay green forever.
-    for p in args.paths:
-        if not os.path.exists(p):
-            print(f"hvdlint: path does not exist: {p}",
-                  file=sys.stderr)
-            return 2
+    # otherwise stay green forever. (--jaxpr verifies the installed
+    # package's builders, not the path args.)
+    if not args.jaxpr:
+        for p in args.paths:
+            if not os.path.exists(p):
+                print(f"hvdlint: path does not exist: {p}",
+                      file=sys.stderr)
+                return 2
 
     baseline_path = args.baseline or DEFAULT_BASELINE
     baseline = None
@@ -117,6 +130,33 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"hvdlint: bad baseline {baseline_path}: {e}",
                       file=sys.stderr)
                 return 2
+
+    if args.jaxpr:
+        # Semantic tier: trace-and-verify instead of AST passes. The
+        # report/baseline/exit contract is identical; the matrix and
+        # cache live in jaxpr_verify.
+        from . import jaxpr_verify
+        result = jaxpr_verify.run_jaxpr_analysis(
+            baseline=baseline,
+            use_cache=not args.no_jaxpr_cache)
+        if result.file_count == 0:
+            print("hvdlint --jaxpr: no builder configs verified "
+                  "(no devices?)", file=sys.stderr)
+            return 2
+        sys.stdout.write(RENDERERS[args.format](
+            result.findings, suppressed=result.suppressed,
+            baselined=result.baselined))
+        meta = getattr(result, "meta", {})
+        print(f"hvdlint --jaxpr: {result.file_count} config(s) "
+              f"verified on {meta.get('devices', '?')} devices "
+              f"({meta.get('cache', '?')} cache"
+              + (f", traced in {meta.get('elapsed_s')}s"
+                 if meta.get("cache") == "miss" else "")
+              + ")"
+              + (f"; skipped: {', '.join(meta['configs_skipped'])}"
+                 if meta.get("configs_skipped") else ""),
+              file=sys.stderr)
+        return 1 if result.findings else 0
 
     focus_from = None
     if args.changed_only:

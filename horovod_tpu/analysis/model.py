@@ -28,7 +28,7 @@ import tokenize
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 RULE_IDS = ("HVD001", "HVD002", "HVD003", "HVD004", "HVD005",
-            "HVD006")
+            "HVD006", "HVD007")
 
 _SUPPRESS_RE = re.compile(
     r"#\s*hvdlint:\s*(disable|disable-next|disable-file)\s*="
@@ -202,6 +202,48 @@ class SourceFile:
 class KnobDecl:
     env: str
     line: int
+    # Declared default, statically evaluated from the Knob(...) call
+    # (literals and constant arithmetic like 64 * 1024 * 1024); None
+    # when the expression is not statically evaluable. Drives the
+    # HVD002 docs-drift check against the user_guide knob tables.
+    default: object = None
+    has_default: bool = False
+
+
+def const_eval(node: ast.AST) -> Tuple[bool, object]:
+    """(ok, value) for literals and constant arithmetic — enough to
+    fold registry defaults like `64 * 1024 * 1024` without importing
+    the config module. Unary minus and + - * / // on folded operands
+    are supported; anything else is (False, None)."""
+    if isinstance(node, ast.Constant):
+        return True, node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op,
+                                                    ast.USub):
+        ok, v = const_eval(node.operand)
+        if ok and isinstance(v, (int, float)):
+            return True, -v
+        return False, None
+    if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.Add, ast.Sub, ast.Mult, ast.Div,
+                      ast.FloorDiv)):
+        lok, lv = const_eval(node.left)
+        rok, rv = const_eval(node.right)
+        if not (lok and rok) or not all(
+                isinstance(v, (int, float)) for v in (lv, rv)):
+            return False, None
+        try:
+            if isinstance(node.op, ast.Add):
+                return True, lv + rv
+            if isinstance(node.op, ast.Sub):
+                return True, lv - rv
+            if isinstance(node.op, ast.Mult):
+                return True, lv * rv
+            if isinstance(node.op, ast.Div):
+                return True, lv / rv
+            return True, lv // rv
+        except (ZeroDivisionError, OverflowError):
+            return False, None
+    return False, None
 
 
 class KnobRegistry:
@@ -243,8 +285,13 @@ class KnobRegistry:
                                     and elt.args):
                                 env = str_const(elt.args[0])
                                 if env:
+                                    ok, dv = (
+                                        const_eval(elt.args[2])
+                                        if len(elt.args) > 2
+                                        else (False, None))
                                     reg.knobs.append(
-                                        KnobDecl(env, elt.lineno))
+                                        KnobDecl(env, elt.lineno,
+                                                 dv, ok))
                     elif name == "_ATTR_MAP" and isinstance(
                             node.value, ast.Dict):
                         for k, v in zip(node.value.keys,

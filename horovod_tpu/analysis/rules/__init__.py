@@ -28,7 +28,12 @@ from .locks import LockDisciplineRule       # noqa: E402
 from .trace import TracePurityRule          # noqa: E402
 from .protocol import ProtocolRule          # noqa: E402
 from .lockset import LocksetRule            # noqa: E402
+from .jaxpr_rules import JaxprVerifierRule  # noqa: E402
 
+# The pure-AST tiers: what `run_analysis` executes. HVD007 is NOT in
+# this list on purpose — it is the SEMANTIC tier (it imports jax and
+# the code under analysis, the opposite of the AST purity contract)
+# and runs via `--jaxpr` / analysis.jaxpr_verify instead.
 ALL_RULES: List[Type[Rule]] = [
     SpmdDivergenceRule,
     RegistryRule,
@@ -36,6 +41,10 @@ ALL_RULES: List[Type[Rule]] = [
     TracePurityRule,
     ProtocolRule,
     LocksetRule,
+]
+
+SEMANTIC_RULES: List[Type[Rule]] = [
+    JaxprVerifierRule,
 ]
 
 RULES_BY_ID: Dict[str, Type[Rule]] = {r.id: r for r in ALL_RULES}

@@ -1,6 +1,6 @@
 """HVD002 — registry enforcement: config knobs and metric names.
 
-Three invariants, all whole-program:
+Four invariants, all whole-program:
 
 1. Every `os.environ` / `os.getenv` read of a `HOROVOD_*` name outside
    the declaring config module must go away: reads of DECLARED knobs
@@ -17,11 +17,21 @@ Three invariants, all whole-program:
    is idempotent at runtime, so a second site "works" — until its doc
    string, type, or label set drifts from the first; a lookup of a
    never-registered literal name is a typo that returns None at 3am.
+4. The user_guide's knob tables agree with the registry: a table row
+   naming a `HOROVOD_*` variable that is not declared is a stale row
+   (renamed/removed knob still being taught to users), and a row
+   whose default cell contradicts the declared default is docs drift
+   nothing used to check. The doc file is located by convention —
+   `docs/user_guide.md` two levels above the registry's `common/`
+   directory — so fixture registries (which do not live in a
+   `common/` dir) never scan the real docs.
 """
 
 from __future__ import annotations
 
 import ast
+import os
+import re
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..model import (Finding, Project, SourceFile, attr_chain,
@@ -30,6 +40,91 @@ from . import Rule
 
 ENV_PREFIX = "HOROVOD_"
 METRIC_REG_METHODS = ("counter", "gauge", "histogram")
+
+_DOC_KNOB_RE = re.compile(r"\bHOROVOD_[A-Z0-9_]+\b")
+
+
+def _default_tokens(default) -> List[str]:
+    """Textual forms a doc default cell may legitimately spell the
+    declared default as. Empty list = not checkable (empty-string and
+    non-literal defaults have no canonical doc spelling)."""
+    if isinstance(default, bool):
+        return (["1", "true", "on", "yes"] if default
+                else ["0", "false", "off", "no"])
+    if isinstance(default, (int, float)):
+        toks = [repr(default)]
+        if isinstance(default, float) and default == int(default):
+            toks.append(str(int(default)))
+        return toks
+    if isinstance(default, str) and default:
+        return [default]
+    return []
+
+
+def doc_table_findings(project: Project) -> List[Finding]:
+    """Invariant 4: the user_guide knob tables vs the registry."""
+    reg = project.registry
+    rf = project.registry_file
+    if reg is None or rf is None:
+        return []
+    cfg_dir = os.path.dirname(os.path.abspath(rf.path))
+    if os.path.basename(cfg_dir) != "common":
+        return []  # fixture/synthetic registries: no docs convention
+    root = os.path.dirname(os.path.dirname(cfg_dir))
+    doc_path = os.path.join(root, "docs", "user_guide.md")
+    if not os.path.isfile(doc_path):
+        return []
+    # rel path in the analyzer's scheme: relative to the dir the rel
+    # paths of the scanned sources are anchored at.
+    pkg_rel_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(rf.rel)))
+    doc_rel = "/".join(p for p in (pkg_rel_root, "docs",
+                                   "user_guide.md") if p)
+    by_env = {k.env: k for k in reg.knobs}
+    findings: List[Finding] = []
+    try:
+        with open(doc_path, "r", encoding="utf-8",
+                  errors="replace") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return []
+    for lineno, line in enumerate(lines, start=1):
+        if not line.lstrip().startswith("|"):
+            continue
+        cells = [c.strip() for c in line.split("|")]
+        if len(cells) < 3:
+            continue
+        name_cell = cells[1]
+        for env in _DOC_KNOB_RE.findall(name_cell):
+            kd = by_env.get(env)
+            if kd is None:
+                findings.append(Finding(
+                    "HVD002", doc_rel, lineno, 1,
+                    f"user_guide knob table row names '{env}', "
+                    f"which is not declared in {reg.rel} — a stale "
+                    f"row still teaching users a renamed or removed "
+                    f"knob", "<knob-table>"))
+                continue
+            # 3-column rows (| name | default | doc |) carry a
+            # default cell; 2-column rows are name+doc only.
+            if len(cells) < 5 or not kd.has_default:
+                continue
+            toks = _default_tokens(kd.default)
+            if not toks:
+                continue
+            cell = cells[2]
+            if not re.search(r"[0-9A-Za-z]", cell):
+                continue
+            low = cell.lower()
+            if not any(re.search(
+                    rf"(?<![0-9A-Za-z_.]){re.escape(t.lower())}"
+                    rf"(?![0-9A-Za-z_.])", low) for t in toks):
+                findings.append(Finding(
+                    "HVD002", doc_rel, lineno, 1,
+                    f"user_guide knob table row for '{env}' shows "
+                    f"default {cell!r} but {reg.rel} declares "
+                    f"{kd.default!r} — docs drift", "<knob-table>"))
+    return findings
 
 
 def env_read_key(node: ast.AST) -> Optional[Tuple[str, ast.AST]]:
@@ -171,4 +266,7 @@ class RegistryRule(Rule):
                     f"metric '{name}' is looked up but never "
                     f"registered anywhere in the scanned sources "
                     f"(typo or dead lookup)", sf.context_of(node)))
+
+        # ---- user_guide knob tables vs the registry ---------------------
+        findings.extend(doc_table_findings(project))
         return findings

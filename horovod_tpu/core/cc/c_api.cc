@@ -15,6 +15,8 @@
 using hvdtpu::Controller;
 using hvdtpu::ControllerOptions;
 using hvdtpu::Entry;
+using hvdtpu::Mutex;
+using hvdtpu::MutexLock;
 
 namespace {
 
@@ -26,9 +28,11 @@ namespace {
 struct CoreHandle {
   explicit CoreHandle(const ControllerOptions& o) : ctrl(o) {}
   Controller ctrl;
-  std::mutex mu;        // guards stash (+ serialization path)
-  std::string stash;    // pending serialized batch, empty = none
-  bool stash_valid = false;  // distinguishes an empty batch from none
+  Mutex mu;             // guards stash (+ serialization path)
+  // pending serialized batch, empty = none
+  std::string stash GUARDED_BY(mu);
+  // distinguishes an empty batch from none
+  bool stash_valid GUARDED_BY(mu) = false;
 };
 
 }  // namespace
@@ -116,7 +120,7 @@ long long hvd_core_control_bytes(void* h) {
 long long hvd_core_next_batch(void* h, char* buf, long long bufsize,
                               double timeout_s) {
   CoreHandle* ch = static_cast<CoreHandle*>(h);
-  std::lock_guard<std::mutex> lk(ch->mu);
+  MutexLock lk(ch->mu);
   if (!ch->stash_valid) {
     std::vector<Entry> entries;
     if (!ch->ctrl.NextBatch(timeout_s, &entries)) return -1;

@@ -17,6 +17,8 @@
 #include <mutex>
 #include <string>
 
+#include "thread_annotations.h"
+
 namespace hvdtpu {
 
 enum class LogLevel : int {
@@ -51,7 +53,7 @@ class Logger {
     va_end(args);
     static const char* names[] = {"TRACE", "DEBUG", "INFO",
                                   "WARN",  "ERROR", "FATAL"};
-    std::lock_guard<std::mutex> lk(mu_);
+    MutexLock lk(mu_);
     fprintf(stderr, "[hvdtpu_core %s] %s\n",
             names[static_cast<int>(level)], buf);
   }
@@ -72,7 +74,7 @@ class Logger {
     level_.store(v);
   }
   std::atomic<int> level_;
-  std::mutex mu_;
+  Mutex mu_;  // serializes the stderr write (one line per record)
 };
 
 #define HVD_LOG(level, ...)                                       \

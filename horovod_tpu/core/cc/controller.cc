@@ -192,7 +192,7 @@ Controller::~Controller() { Shutdown(); }
 
 void Controller::SetError(const std::string& msg) {
   {
-    std::lock_guard<std::mutex> lk(err_mu_);
+    MutexLock lk(err_mu_);
     last_error_ = msg;
   }
   ok_.store(false);
@@ -214,15 +214,15 @@ void Controller::Abort() {
     EnqueueToWorkers(BuildFrame(MsgType::kShutdown, ""));
   shutdown_.store(true);
   {
-    std::lock_guard<std::mutex> lk(pump_mu_);
+    MutexLock lk(pump_mu_);
     pump_cv_.notify_all();
   }
   {
-    std::lock_guard<std::mutex> lk(ready_mu_);
+    MutexLock lk(ready_mu_);
     ready_cv_.notify_all();
   }
   {
-    std::lock_guard<std::mutex> lk(submit_mu_);
+    MutexLock lk(submit_mu_);
     cycle_cv_.notify_all();
   }
   if (coord_fd_ >= 0) ::shutdown(coord_fd_, SHUT_RDWR);
@@ -240,7 +240,7 @@ void Controller::Shutdown() {
     // scope, so joining while holding it would deadlock.
     std::vector<std::thread> readers;
     {
-      std::lock_guard<std::mutex> lk(reader_threads_mu_);
+      MutexLock lk(reader_threads_mu_);
       readers.swap(reader_threads_);
       finished_thread_ids_.clear();
     }
@@ -267,7 +267,7 @@ void Controller::Submit(const std::string& name, const std::string& sig,
   // Requests carrying metadata (uneven allgather sizes / alltoall
   // splits — values that vary per call) always go the full path.
   if (opts_.rank != 0 && opts_.cache_capacity > 0 && meta.empty()) {
-    std::lock_guard<std::mutex> clk(cache_mu_);
+    MutexLock clk(cache_mu_);
     auto it = submit_cache_.find(name);
     if (it != submit_cache_.end() && it->second.sig == sig)
       r.cache_id = it->second.id;
@@ -279,7 +279,7 @@ void Controller::Submit(const std::string& name, const std::string& sig,
     r.meta = meta;
   }
   {
-    std::lock_guard<std::mutex> lk(submit_mu_);
+    MutexLock lk(submit_mu_);
     pending_.push_back(std::move(r));
   }
   cycle_cv_.notify_one();
@@ -287,7 +287,7 @@ void Controller::Submit(const std::string& name, const std::string& sig,
 
 void Controller::Join() {
   {
-    std::lock_guard<std::mutex> lk(submit_mu_);
+    MutexLock lk(submit_mu_);
     Request r;
     r.join = true;
     pending_.push_back(std::move(r));
@@ -297,14 +297,14 @@ void Controller::Join() {
 
 bool Controller::NextBatch(double timeout_s, std::vector<Entry>* out) {
   out->clear();
-  std::unique_lock<std::mutex> lk(ready_mu_);
+  CondLock lk(ready_mu_);
   // system_clock wait_until, not wait_for: libstdc++ lowers
   // steady-clock waits to pthread_cond_clockwait, which this
   // toolchain's ThreadSanitizer cannot see through (phantom
   // double-lock reports in the TSAN stress). A clock step stretches
   // one timeout; the caller re-polls, so that is harmless.
   if (!ready_cv_.wait_until(
-          lk,
+          lk.native(),
           std::chrono::system_clock::now() +
               std::chrono::microseconds(
                   static_cast<int64_t>(timeout_s * 1e6)),
@@ -320,7 +320,7 @@ bool Controller::NextBatch(double timeout_s, std::vector<Entry>* out) {
 }
 
 int Controller::AllJoined() {
-  std::lock_guard<std::mutex> lk(ready_mu_);
+  MutexLock lk(ready_mu_);
   return all_joined_last_rank_;
 }
 
@@ -344,7 +344,7 @@ void Controller::CycleLoop() {
   while (!shutdown_.load()) {
     std::vector<Request> mine;
     {
-      std::unique_lock<std::mutex> lk(submit_mu_);
+      CondLock lk(submit_mu_);
       if (paced) {
         // system_clock wait_until, NOT wait_for: libstdc++ lowers
         // steady-clock waits to pthread_cond_clockwait, which this
@@ -353,13 +353,13 @@ void Controller::CycleLoop() {
         // double-locks/races). An NTP step can stretch or shrink ONE
         // pacing tick; the loop re-checks, so that is harmless.
         cycle_cv_.wait_until(
-            lk,
+            lk.native(),
             std::chrono::system_clock::now() +
                 std::chrono::microseconds(static_cast<int64_t>(
                     cycle_time_ms_.load() * 1000.0)),
             [&] { return shutdown_.load(); });
       } else {
-        cycle_cv_.wait(lk, [&] {
+        cycle_cv_.wait(lk.native(), [&] {
           return shutdown_.load() || !pending_.empty() || agg_wake_;
         });
       }
@@ -377,8 +377,8 @@ void Controller::CycleLoop() {
       // paced wait above.)
       auto deadline = std::chrono::system_clock::now() +
                       std::chrono::microseconds(opts_.agg_linger_us);
-      std::unique_lock<std::mutex> lk(submit_mu_);
-      cycle_cv_.wait_until(lk, deadline, [&] {
+      CondLock lk(submit_mu_);
+      cycle_cv_.wait_until(lk.native(), deadline, [&] {
         return shutdown_.load() || AllChildrenReported();
       });
       for (auto& r : pending_) mine.push_back(std::move(r));
@@ -396,7 +396,7 @@ void Controller::CycleLoop() {
       WorkTimer wt(&work_ns_);
       AggMap out;
       {
-        std::lock_guard<std::mutex> alk(agg_mu_);
+        MutexLock alk(agg_mu_);
         out.swap(agg_pending_);
         agg_reported_ = RankSet(0, opts_.size);
       }
@@ -475,7 +475,7 @@ void Controller::MarkReady(const std::string& name, TensorState& st,
 }
 
 void Controller::CoordinatorIngest(int rank, std::vector<Request> reqs) {
-  std::lock_guard<std::mutex> lk(coord_mu_);
+  MutexLock lk(coord_mu_);
   double now = NowSeconds();
   for (auto& r : reqs) {
     if (r.cache_id != 0) {
@@ -508,7 +508,7 @@ void Controller::CoordinatorIngestAgg(std::vector<AggEntry> entries) {
   // announcement with a rank BITSET instead of one frame per rank.
   // Root-side work per burst is O(distinct tensors x arity), not
   // O(world): the unions are word-ops on dense sets.
-  std::lock_guard<std::mutex> lk(coord_mu_);
+  MutexLock lk(coord_mu_);
   double now = NowSeconds();
   for (auto& e : entries) {
     if (e.ranks.lo() < 0 || e.ranks.hi() > opts_.size ||
@@ -550,7 +550,7 @@ void Controller::CoordinatorIngestAgg(std::vector<AggEntry> entries) {
 
 void Controller::WakeCycleForAgg() {
   {
-    std::lock_guard<std::mutex> lk(submit_mu_);
+    MutexLock lk(submit_mu_);
     agg_wake_ = true;
   }
   cycle_cv_.notify_one();
@@ -558,7 +558,7 @@ void Controller::WakeCycleForAgg() {
 
 void Controller::MergeChildRequests(int rank, std::vector<Request> reqs) {
   {
-    std::lock_guard<std::mutex> lk(agg_mu_);
+    MutexLock lk(agg_mu_);
     for (auto& r : reqs) MergeRequest(&agg_pending_, opts_.size, rank, r);
     agg_reported_.set(rank);
   }
@@ -567,7 +567,7 @@ void Controller::MergeChildRequests(int rank, std::vector<Request> reqs) {
 
 void Controller::MergeChildAgg(int rank, std::vector<AggEntry> entries) {
   {
-    std::lock_guard<std::mutex> lk(agg_mu_);
+    MutexLock lk(agg_mu_);
     for (auto& e : entries)
       if (!MergeAgg(&agg_pending_, opts_.size, e))
         HVD_LOG(kWarning, "dropping malformed agg entry from child");
@@ -577,7 +577,7 @@ void Controller::MergeChildAgg(int rank, std::vector<AggEntry> entries) {
 }
 
 bool Controller::AllChildrenReported() {
-  std::lock_guard<std::mutex> lk(agg_mu_);
+  MutexLock lk(agg_mu_);
   return agg_reported_.count() >= connected_children_.load();
 }
 
@@ -587,7 +587,7 @@ void Controller::RunCoordinatorCycle() {
     // Work accounting scoped to the cut itself; BroadcastEntries'
     // fan-out is timed inside EnqueueToWorkers (no double count).
     WorkTimer wt(&work_ns_);
-    std::lock_guard<std::mutex> lk(coord_mu_);
+    MutexLock lk(coord_mu_);
     double now = NowSeconds();
     // Re-check readiness: a rank joining can make earlier tensors
     // eligible (their missing submitters are gone).
@@ -784,13 +784,13 @@ void Controller::EnqueueToWorkers(const std::string& frame) {
   // pump marks inflight under pump_mu_ before it writes).
   std::vector<int> fds;
   {
-    std::lock_guard<std::mutex> clk(coord_mu_);
+    MutexLock clk(coord_mu_);
     fds = worker_fds_;
   }
   bool queued = false;
   std::vector<int> severed;
   {
-    std::lock_guard<std::mutex> lk(pump_mu_);
+    MutexLock lk(pump_mu_);
     for (int r : place_.children) {
       if (fds[r] < 0) continue;
       if (pump_buf_[r].size() + pump_inflight_[r] + frame.size() >
@@ -828,7 +828,7 @@ void Controller::EnqueueToWorkers(const std::string& frame) {
     }
   }
   if (!severed.empty()) {
-    std::lock_guard<std::mutex> clk(coord_mu_);
+    MutexLock clk(coord_mu_);
     for (int r : severed)
       if (r < static_cast<int>(worker_fds_.size()) &&
           worker_fds_[r] == fds[r]) {
@@ -857,7 +857,7 @@ void Controller::PumpLoop() {
   while (true) {
     int r_next = -1;
     {
-      std::unique_lock<std::mutex> lk(pump_mu_);
+      CondLock lk(pump_mu_);
       for (int k = 0; k < n; ++k) {
         int r = kids[(rr + k) % n];
         if (!pump_buf_[r].empty()) { r_next = r; rr = (rr + k) % n;
@@ -866,7 +866,7 @@ void Controller::PumpLoop() {
       if (r_next < 0) {
         if (shutdown_.load()) break;  // fully drained
         stall_anchor = -1;
-        pump_cv_.wait_until(lk, std::chrono::system_clock::now() +
+        pump_cv_.wait_until(lk.native(), std::chrono::system_clock::now() +
                                     std::chrono::milliseconds(50));
         continue;
       }
@@ -878,14 +878,14 @@ void Controller::PumpLoop() {
     if (shutdown_.load()) {
       if (shutdown_seen_at == 0.0) shutdown_seen_at = NowSeconds();
       if (NowSeconds() - shutdown_seen_at > kFlushWindowS) {
-        std::lock_guard<std::mutex> lk(pump_mu_);
+        MutexLock lk(pump_mu_);
         pump_inflight_[r_next] = 0;
         break;
       }
     }
     int fd;
     {
-      std::lock_guard<std::mutex> clk(coord_mu_);
+      MutexLock clk(coord_mu_);
       fd = r_next < static_cast<int>(worker_fds_.size())
                ? worker_fds_[r_next] : -1;
     }
@@ -910,7 +910,7 @@ void Controller::PumpLoop() {
     }
     bool progressed = off > 0;
     {
-      std::unique_lock<std::mutex> lk(pump_mu_);
+      CondLock lk(pump_mu_);
       pump_inflight_[r_next] = 0;
       if (off < local.size()) {
         // Prepend the unsent tail so per-rank frame order is
@@ -927,7 +927,7 @@ void Controller::PumpLoop() {
         // spinning on EAGAIN (with ONE stuck rank this sleeps after
         // a single futile revisit, not after n-1 of them).
         stall_anchor = -1;
-        pump_cv_.wait_until(lk, std::chrono::system_clock::now() +
+        pump_cv_.wait_until(lk.native(), std::chrono::system_clock::now() +
                                     std::chrono::milliseconds(1));
       } else if (stall_anchor < 0) {
         stall_anchor = r_next;
@@ -937,7 +937,7 @@ void Controller::PumpLoop() {
   // Shutdown: sever worker fds so reader threads unblock (the old
   // Abort() did this inline; it now belongs to the pump, after the
   // final kShutdown frames had their flush window).
-  std::lock_guard<std::mutex> clk(coord_mu_);
+  MutexLock clk(coord_mu_);
   for (int fd : worker_fds_)
     if (fd >= 0) ::shutdown(fd, SHUT_RDWR);
 }
@@ -946,12 +946,12 @@ void Controller::DeliverEntries(const std::vector<Entry>& entries) {
   // Learn response-cache assignments from the coordinator's broadcast
   // (reference: workers updating their ResponseCache from responses).
   if (opts_.rank != 0 && opts_.cache_capacity > 0) {
-    std::lock_guard<std::mutex> lk(cache_mu_);
+    MutexLock lk(cache_mu_);
     for (const auto& e : entries)
       if (e.cache_id != 0)
         submit_cache_[e.name] = CacheSlot{e.cache_id, e.sig};
   }
-  std::lock_guard<std::mutex> lk(ready_mu_);
+  MutexLock lk(ready_mu_);
   for (const auto& e : entries) {
     if (e.name == kAllJoined) {
       all_joined_last_rank_ = e.active_ranks;
@@ -984,7 +984,7 @@ void Controller::ServerAcceptLoop() {
     int one = 1;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
     handshaking_.fetch_add(1);
-    std::lock_guard<std::mutex> lk(reader_threads_mu_);
+    MutexLock lk(reader_threads_mu_);
     // Reap threads that announced completion (failed handshakes,
     // closed readers) so repeated connect attempts over a long job
     // cannot accumulate unbounded exited-but-joinable threads.
@@ -1017,7 +1017,7 @@ void Controller::HandshakeConn(int fd) {
       self->handshaking_.fetch_sub(1);
       // Mark this thread reapable by the accept loop (it holds
       // reader_threads_mu_ only briefly; we are off the hot path).
-      std::lock_guard<std::mutex> lk(self->reader_threads_mu_);
+      MutexLock lk(self->reader_threads_mu_);
       self->finished_thread_ids_.push_back(
           std::this_thread::get_id());
     }
@@ -1061,7 +1061,7 @@ void Controller::HandshakeConn(int fd) {
     // Claim-once check under ONE lock: concurrent handshakes for the
     // same rank must not be able to interleave between check and
     // store.
-    std::lock_guard<std::mutex> lk(coord_mu_);
+    MutexLock lk(coord_mu_);
     if (worker_claimed_[rank]) {
       HVD_LOG(kWarning, "duplicate hello for rank %u rejected", rank);
       ::close(fd);
@@ -1080,7 +1080,7 @@ void Controller::HandshakeConn(int fd) {
                 : CoordMac(opts_.auth_secret, worker_nonce));
   SendMsg(fd, MsgType::kWelcome, wl.data());
   {
-    std::lock_guard<std::mutex> lk(coord_mu_);
+    MutexLock lk(coord_mu_);
     worker_fds_[rank] = fd;
   }
   connected_children_.fetch_add(1);
@@ -1152,7 +1152,7 @@ void Controller::WorkerReaderLoop() {
   if (!shutdown_.load()) {
     bool joined;
     {
-      std::lock_guard<std::mutex> lk(ready_mu_);
+      MutexLock lk(ready_mu_);
       joined = all_joined_last_rank_ >= 0;
     }
     if (!clean && !joined) {

@@ -105,14 +105,22 @@ class Controller {
   void Join();
 
   // Worker thread: block up to timeout_s for the next agreed batch.
-  // Returns false on shutdown; *error is set per-entry.
-  bool NextBatch(double timeout_s, std::vector<Entry>* out);
+  // Returns false on shutdown; *error is set per-entry. (Opted out
+  // of the thread-safety analysis: the cv-wait predicate lambda
+  // reads ready_ under the held CondLock, which the intra-procedural
+  // analysis cannot follow into the lambda.)
+  bool NextBatch(double timeout_s, std::vector<Entry>* out)
+      NO_THREAD_SAFETY_ANALYSIS;
 
   // -1 until the coordinator reports all ranks joined; then the rank
   // that joined last (the hvd.join() return value in the reference).
   int AllJoined();
 
-  void Shutdown();
+  // Joins every controller thread, then tears the sockets down. The
+  // post-join section touches GUARDED_BY state without locks — by
+  // then the process is single-threaded again (quiescence the
+  // analysis cannot express), hence the explicit opt-out.
+  void Shutdown() NO_THREAD_SAFETY_ANALYSIS;
   // Live-tunable fusion threshold (reference: ParameterManager
   // adjusting HOROVOD_FUSION_THRESHOLD online). Coordinator-side.
   void SetFusionThreshold(int64_t bytes) {
@@ -133,7 +141,7 @@ class Controller {
   // Returns a copy: the string may be rewritten by controller threads
   // (lost connection, reader errors) concurrently with this read.
   std::string last_error() const {
-    std::lock_guard<std::mutex> lk(err_mu_);
+    MutexLock lk(err_mu_);
     return last_error_;
   }
   int64_t cycles() const { return cycles_; }
@@ -156,28 +164,39 @@ class Controller {
   int64_t frames_ingested() const { return frames_in_.load(); }
 
  private:
-  void CycleLoop();
-  void PumpLoop();
+  // Condition-variable predicates capture guarded fields in lambdas
+  // the (intra-procedural) thread-safety analysis cannot follow, so
+  // the cv-wait loops opt out explicitly; every access in them still
+  // happens under the right CondLock (reviewed, and dynamically
+  // vetted by the TSAN stress binary).
+  void CycleLoop() NO_THREAD_SAFETY_ANALYSIS;
+  void PumpLoop() NO_THREAD_SAFETY_ANALYSIS;
   void EnqueueToWorkers(const std::string& frame);
   // Set shutdown + wake everything WITHOUT joining threads — safe to
   // call from the controller's own threads (Shutdown() joins and must
-  // only run on an external thread).
-  void Abort();
+  // only run on an external thread). Opted out: it reads fd fields
+  // that are written once before threads start and severed here
+  // without locks (shutdown_ ordering, not locking, is the protocol).
+  void Abort() NO_THREAD_SAFETY_ANALYSIS;
   void SetError(const std::string& msg);
   void CoordinatorIngest(int rank, std::vector<Request> reqs);
   void CoordinatorIngestAgg(std::vector<AggEntry> entries);
   struct TensorState;
-  // Shared ingest helpers (coord_mu_ held by the caller).
+  // Shared ingest helpers — the REQUIRES contract is what used to be
+  // the "coord_mu_ held by the caller" comment, now machine-checked
+  // at every call site under clang.
   TensorState& UpsertTensor(const std::string& name,
                             const std::string& sig, int64_t nbytes,
-                            int reporting_rank, double now);
-  void MarkReady(const std::string& name, TensorState& st, double now);
+                            int reporting_rank, double now)
+      REQUIRES(coord_mu_);
+  void MarkReady(const std::string& name, TensorState& st, double now)
+      REQUIRES(coord_mu_);
   // Aggregator side: fold a child's frame into agg_pending_ and wake
   // the cycle thread to forward it upward.
   void MergeChildRequests(int rank, std::vector<Request> reqs);
   void MergeChildAgg(int rank, std::vector<AggEntry> entries);
   void WakeCycleForAgg();
-  bool AllChildrenReported();
+  bool AllChildrenReported() EXCLUDES(agg_mu_);
   void RunCoordinatorCycle();
   void BroadcastEntries(const std::vector<Entry>& entries);
   void DeliverEntries(const std::vector<Entry>& entries);
@@ -185,7 +204,7 @@ class Controller {
   void HandshakeConn(int fd);
   void ReaderLoop(int rank, int fd);
   void WorkerReaderLoop();
-  void CheckStalls(double now);
+  void CheckStalls(double now) REQUIRES(coord_mu_);
 
   ControllerOptions opts_;
   std::atomic<int64_t> fusion_threshold_{64 << 20};
@@ -193,8 +212,8 @@ class Controller {
   std::atomic<int> quiesce_cycles_{0};
   std::atomic<bool> shutdown_{false};
   std::atomic<bool> ok_{true};
-  mutable std::mutex err_mu_;
-  std::string last_error_;
+  mutable Mutex err_mu_;
+  std::string last_error_ GUARDED_BY(err_mu_);
   std::atomic<int64_t> cycles_{0};
   std::atomic<int64_t> control_bytes_sent_{0};
 
@@ -214,19 +233,19 @@ class Controller {
   // cycle_time_ms pacing, which is what preserves fusion batching
   // and quiescence semantics (a cut still collects everything that
   // arrived in the window).
-  std::mutex submit_mu_;
+  Mutex submit_mu_;
   std::condition_variable cycle_cv_;
-  bool agg_wake_ = false;  // child data pending (under submit_mu_)
-  std::vector<Request> pending_;
+  bool agg_wake_ GUARDED_BY(submit_mu_) = false;  // child data pending
+  std::vector<Request> pending_ GUARDED_BY(submit_mu_);
 
   // --- aggregator merge state (non-root ranks with children) ---
-  std::mutex agg_mu_;
-  AggMap agg_pending_;
+  Mutex agg_mu_;
+  AggMap agg_pending_ GUARDED_BY(agg_mu_);
   // Direct children that have reported since the last upward
   // forward: when every CONNECTED child has, the cycle forwards
   // immediately (steady state = exactly one merged frame per tier
   // per burst); otherwise the agg_linger_us cap bounds the wait.
-  RankSet agg_reported_;
+  RankSet agg_reported_ GUARDED_BY(agg_mu_);
   std::atomic<int> connected_children_{0};
 
   // --- per-node control-plane accounting (see control_work_ns) ---
@@ -241,14 +260,15 @@ class Controller {
     uint32_t id = 0;
     std::string sig;
   };
-  std::mutex cache_mu_;
-  std::unordered_map<std::string, CacheSlot> submit_cache_;
+  Mutex cache_mu_;
+  std::unordered_map<std::string, CacheSlot> submit_cache_
+      GUARDED_BY(cache_mu_);
 
   // --- agreed batches awaiting execution ---
-  std::mutex ready_mu_;
+  Mutex ready_mu_;
   std::condition_variable ready_cv_;
-  std::deque<Entry> ready_;
-  int all_joined_last_rank_ = -1;
+  std::deque<Entry> ready_ GUARDED_BY(ready_mu_);
+  int all_joined_last_rank_ GUARDED_BY(ready_mu_) = -1;
 
   // --- coordinator state (rank 0 only) ---
   struct TensorState {
@@ -265,10 +285,14 @@ class Controller {
     bool error_sent = false;
     std::string error;
   };
-  std::mutex coord_mu_;
-  std::map<std::string, TensorState> tensors_;  // pending negotiation
-  std::vector<std::string> ready_order_;        // fully-ready FIFO
-  std::set<int> joined_ranks_;
+  Mutex coord_mu_;
+  // pending negotiation, fully-ready FIFO, joined set: the
+  // tree.h containers (RankSet readiness bitsets inside TensorState,
+  // the AggMap above) carry no internal locking by design — their
+  // thread-safety contract is exactly these GUARDED_BY declarations.
+  std::map<std::string, TensorState> tensors_ GUARDED_BY(coord_mu_);
+  std::vector<std::string> ready_order_ GUARDED_BY(coord_mu_);
+  std::set<int> joined_ranks_ GUARDED_BY(coord_mu_);
   // Response cache, coordinator side: id -> full request metadata, so
   // cached 5-byte announcements expand back losslessly. Ids are
   // assigned once per name (capacity-bounded, never reused), so
@@ -279,16 +303,19 @@ class Controller {
     std::string sig;
     int64_t nbytes = 0;
   };
-  std::unordered_map<uint32_t, CachedTensor> coord_cache_;
-  std::unordered_map<std::string, uint32_t> coord_cache_ids_;
-  uint32_t next_cache_id_ = 1;
-  int last_joined_rank_ = -1;
-  bool join_announced_ = false;
-  int32_t next_batch_id_ = 1;
-  int64_t stall_warned_gen_ = 0;
-  // Quiescence-gate state (coordinator cycle thread only).
-  size_t quiesce_last_ready_ = 0;
-  int quiesce_stable_ = 0;
+  std::unordered_map<uint32_t, CachedTensor> coord_cache_
+      GUARDED_BY(coord_mu_);
+  std::unordered_map<std::string, uint32_t> coord_cache_ids_
+      GUARDED_BY(coord_mu_);
+  uint32_t next_cache_id_ GUARDED_BY(coord_mu_) = 1;
+  int last_joined_rank_ GUARDED_BY(coord_mu_) = -1;
+  bool join_announced_ GUARDED_BY(coord_mu_) = false;
+  int32_t next_batch_id_ GUARDED_BY(coord_mu_) = 1;
+  int64_t stall_warned_gen_ GUARDED_BY(coord_mu_) = 0;
+  // Quiescence-gate state (coordinator cycle thread only; the cycle
+  // thread always holds coord_mu_ when it touches these).
+  size_t quiesce_last_ready_ GUARDED_BY(coord_mu_) = 0;
+  int quiesce_stable_ GUARDED_BY(coord_mu_) = 0;
 
   // --- sockets ---
   // "coordinator side" below means ANY node with children — the root
@@ -297,15 +324,17 @@ class Controller {
   // its own subtree).
   int listen_fd_ = -1;
   int coord_fd_ = -1;                 // upward connection (to parent)
-  std::vector<int> worker_fds_;       // fd per CHILD rank (idx = rank)
+  // fd per CHILD rank (idx = rank), sized once in the constructor.
+  std::vector<int> worker_fds_ GUARDED_BY(coord_mu_);
   // Severed-for-cap-breach fds: unlinked from worker_fds_ (so
   // broadcasts stop paying for the dead rank) but kept open until
   // Shutdown() — the pump may still hold the raw fd mid-write, and
-  // close() under it would race fd reuse. Guarded by coord_mu_.
-  std::vector<int> retired_fds_;
-  std::vector<char> worker_claimed_;  // rank slot claimed (pre-fd)
+  // close() under it would race fd reuse.
+  std::vector<int> retired_fds_ GUARDED_BY(coord_mu_);
+  // rank slot claimed (pre-fd)
+  std::vector<char> worker_claimed_ GUARDED_BY(coord_mu_);
   std::atomic<int> handshaking_{0};   // in-flight handshake threads
-  std::mutex send_mu_;                // worker side: serialize
+  Mutex send_mu_;                     // worker side: serialize
                                       // coord_fd_ writes
 
   // --- broadcast pump (coordinator): the round-3 serial O(N)
@@ -317,13 +346,14 @@ class Controller {
   // block the other N-1 — its bytes just sit in ITS outbox. A worker
   // whose outbox exceeds kPumpCap is severed (its reader path then
   // reports the loss), bounding coordinator memory.
-  std::mutex pump_mu_;
+  Mutex pump_mu_;
   std::condition_variable pump_cv_;
-  std::vector<std::string> pump_buf_;   // per-rank pending frames
+  // per-rank pending frames
+  std::vector<std::string> pump_buf_ GUARDED_BY(pump_mu_);
   // Bytes the pump has swapped out of a rank's outbox but not yet
   // written — counted by the kPumpCap check so a wedged rank's
   // pending memory is bounded by ONE cap, not two.
-  std::vector<size_t> pump_inflight_;
+  std::vector<size_t> pump_inflight_ GUARDED_BY(pump_mu_);
   std::atomic<bool> aborting_{false};
   static constexpr size_t kPumpCap = 64u << 20;
 
@@ -333,9 +363,11 @@ class Controller {
   // finish (failed handshake, closed connection) enqueue their id in
   // finished_thread_ids_; the accept loop joins and prunes them
   // before spawning the next, bounding thread accumulation.
-  std::mutex reader_threads_mu_;
-  std::vector<std::thread> reader_threads_;
-  std::vector<std::thread::id> finished_thread_ids_;
+  Mutex reader_threads_mu_;
+  std::vector<std::thread> reader_threads_
+      GUARDED_BY(reader_threads_mu_);
+  std::vector<std::thread::id> finished_thread_ids_
+      GUARDED_BY(reader_threads_mu_);
 };
 
 }  // namespace hvdtpu

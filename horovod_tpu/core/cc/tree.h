@@ -18,6 +18,15 @@
 // reference this property for free (horovod/common/gloo/
 // gloo_controller.cc); this build's point-to-point TCP control plane
 // has to earn it explicitly.
+// Thread-safety contract: nothing in this header locks. RankSet and
+// AggMap/AggEntry are plain containers mutated by whichever
+// controller thread holds the owning mutex — the GUARDED_BY
+// declarations on `Controller::tensors_` / `agg_pending_` /
+// `agg_reported_` (controller.h, thread_annotations.h) ARE the
+// contract, and clang's -Wthread-safety leg of `make check` enforces
+// it at every access. Keeping the containers lock-free is what lets
+// the word-aligned bitset unions stay branch-and-allocation-free on
+// the ingest hot path.
 #pragma once
 
 #include <cstdint>
@@ -25,6 +34,7 @@
 #include <string>
 #include <vector>
 
+#include "thread_annotations.h"
 #include "wire.h"
 
 namespace hvdtpu {

@@ -160,6 +160,21 @@ def partition_cached(leaves: Sequence[Any],
     return partition_signature(sig, int(threshold_bytes))
 
 
+def partition_digest(leaves: Sequence[Any], threshold_bytes: int,
+                     key_fn: Optional[Callable[[int, Any], Any]]
+                     = None) -> str:
+    """`assignment_digest` of a fresh partition — the one-call form of
+    the SPMD cross-process contract ("every process derives this
+    identical string from its identical tree"). The HVD007 jaxpr
+    verifier compares this against `parallel.train.plan_overlap`'s
+    digest and against the eager grouped-allreduce plan
+    (`partition_cached`), so a partitioner change that would compile
+    different programs on different processes fails lint, not a
+    rollout."""
+    return assignment_digest(
+        partition_buckets(leaves, threshold_bytes, key_fn))
+
+
 def split_by_dtype(items: Sequence[Any]) -> List[List[int]]:
     """Same-dtype index subgroups preserving order within each — the
     per-dtype wire-packing rule both the eager fusion

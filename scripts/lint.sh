@@ -1,21 +1,32 @@
 #!/usr/bin/env bash
-# Repo lint gate: ruff (style/pyflakes) + hvdlint (framework
-# invariants: SPMD divergence, knob registry, lock discipline, trace
-# purity, collective-protocol consistency, lockset races) + the
-# native core's -Werror compile check. Exit nonzero on any finding —
-# this is the CI entry point; tests/test_lint.py runs the hvdlint
-# half in-process as part of tier-1.
+# Repo lint gate: ruff (style/pyflakes) + hvdlint AST tiers
+# (framework invariants: SPMD divergence, knob registry + docs drift,
+# lock discipline, trace purity, collective-protocol consistency,
+# lockset races) + the hvdlint SEMANTIC tier (HVD007: the traced
+# step builders' collective invariants, source-hash cached) + the
+# native core's -Werror compile check (plus a -Wthread-safety leg
+# when clang is available) + the wire-parser fuzzer under
+# ASan/UBSan when the toolchain supports it. Exit nonzero on any
+# finding — this is the CI entry point; tests/test_lint.py runs the
+# hvdlint halves in-process as part of tier-1.
 #
 # Pre-commit fast path: `scripts/lint.sh --changed-only [REF]` makes
 # hvdlint analyze only the files touched since REF (default HEAD)
-# plus their call-graph neighbors. CI runs the full pass (no args).
+# plus their call-graph neighbors, and runs the jaxpr tier only when
+# the focus set touches the semantic surface (parallel/,
+# ops/bucketing.py, numerics.py, analysis/). CI runs the full pass
+# (no args).
 set -u
 cd "$(dirname "$0")/.."
 
 HVDLINT_ARGS=()
+CHANGED_ONLY=0
+CHANGED_REF="HEAD"
 if [ "${1:-}" = "--changed-only" ]; then
+    CHANGED_ONLY=1
     HVDLINT_ARGS+=(--changed-only)
     if [ -n "${2:-}" ]; then
+        CHANGED_REF="$2"
         HVDLINT_ARGS+=("$2")
     fi
 fi
@@ -31,14 +42,66 @@ else
     echo "ruff not installed; skipping (config lives in pyproject.toml)"
 fi
 
-echo "== hvdlint =="
+echo "== hvdlint (AST tiers) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m horovod_tpu.analysis horovod_tpu/ \
     ${HVDLINT_ARGS[@]+"${HVDLINT_ARGS[@]}"} || rc=1
 
+# Semantic tier: traces the real step builders (HVD007). In
+# --changed-only mode it only runs when the focus set touches the
+# surface it verifies; always bounded by its own wall-clock budget
+# (HVDLINT_JAXPR_BUDGET seconds) on hosts with coreutils timeout —
+# the source-hash cache makes warm runs near-instant either way.
+run_jaxpr=1
+if [ "$CHANGED_ONLY" = "1" ]; then
+    changed=$( { git diff --name-only "$CHANGED_REF" -- 2>/dev/null;
+                 git ls-files --others --exclude-standard 2>/dev/null; } \
+               | sort -u )
+    if ! printf '%s\n' "$changed" | grep -qE \
+        '^horovod_tpu/(parallel/|ops/bucketing\.py|numerics\.py|analysis/)'
+    then
+        run_jaxpr=0
+        echo "== hvdlint (jaxpr tier): skipped (no semantic-tier files changed) =="
+    fi
+fi
+if [ "$run_jaxpr" = "1" ]; then
+    echo "== hvdlint (jaxpr tier) =="
+    JAXPR_CMD=(python -m horovod_tpu.analysis --jaxpr)
+    if command -v timeout >/dev/null 2>&1; then
+        JAXPR_CMD=(timeout "${HVDLINT_JAXPR_BUDGET:-300}" "${JAXPR_CMD[@]}")
+    fi
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" "${JAXPR_CMD[@]}" || rc=1
+fi
+
 echo "== cc check (-Wall -Wextra -Werror) =="
 if command -v "${CXX:-g++}" >/dev/null 2>&1; then
     make -C horovod_tpu/core/cc check || rc=1
+else
+    echo "no C++ toolchain; skipping"
+fi
+
+# Wire-parser fuzz under ASan+UBSan (incl. SerializeAgg/ParseAgg):
+# sanitizer findings are check failures. Graceful skip when the
+# toolchain cannot link the sanitizers (same protocol as ruff).
+echo "== fuzz_wire (ASan/UBSan) =="
+if command -v "${CXX:-g++}" >/dev/null 2>&1; then
+    sanprobe=$(mktemp -d)
+    if printf 'int main(){return 0;}' > "$sanprobe/p.cc" \
+        && "${CXX:-g++}" -fsanitize=address,undefined \
+           "$sanprobe/p.cc" -o "$sanprobe/p" >/dev/null 2>&1 \
+        && "$sanprobe/p" >/dev/null 2>&1
+    then
+        if make -C horovod_tpu/core/cc fuzz_wire \
+            && horovod_tpu/core/cc/fuzz_wire "${FUZZ_WIRE_ITERS:-20000}"
+        then
+            :
+        else
+            rc=1
+        fi
+    else
+        echo "toolchain cannot link ASan/UBSan; skipping fuzz run"
+    fi
+    rm -rf "$sanprobe"
 else
     echo "no C++ toolchain; skipping"
 fi

@@ -1,13 +1,24 @@
-"""Historical-bug regression corpus: the three defects this repo
-actually shipped and later fixed, reconstructed in miniature, each
-asserting the analyzer would now catch it at lint time.
+"""Historical-bug regression corpus: the defects this repo actually
+shipped and later fixed, reconstructed in miniature, each asserting
+the analyzer would now catch it at lint time.
 
+AST tier (run_analysis; EXPECT-anchored):
   * PR 1 — the unlocked `_bytes_processed` accumulation raced between
     the caller thread and the controller's dispatch worker (HVD006).
   * PR 4 — `subprocess.Popen` spawned while holding `TaskService._lock`
     serialized every contender behind process startup (HVD003).
   * PR 6 — torch async handles submitted but never synchronized leaked
     their engine entries for the life of the session (HVD005).
+
+Jaxpr tier (HVD007, traced by TestHistoricalRegressions through
+analysis.jaxpr_verify.verify_traced — no EXPECT markers because these
+are IR-level defects the AST pass cannot see, which is the point):
+  * PR 8 bug #1 — the monolithic reduction leg emitted psums over
+    size-1 mesh axes (identity wire: the full pack/reduce round trip
+    with zero bytes to move, shipped in every world-1 step).
+  * PR 8 bug #2 — the legacy-jax psum transpose re-reduced an
+    already-reduced gradient over the same axis, so gradients arrived
+    exactly |axis|x too large.
 """
 
 import subprocess
@@ -62,3 +73,57 @@ class Pr6HandleLeak:
         if self._should_sync:
             return collective_ops.synchronize(h)
         return grads
+
+
+def pr8_wire_gate_builder():
+    """PR 8 bug #1, jaxpr tier: a traced step whose reduction runs
+    over a size-1 mesh axis. Before the r08 wire gate, the monolithic
+    leg emitted exactly this for every leaf at world 1 (12 dead
+    size-1 all-reduces per transformer step); HVD007's check (a) must
+    flag the size-1 reduce. Returns (jitted step, example args,
+    mesh axis sizes) for analysis.jaxpr_verify.verify_traced."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from horovod_tpu.common.compat import shard_map
+
+    mesh = Mesh(np.array(jax.devices("cpu")[:2]).reshape(2, 1),
+                ("data", "one"))
+
+    def local(g):
+        g = lax.psum(g, "data")
+        return lax.psum(g, "one")  # size-1 axis: identity wire
+
+    step = jax.jit(shard_map(local, mesh=mesh, in_specs=P(),
+                             out_specs=P()))
+    args = (jax.ShapeDtypeStruct((4,), jnp.float32),)
+    return step, args, {"data": 2, "one": 1}
+
+
+def pr8_legacy_double_reduce_builder():
+    """PR 8 bug #2, jaxpr tier: the legacy-jax psum transpose shape —
+    a gradient already psum'd over an axis is psum'd over that same
+    axis again, arriving |axis|x too large (measured 2.0x/4.0x per
+    tp/sp axis in round 8). HVD007's check (d) must flag the double
+    reduction."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from horovod_tpu.common.compat import shard_map
+
+    mesh = Mesh(np.array(jax.devices("cpu")[:2]), ("data",))
+
+    def local(g):
+        s = lax.psum(g, "data")          # the real reduction
+        return lax.psum(s, "data") * 0.5  # the transpose's re-reduce
+
+    step = jax.jit(shard_map(local, mesh=mesh, in_specs=P(),
+                             out_specs=P()))
+    args = (jax.ShapeDtypeStruct((4,), jnp.float32),)
+    return step, args, {"data": 2}

@@ -206,8 +206,20 @@ class TestCli:
         assert cli_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
         for rid in ("HVD001", "HVD002", "HVD003", "HVD004",
-                    "HVD005", "HVD006"):
+                    "HVD005", "HVD006", "HVD007"):
             assert rid in out
+
+    def test_jaxpr_mode_exit_contract(self, tmp_path, capsys,
+                                      monkeypatch):
+        """`--jaxpr` runs the semantic tier through the same CLI
+        contract: clean repo -> exit 0, cache file written next to
+        the cwd."""
+        monkeypatch.chdir(tmp_path)
+        assert cli_main(["--jaxpr"]) == 0
+        out = capsys.readouterr()
+        assert "0 finding(s)" in out.out
+        assert "config(s) verified" in out.err
+        assert (tmp_path / ".hvdlint-jaxpr-cache.json").exists()
 
 
 def _fixture_project():
@@ -326,9 +338,10 @@ class TestDataflow:
 
 
 class TestHistoricalRegressions:
-    """The three bugs this repo actually shipped (PR 1 race, PR 4
-    Popen-under-lock, PR 6 handle leak) reconstructed in
-    tests/lint_fixtures/hvd_regressions.py must each be caught."""
+    """The bugs this repo actually shipped (PR 1 race, PR 4
+    Popen-under-lock, PR 6 handle leak; PR 8's two jaxpr-level
+    defects) reconstructed in tests/lint_fixtures/hvd_regressions.py
+    must each be caught by the tier that owns them."""
 
     def test_all_three_are_flagged(self):
         result = run_analysis([FIXTURES], cwd=REPO_ROOT)
@@ -339,6 +352,34 @@ class TestHistoricalRegressions:
                 "Pr1BytesProcessedRace._dispatch_loop") in got
         assert ("HVD003", "Pr4PopenUnderLock.spawn") in got
         assert ("HVD005", "Pr6HandleLeak.step") in got
+
+    @staticmethod
+    def _fixture_module():
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "hvd_regressions_fixture",
+            os.path.join(FIXTURES, "hvd_regressions.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_round8_wire_gate_bug_is_flagged(self):
+        """PR 8 bug #1 (size-1-axis psum at world 1) as a traced
+        program: invisible to every AST rule, caught by HVD007."""
+        from horovod_tpu.analysis.jaxpr_verify import verify_traced
+        mod = self._fixture_module()
+        step, args, mesh_shape = mod.pr8_wire_gate_builder()
+        msgs = verify_traced(step, args, mesh_shape)
+        assert any("size-1" in m for m in msgs), msgs
+
+    def test_round8_double_reduce_bug_is_flagged(self):
+        """PR 8 bug #2 (legacy psum-transpose over-count) as a traced
+        program: HVD007's reduced-axes dataflow names the axis."""
+        from horovod_tpu.analysis.jaxpr_verify import verify_traced
+        mod = self._fixture_module()
+        step, args, mesh_shape = mod.pr8_legacy_double_reduce_builder()
+        msgs = verify_traced(step, args, mesh_shape)
+        assert any("double reduction" in m for m in msgs), msgs
 
 
 class TestChangedOnly:
@@ -421,3 +462,176 @@ class TestEnvValue:
         assert hconfig.env_value(
             "HOROVOD_ELASTIC_EPOCH", env={"HOROVOD_ELASTIC_EPOCH":
                                           "7"}) == 7
+
+
+class TestJaxprTier:
+    """HVD007 — the semantic tier's tier-1 gate: the full builder
+    matrix must verify clean inside a wall-clock budget, the
+    source-hash cache must make warm runs free, and the matrix must
+    actually cover the advertised cells."""
+
+    def test_repo_is_hvd007_clean_across_full_matrix(self):
+        from horovod_tpu.analysis import jaxpr_verify
+        t0 = time.perf_counter()
+        result = jaxpr_verify.run_jaxpr_analysis(cwd=REPO_ROOT,
+                                                 use_cache=False)
+        elapsed = time.perf_counter() - t0
+        assert result.findings == [], (
+            "HVD007 findings on the repo's builders:\n"
+            + render_text(result.findings))
+        # the acceptance floor: the full (world x overlap x numerics)
+        # grid plus the shape extras and the eager plan
+        assert result.file_count >= 12, result.meta
+        assert result.meta["configs_skipped"] == [], result.meta
+        # time budget: tracing is zero-FLOP, this must never become
+        # tier-1's slow step
+        assert elapsed < 120.0, f"jaxpr tier took {elapsed:.1f}s"
+
+    def test_matrix_covers_required_cells(self):
+        from horovod_tpu.analysis.jaxpr_verify import default_matrix
+        names = [c.name for c in default_matrix()]
+        for world in (1, 2, 8):
+            for ov in ("on", "off"):
+                for nm in ("on", "off"):
+                    assert (f"world={world},overlap={ov},"
+                            f"numerics={nm}") in names
+        assert sum("eager-plan" in n for n in names) >= 2
+        assert any("tensor1" in n for n in names)   # trivial axis
+        assert any("bfloat16" in n for n in names)  # separate vote
+
+    def test_cache_hit_and_source_key_invalidation(self, tmp_path,
+                                                   monkeypatch):
+        from horovod_tpu.analysis import jaxpr_verify
+        cache = tmp_path / "jaxpr-cache.json"
+        r1 = jaxpr_verify.run_jaxpr_analysis(cwd=REPO_ROOT,
+                                             cache_path=str(cache))
+        assert cache.exists()
+        before = jaxpr_verify.cache_stats()
+        r2 = jaxpr_verify.run_jaxpr_analysis(cwd=REPO_ROOT,
+                                             cache_path=str(cache))
+        after = jaxpr_verify.cache_stats()
+        assert after["hits"] == before["hits"] + 1, (before, after)
+        assert r2.file_count == r1.file_count
+        assert r2.meta["cache"] == "hit"
+        # key must move when a dependency source changes
+        dep = tmp_path / "fake_dep.py"
+        dep.write_text("a = 1\n")
+        real = jaxpr_verify._dependency_files()
+        monkeypatch.setattr(jaxpr_verify, "_dependency_files",
+                            lambda: real + [str(dep)])
+        k1 = jaxpr_verify.source_cache_key()
+        dep.write_text("a = 2\n")
+        k2 = jaxpr_verify.source_cache_key()
+        assert k1 != k2
+
+    def test_plan_digest_ties_builder_to_introspection(self):
+        """The digest the traced builder records at trace time is the
+        digest plan_overlap computes offline — one authority for the
+        SPMD cross-process contract."""
+        import jax
+        import numpy as np
+        import optax
+        from jax.sharding import Mesh
+
+        from horovod_tpu.parallel.train import (build_train_step,
+                                                last_overlap_info,
+                                                plan_overlap)
+
+        mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+        params = {"a": np.zeros((4, 4), np.float32),
+                  "b": np.zeros((3,), np.float32)}
+        opt = optax.sgd(0.1)
+        st = opt.init(params)
+
+        def loss(p, batch):
+            import jax.numpy as jnp
+            return jnp.mean((batch[:, None] * p["a"]).sum(-1)
+                            + p["b"].sum())
+
+        s = build_train_step(loss, opt, mesh, donate=False,
+                             overlap=True, overlap_threshold=32)
+        s.lower(params, st, np.zeros((8, 4), np.float32))
+        info = last_overlap_info()
+        plan = plan_overlap(params, mesh, overlap_threshold=32,
+                            guard=False)
+        assert info["digest"] == plan.digest
+        assert info["buckets"] == len(plan.bucket_leaf_indices)
+
+    def test_wire_groups_account_flag_ride(self):
+        """Numerics on: the plan's exact-count carrier group grows by
+        exactly one element; bf16-only buckets never ride."""
+        import numpy as np
+        from jax.sharding import Mesh
+        import jax
+
+        from horovod_tpu.parallel.train import plan_overlap
+
+        mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+        f32 = {"w": np.zeros((4,), np.float32)}
+        p = plan_overlap(f32, mesh, overlap_threshold=1 << 20,
+                         guard=True)
+        (wg,) = p.wire[0]
+        assert wg.rides_flag and wg.n == 5  # 4 payload + flag
+        bf16 = {"w": jax.ShapeDtypeStruct((4,), jax.numpy.bfloat16)}
+        p2 = plan_overlap(bf16, mesh, overlap_threshold=1 << 20,
+                          guard=True)
+        (wg2,) = p2.wire[0]
+        assert not wg2.rides_flag and wg2.n == 4
+
+
+class TestDocsDrift:
+    """HVD002 invariant 4: the user_guide knob tables vs the
+    registry."""
+
+    @staticmethod
+    def _project(tmp_path, doc_rows, registry_dir="pkg/common"):
+        reg_dir = tmp_path / registry_dir
+        reg_dir.mkdir(parents=True)
+        (reg_dir / "config.py").write_text(
+            "KNOBS = [\n"
+            "    Knob('HOROVOD_ALPHA', int, 64 * 1024, 'doc'),\n"
+            "    Knob('HOROVOD_BETA', _parse_bool, True, 'doc'),\n"
+            "    Knob('HOROVOD_GAMMA', str, '', 'doc'),\n"
+            "]\n"
+            # uses, so the unused-knob check stays quiet
+            "_ATTR_MAP = {}\n")
+        (tmp_path / "docs").mkdir(exist_ok=True)
+        (tmp_path / "docs" / "user_guide.md").write_text(
+            "| Knob | Default | What |\n|---|---|---|\n"
+            + "\n".join(doc_rows) + "\n")
+        root = str(tmp_path / registry_dir.split("/")[0])
+        return run_analysis([root], cwd=str(tmp_path))
+
+    def test_stale_row_and_default_drift_flagged(self, tmp_path):
+        result = self._project(tmp_path, [
+            "| `HOROVOD_ALPHA` | 9999 | wrong default |",
+            "| `HOROVOD_BETA` | 1 | agrees (bool spellings) |",
+            "| `HOROVOD_GAMMA` | (launcher-set) | empty default: "
+            "not checkable |",
+            "| `HOROVOD_GONE` | 3 | stale row |",
+        ])
+        doc = [f for f in result.findings
+               if f.path == "docs/user_guide.md"]
+        msgs = [f.message for f in doc]
+        assert any("HOROVOD_GONE" in m and "stale" in m
+                   for m in msgs), msgs
+        assert any("HOROVOD_ALPHA" in m and "drift" in m
+                   for m in msgs), msgs
+        assert not any("HOROVOD_BETA" in m for m in msgs), msgs
+        assert not any("HOROVOD_GAMMA" in m for m in msgs), msgs
+
+    def test_arith_default_spellings_accepted(self, tmp_path):
+        result = self._project(tmp_path, [
+            "| `HOROVOD_ALPHA` | 65536 | folded 64 * 1024 |",
+        ])
+        assert not [f for f in result.findings
+                    if f.path == "docs/user_guide.md"]
+
+    def test_non_common_registry_skips_docs(self, tmp_path):
+        """A registry outside a common/ dir (e.g. the lint fixtures)
+        must never scan a docs tree it does not own."""
+        result = self._project(tmp_path, [
+            "| `HOROVOD_GONE` | 3 | would be stale |",
+        ], registry_dir="pkg/lint_fixtures")
+        assert not [f for f in result.findings
+                    if f.path == "docs/user_guide.md"]
