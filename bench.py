@@ -180,6 +180,20 @@ def _trace_digest():
         return {}
 
 
+def _journal_digest():
+    """Compact lifecycle-journal digest for the JSON artifact: event
+    counts by type from this process's own journal ({'enabled':
+    False} in the common un-journaled bench run) — a chaos bench run
+    under HOROVOD_JOURNAL_DIR carries its recovery accounting in the
+    same artifact as its rate."""
+    try:
+        from horovod_tpu import journal
+        return journal.journal_digest()
+    except Exception as e:  # pragma: no cover - defensive
+        log(f"bench: journal digest unavailable ({e})")
+        return {}
+
+
 def _profile_block(profile_dir):
     """The `profile` digest every artifact carries (null when no
     capture ran): top-3 sinks + category split, parsed from the
@@ -634,6 +648,7 @@ def eager_main(model_name: str = "resnet50"):
         "profile": _profile_block(profile_dir),
         "metrics": _metrics_snapshot(),
         "trace": _trace_digest(),
+        "journal": _journal_digest(),
     }), flush=True)
 
 
@@ -778,6 +793,7 @@ def transformer_main():
         "profile": _profile_block(profile_dir),
         "metrics": _metrics_snapshot(),
         "trace": _trace_digest(),
+        "journal": _journal_digest(),
     }), flush=True)
 
 
@@ -1505,6 +1521,7 @@ def main(model_name: str = "resnet50"):
         "profile": _profile_block(profile_dir),
         "metrics": _metrics_snapshot(),
         "trace": _trace_digest(),
+        "journal": _journal_digest(),
     }
     if overlap_block is not None:
         doc["overlap"] = overlap_block

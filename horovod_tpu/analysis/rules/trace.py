@@ -107,6 +107,26 @@ def _span_mutation(call: ast.Call) -> str:
     return ""
 
 
+# Journal-write surface of journal.py: an event appended (and
+# fsync'd!) from inside a traced function lands ONCE per compilation,
+# so the incident analyzer would see a single phantom lifecycle event
+# per retrace instead of one per step — and the hot path would have
+# paid a trace-time disk sync to get it.
+_JOURNAL_ATTRS = frozenset({
+    "record", "event", "note_commit", "note_sync", "observe_phase",
+})
+
+
+def _journal_mutation(call: ast.Call) -> str:
+    f = call.func
+    if not isinstance(f, ast.Attribute) or f.attr not in _JOURNAL_ATTRS:
+        return ""
+    recv = attr_chain(f.value).lower()
+    if "journal" in recv or recv.split(".")[-1] in ("j", "_journal"):
+        return f"{attr_chain(f) or f.attr}()"
+    return ""
+
+
 def _side_effect(node: ast.AST) -> str:
     """Human-readable description when `node` is a trace-impure
     operation, else ''."""
@@ -127,6 +147,9 @@ def _side_effect(node: ast.AST) -> str:
     s = _span_mutation(node)
     if s:
         return f"trace-span mutation '{s}'"
+    jw = _journal_mutation(node)
+    if jw:
+        return f"journal write '{jw}'"
     if call_name(node) == "fire" and "fault" in chain.lower():
         return f"fault-injection seam '{chain}()'"
     # The registry-routed point read mandated by HVD002 is just as
@@ -142,8 +165,8 @@ def _side_effect(node: ast.AST) -> str:
 class TracePurityRule(Rule):
     id = "HVD004"
     summary = ("python side-effect (metrics/faults/environ/wall-"
-               "clock/trace-span/profiler-session) inside a "
-               "jit/shard_map/pmap-traced function")
+               "clock/trace-span/journal-write/profiler-session) "
+               "inside a jit/shard_map/pmap-traced function")
 
     def run(self, project: Project) -> List[Finding]:
         findings: List[Finding] = []

@@ -247,6 +247,12 @@ def init(config_overrides: Optional[Dict[str, Any]] = None,
         from .. import tracing as _tracing
         _tracing.on_init(cfg, _state)
 
+        # Lifecycle journal AFTER tracing: it persists the calibrated
+        # clock offset (when one exists) so driver+worker journals
+        # merge on one timeline. Best-effort like tracing.
+        from .. import journal as _journal
+        _journal.on_init(cfg, _state)
+
         hlog.info("horovod_tpu initialized: rank=%d size=%d local_rank=%d "
                   "local_size=%d cross_rank=%d cross_size=%d devices=%d",
                   _state.topology.rank, _state.topology.size,

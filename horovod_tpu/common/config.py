@@ -215,6 +215,28 @@ KNOBS: List[Knob] = [
          "Install the SIGUSR2 handler that dumps the flight "
          "recorder to postmortem-rank{r}.json (main-thread init "
          "only; the elastic 'dump' verb works regardless)."),
+    # -- job-lifecycle journal (recovery observability) -----------------------
+    Knob("HOROVOD_JOURNAL_DIR", str, "",
+         "Directory for the crash-safe job-lifecycle event journal "
+         "(journal.py): the elastic driver and every worker append "
+         "typed JSONL lifecycle events (membership epochs, heartbeat "
+         "verdicts, gang-restart phases, commits, fault firings, "
+         "postmortem references) that survive SIGKILL; "
+         "`python -m horovod_tpu.runner.doctor incident <dir>` merges "
+         "them into an MTTR-decomposed incident report. Empty "
+         "(default) disables journaling entirely (one load + compare "
+         "per seam)."),
+    Knob("HOROVOD_JOURNAL_FSYNC", int, 1,
+         "Journal flush cadence: fsync after every N appended "
+         "records. 1 (default) makes every event durable before the "
+         "writer proceeds; lifecycle-critical events (fault firings, "
+         "failure detection, commits, recovery phase edges) fsync "
+         "regardless of this batching."),
+    Knob("HOROVOD_JOURNAL_ROTATE_MB", int, 64,
+         "Journal rotation cap in MiB: past it the live file rotates "
+         "to a single .1 sibling (the offline analyzer reads both), "
+         "bounding an unattended soak at two segments per process. "
+         "0 disables rotation."),
     # -- autotune ------------------------------------------------------------
     Knob("HOROVOD_AUTOTUNE", _parse_bool, False,
          "Enable online autotuning of fusion threshold and cycle time."),
@@ -264,6 +286,17 @@ KNOBS: List[Knob] = [
     Knob("HOROVOD_ELASTIC_INIT_TIMEOUT", float, 120.0,
          "Per-attempt cap the growing elastic re-init timeout doubles "
          "up to."),
+    Knob("HOROVOD_ELASTIC_TEARDOWN_GRACE", float, 10.0,
+         "Seconds a gang-restart teardown waits after SIGTERM before "
+         "escalating to SIGKILL. The first incident report "
+         "(benchmarks/INCIDENT_chaos_r11.json) measured this fallback "
+         "as the dominant MTTR term: XLA's coordination service "
+         "installs a preemption notifier that CATCHES SIGTERM without "
+         "exiting, so jax.distributed workers never die on the "
+         "polite signal and every teardown pays the full grace. "
+         "Restore comes from the last durable commit either way — "
+         "lower this to trade teardown latency for the (journal-"
+         "fsync-protected) tail of worker-side shutdown work."),
     Knob("HOROVOD_ELASTIC_DRAIN_GRACE", float, 30.0,
          "Seconds a gracefully-removed worker may keep running past "
          "the resize before the driver terminates it."),
@@ -464,6 +497,9 @@ class Config:
         "trace_clock_sync_interval": "HOROVOD_TRACE_CLOCK_SYNC_INTERVAL",
         "trace_clock_probes": "HOROVOD_TRACE_CLOCK_PROBES",
         "trace_sigusr2": "HOROVOD_TRACE_SIGUSR2",
+        "journal_dir": "HOROVOD_JOURNAL_DIR",
+        "journal_fsync": "HOROVOD_JOURNAL_FSYNC",
+        "journal_rotate_mb": "HOROVOD_JOURNAL_ROTATE_MB",
         "autotune": "HOROVOD_AUTOTUNE",
         "autotune_log": "HOROVOD_AUTOTUNE_LOG",
         "autotune_mode": "HOROVOD_AUTOTUNE_MODE",
@@ -486,6 +522,7 @@ class Config:
         "elastic_timeout": "HOROVOD_ELASTIC_TIMEOUT",
         "elastic_init_base_timeout": "HOROVOD_ELASTIC_INIT_BASE_TIMEOUT",
         "elastic_init_timeout": "HOROVOD_ELASTIC_INIT_TIMEOUT",
+        "elastic_teardown_grace": "HOROVOD_ELASTIC_TEARDOWN_GRACE",
         "elastic_drain_grace": "HOROVOD_ELASTIC_DRAIN_GRACE",
         "heartbeat_timeout": "HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT",
         "heartbeat_interval": "HOROVOD_ELASTIC_HEARTBEAT_INTERVAL",

@@ -73,6 +73,9 @@ def _reinitialize() -> None:
     deadline = time.time() + config.env_value("HOROVOD_ELASTIC_TIMEOUT")
     attempt = 0
     _m_resets.inc()
+    from .. import journal as _journal
+    _journal.record("reinit_begin",
+                    epoch=config.env_value("HOROVOD_ELASTIC_EPOCH"))
     t_reset = time.monotonic()
     try:
         while True:
@@ -121,6 +124,16 @@ def _reinitialize() -> None:
             os.environ["HOROVOD_START_TIMEOUT"] = user_start_timeout
 
 
+def _journal_step(state) -> "int | None":
+    """Int view of the conventional `step` attr for journal records
+    (None for states without one, or with non-integer steps)."""
+    try:
+        v = getattr(state, "step", None)
+        return int(v) if v is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
 def run(func: Callable) -> Callable:
     """Decorator making a training function elastic. The wrapped
     function must take a State as its first argument."""
@@ -144,6 +157,8 @@ def run(func: Callable) -> Callable:
             hlog.info("elastic: resumed from snapshot")
         reset_limit = config.env_value("HOROVOD_ELASTIC_RESET_LIMIT")
         resets = 0
+        from .. import journal as _journal
+        recovering = None
         while True:
             # sync() runs at the top of EVERY attempt, including the
             # first (reference: horovod/torch/elastic/__init__.py run)
@@ -153,11 +168,24 @@ def run(func: Callable) -> Callable:
             # the script was launched with the plain non-elastic
             # launcher.
             state.sync()
+            # Committed-step watermark check: compare the step this
+            # attempt resumed at against the highest step ANY
+            # incarnation ever journaled a commit for — a respawned
+            # gang measures its loss instead of assuming the snapshot
+            # was current (hvd_committed_step_loss_total).
+            _journal.note_sync(getattr(state, "step", None))
+            if recovering is not None:
+                _journal.observe_phase(
+                    "restore", time.monotonic() - recovering)
+                recovering = None
             try:
                 return func(state, *args, **kwargs)
             except HorovodInternalError as e:
                 hlog.warning("elastic: collective failure — restoring "
                              "committed state and re-initializing")
+                _journal.record(
+                    "internal_error", error=str(e)[:200],
+                    step=_journal_step(state))
                 # Flight-recorder postmortem BEFORE the restore tears
                 # the evidence down: the in-flight tensor table and
                 # controller queue still show what this rank was
@@ -167,10 +195,16 @@ def run(func: Callable) -> Callable:
                     f"HorovodInternalError: {e}", trigger="crash")
                 state.before_reset()
                 state.restore()
+                recovering = time.monotonic()
+                _journal.count_recovery("internal_error")
                 _reinitialize()
                 state.on_reset()
             except HostsUpdatedInterrupt:
                 hlog.info("elastic: hosts updated — re-initializing")
+                _journal.record(
+                    "hosts_updated",
+                    epoch=config.env_value("HOROVOD_ELASTIC_EPOCH"),
+                    step=_journal_step(state))
                 notifications.consume()
                 state.before_reset()
                 _reinitialize()

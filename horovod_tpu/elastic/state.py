@@ -48,6 +48,15 @@ class HostsUpdatedInterrupt(Exception):
     (state.sync() then runs at the top of the next attempt)."""
 
 
+def _int_or_none(v: Any) -> Optional[int]:
+    """Journal-friendly view of a user step attr (which may be a jax
+    scalar, numpy int, or something unconvertible)."""
+    try:
+        return int(v) if v is not None else None
+    except (TypeError, ValueError):
+        return None
+
+
 def _to_host(tree: Any) -> Any:
     return jax.tree_util.tree_map(
         lambda x: np.asarray(x) if isinstance(x, (jax.Array, np.ndarray))
@@ -111,6 +120,15 @@ class State:
         from .. import numerics as _numerics
         _numerics.on_commit(self)
         self.save()
+        # Journal AFTER save: a journaled commit means the snapshot
+        # is durable, so the committed-step watermark the journal
+        # carries across restarts never runs ahead of what a
+        # restarted gang can actually restore (journal.note_commit
+        # also closes a pending recovery's first_commit phase).
+        from .. import journal as _journal
+        _journal.note_commit(getattr(self, "step", None),
+                             durable=getattr(
+                                 self, "_last_save_durable", False))
         self.check_host_updates()
 
     def check_host_updates(self) -> None:
@@ -175,6 +193,9 @@ class ObjectState(State):
         _m_restores.inc()
         for k, v in self._saved.items():
             setattr(self, k, copy.deepcopy(v))
+        from .. import journal as _journal
+        _journal.record("restore", step=_int_or_none(
+            getattr(self, "step", None)))
 
     def sync(self) -> None:
         _m_syncs.inc()
@@ -183,6 +204,11 @@ class ObjectState(State):
         for k, v in synced.items():
             setattr(self, k, v)
         self.save()
+        from .. import journal as _journal
+        from ..common.config import env_value as _env_value
+        _journal.record("sync_done",
+                        step=_int_or_none(getattr(self, "step", None)),
+                        epoch=_env_value("HOROVOD_ELASTIC_EPOCH"))
 
 
 class JaxState(ObjectState):
@@ -237,6 +263,11 @@ class JaxState(ObjectState):
         super().save()
         self._tree_saved = {k: _to_host(getattr(self, k))
                             for k in self._tree_attrs}
+        # Journal durability marker: only a save that actually issued
+        # a snapshot write advances the watermark a RESTARTED gang
+        # can restore to (non-writing ranks may run a step ahead of
+        # the snapshot owner; that is recompute, not committed loss).
+        self._last_save_durable = False
         if self._snapshot_path and self._snapshot_armed:
             self._write_snapshot()
 
@@ -246,6 +277,7 @@ class JaxState(ObjectState):
             return
         if self._snapshot_backend == "orbax":
             self._orbax_save()
+            self._last_save_durable = True
             return
         import os
         import pickle
@@ -254,6 +286,7 @@ class JaxState(ObjectState):
             pickle.dump({"known": dict(self._saved),
                          "trees": dict(self._tree_saved)}, f)
         os.replace(tmp, self._snapshot_path)
+        self._last_save_durable = True
 
     def before_reset(self) -> None:
         """Flush and drop the Orbax manager before the coordination
@@ -370,6 +403,9 @@ class JaxState(ObjectState):
             setattr(self, k, jax.tree_util.tree_map(jnp.asarray, v)
                     if v is not None else None)
         self.save()
+        from .. import journal as _journal
+        _journal.record("snapshot_loaded", step=_int_or_none(
+            getattr(self, "step", None)))
 
     def restore(self) -> None:
         super().restore()

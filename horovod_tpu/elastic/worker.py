@@ -332,4 +332,10 @@ def refresh_env_from_rendezvous() -> None:
             err_attempt += 1
     for k, v in assignment.items():
         os.environ[k] = str(v)
+    from .. import journal as _journal
+    _journal.record(
+        "assignment",
+        new_rank=int(assignment.get("HOROVOD_RANK", -1)),
+        size=int(assignment.get("HOROVOD_SIZE", -1)),
+        epoch=int(assignment.get("HOROVOD_ELASTIC_EPOCH", -1)))
     hlog.info("elastic: refreshed assignment: %s", assignment)

@@ -203,6 +203,13 @@ class _Plan:
             _m_fired.labels(point=point, action=rule.action).inc()
             hlog.warning("faults: firing %s at %s (hit %d, fired %d)",
                          rule.action, point, hits, fired)
+            # Journal BEFORE the action applies: for "crash" this
+            # fsync'd line is the process's last word, and it is what
+            # lets `doctor incident` attribute the recovery to the
+            # exact injected seam instead of just "exit 43".
+            from . import journal as _journal
+            _journal.record("fault_fired", point=point,
+                            action=rule.action, hit=hits)
             if rule.action == "delay":
                 time.sleep(rule.ms / 1000.0)
                 return "delay"

@@ -38,6 +38,11 @@ LATENCY_BUCKETS = (1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0, 10.0, 100.0)
 BYTES_BUCKETS = (1024.0, 8192.0, 65536.0, 524288.0, 4194304.0,
                  33554432.0, 268435456.0, 2147483648.0)
 COUNT_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
+# Recovery phases span a sub-second in-process restore to a
+# multi-minute blacklist-then-respawn on a starved pool (journal.py's
+# hvd_recovery_seconds{phase} SLO histograms).
+RECOVERY_BUCKETS = (0.1, 0.5, 1.0, 2.0, 5.0, 15.0, 60.0, 300.0,
+                    1800.0)
 
 
 def _fmt(v: float) -> str:

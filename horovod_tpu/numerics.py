@@ -289,6 +289,9 @@ def _escalate(consec: int, max_consecutive: Optional[int]) -> None:
     m = (max_consecutive if max_consecutive is not None
          else max_consecutive_skips())
     if m and consec >= m:
+        from . import journal as _journal
+        _journal.record("numerics_escalation", skips=int(consec),
+                        limit=int(m))
         raise HorovodInternalError(
             f"numerics: {consec} consecutive non-finite skip-steps "
             f"reached HOROVOD_NUMERICS_MAX_CONSECUTIVE_SKIPS={m}; "
@@ -500,11 +503,18 @@ def check_replica_divergence(params: Any,
         # laundered, log claiming recovery. Deliberately NOT a
         # HorovodInternalError so the elastic retry loop does not
         # swallow it: fail hard and name the problem.
+        from . import journal as _journal
+        _journal.record("replica_divergence",
+                        divergent_ranks=sorted(divergent),
+                        non_restorable=True)
         raise RuntimeError(
             msg + " — rank 0 (the elastic sync broadcast root) holds "
             "a minority digest, so restore + rank-0 sync would "
             "launder the corruption onto healthy ranks; restart from "
             "a trusted checkpoint instead")
+    from . import journal as _journal
+    _journal.record("replica_divergence",
+                    divergent_ranks=sorted(divergent))
     raise ReplicaDivergenceError(
         msg + "; elastic restore + rank-0 sync recovers",
         divergent_ranks=divergent)

@@ -1,9 +1,13 @@
 """Diagnostics CLI: `hvdrun --check-build` (the capability matrix;
 reference: horovod/runner/launch.py --check-build, which prints the
-[X] NCCL / [ ] MPI style table from horovod/metadata) and
+[X] NCCL / [ ] MPI style table from horovod/metadata),
 `python -m horovod_tpu.runner.doctor trace <dir>` — merge per-rank
 timelines on calibrated clocks and print the straggler report
-(tracing.py)."""
+(tracing.py) — and `python -m horovod_tpu.runner.doctor incident
+<dir>` — merge driver+worker lifecycle journals (journal.py) into a
+byte-deterministic incident_report.json with per-recovery MTTR
+decomposition, cause attribution, and committed-step-loss
+accounting."""
 
 from __future__ import annotations
 
@@ -85,14 +89,30 @@ def trace_report(target: str, out: Optional[str] = None,
     return tracing.render_report(report)
 
 
+def incident(target: str, out: Optional[str] = None) -> str:
+    """Merge the lifecycle journals under `target`
+    (HOROVOD_JOURNAL_DIR of a run) into `incident_report.json` —
+    byte-deterministic for identical journals, so committed artifacts
+    can be regenerated and diffed — and return the rendered
+    per-recovery MTTR decomposition. Also invoked by
+    `hvdrun --incident-report`."""
+    from .. import journal
+    path, report = journal.write_incident_report(target, out=out)
+    return (journal.render_incident_report(report)
+            + f"\n\nreport: {path}")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
-    """`python -m horovod_tpu.runner.doctor [trace <dir>|check-build]`."""
+    """`python -m horovod_tpu.runner.doctor
+    [trace <dir>|incident <dir>|check-build]`."""
     import argparse
 
     p = argparse.ArgumentParser(
         prog="python -m horovod_tpu.runner.doctor",
-        description="horovod_tpu diagnostics: capability matrix and "
-                    "distributed-trace merge/attribution.")
+        description="horovod_tpu diagnostics: capability matrix, "
+                    "distributed-trace merge/attribution, and "
+                    "incident-report generation from lifecycle "
+                    "journals.")
     sub = p.add_subparsers(dest="cmd")
     pc = sub.add_parser("check-build",
                         help="print the capability matrix (default)")
@@ -109,6 +129,18 @@ def main(argv: Optional[List[str]] = None) -> int:
                          "timeline.merged.json next to the inputs)")
     pt.add_argument("--top-k", type=int, default=3,
                     help="offender ranks listed in the report")
+    pi = sub.add_parser(
+        "incident",
+        help="merge the HOROVOD_JOURNAL_DIR lifecycle journals into "
+             "incident_report.json (per-recovery MTTR decomposition, "
+             "cause attribution, committed-step-loss accounting) and "
+             "print the human-readable timeline")
+    pi.add_argument("target",
+                    help="the run's HOROVOD_JOURNAL_DIR (holds "
+                         "journal-driver.jsonl + journal-rankN.jsonl)")
+    pi.add_argument("--out", default=None,
+                    help="report output path (default: "
+                         "incident_report.json inside the dir)")
     args = p.parse_args(argv)
     if args.cmd == "trace":
         try:
@@ -116,6 +148,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                                top_k=args.top_k))
         except (OSError, ValueError) as e:
             print(f"doctor trace: {e}")
+            return 1
+        return 0
+    if args.cmd == "incident":
+        try:
+            print(incident(args.target, out=args.out))
+        except (OSError, ValueError) as e:
+            print(f"doctor incident: {e}")
             return 1
         return 0
     print(check_build(verbose=getattr(args, "verbose", False)))

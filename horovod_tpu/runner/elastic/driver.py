@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ... import journal as _journal
 from ...common import config as _config
 from ...common import logging as hlog
 from ...metrics import REGISTRY as _METRICS
@@ -119,6 +120,28 @@ class ElasticDriver:
         self._draining: Dict[Tuple[str, int], Tuple[_Slot, float]] = {}
         self.drain_grace = _config.env_value(
             "HOROVOD_ELASTIC_DRAIN_GRACE", env=_env)
+        # SIGTERM->SIGKILL escalation window for gang teardowns. The
+        # incident journal measured this as the binding MTTR term:
+        # XLA's preemption notifier catches SIGTERM without exiting,
+        # so workers sit out the whole grace (see the knob doc).
+        self.teardown_grace = _config.env_value(
+            "HOROVOD_ELASTIC_TEARDOWN_GRACE", env=_env)
+        # Lifecycle journal (HOROVOD_JOURNAL_DIR; workers inherit the
+        # knob through the forwarded env and write rank-keyed
+        # siblings): the driver records membership epochs, failure
+        # detection, and every gang-restart phase so `doctor
+        # incident` can decompose each recovery's MTTR.
+        self.journal = _journal.configure("driver", env=_env)
+        _journal.record("driver_start", command=command,
+                        min_np=min_np, max_np=max_np)
+        # Slots killed by the liveness detector: their imminent
+        # nonzero exit must be attributed as "hung", not "crash".
+        self._hung_pending: Dict[Tuple[str, int], float] = {}
+        self._exit_logged: set = set()
+        # Open recovery's phase timestamps for the runtime
+        # hvd_recovery_seconds{phase} observations (the offline
+        # report recomputes them exactly from the journal).
+        self._recovery_marks: Dict[str, float] = {}
 
     # ------------------------------------------------------------------
 
@@ -215,6 +238,8 @@ class ElasticDriver:
                                     self._io_lock), daemon=True)
         t1.start(); t2.start()
         slot.pumps = [t1, t2]
+        _journal.record("spawn", exit_rank=info.rank, host=info.host,
+                        child_pid=p.pid)
         return slot
 
     def _collect_postmortems(self, bad: Dict) -> None:
@@ -260,6 +285,16 @@ class ElasticDriver:
                 runtime.get("controller_queue_depth", 0),
                 len(doc.get("ring", [])),
                 len(doc.get("thread_stacks", {})), path)
+            # First-class journal event (not just a log line): the
+            # incident report links each recovery to the dumps its
+            # dead workers left behind.
+            _journal.record(
+                "postmortem", exit_rank=slot.info.rank, code=code,
+                file=os.path.basename(path),
+                reason=str(doc.get("reason"))[:200],
+                step=doc.get("step"),
+                trigger=doc.get("trigger"),
+                in_flight=len(runtime.get("in_flight_handles", [])))
 
     def _notify_workers(self) -> None:
         """Poke every registered notification listener (reference:
@@ -284,6 +319,13 @@ class ElasticDriver:
         self._clean_since = None
         infos, table = self._assignments(hosts)
         self.rendezvous.publish(self.epoch, table)
+        _journal.record("epoch_published", epoch=self.epoch,
+                        size=len(infos),
+                        hosts={str(i.rank): i.host for i in infos})
+        t = self._recovery_marks.pop("teardown_done", None)
+        if t is not None:
+            _journal.observe_phase("rendezvous", time.monotonic() - t)
+            self._recovery_marks["published"] = time.monotonic()
         return infos, table
 
     def _reconcile(self, infos: List[RankInfo], table: Dict) -> None:
@@ -337,6 +379,11 @@ class ElasticDriver:
                 # verdict against the new one before its first beat.
                 self.rendezvous.clear_heartbeat(key)
                 self.slots[key] = self._spawn(info, dict(table[key]))
+        _journal.record("respawn_done", epoch=self.epoch,
+                        ranks=len(wanted))
+        t = self._recovery_marks.pop("published", None)
+        if t is not None:
+            _journal.observe_phase("respawn", time.monotonic() - t)
 
     def _reap_draining(self) -> None:
         """Collect voluntarily-exited drained workers; hard-kill any
@@ -383,8 +430,10 @@ class ElasticDriver:
         infos, table = self._publish_epoch(hosts)
         self._reconcile(infos, table)
 
+        rc = None
         try:
-            return self._monitor(current)
+            rc = self._monitor(current)
+            return rc
         finally:
             for slot in self.slots.values():
                 if slot.proc.poll() is None:
@@ -393,6 +442,10 @@ class ElasticDriver:
                 if slot.proc.poll() is None:
                     slot.proc.kill()
             self.rendezvous.stop()
+            # rc None = the monitor raised (reset limit starvation,
+            # discovery death): still journaled so the incident
+            # report can tell "job ended" from "journal truncated".
+            _journal.record("job_done", code=rc)
 
     def _check_hung_workers(self) -> None:
         """Liveness detector: kill any still-running worker whose
@@ -432,6 +485,10 @@ class ElasticDriver:
                         "reaps it; relying on the host blacklist to "
                         "steer the restart elsewhere", key[0])
                 _m_hung.inc()
+                _journal.record("hung_worker", exit_rank=slot.info.rank,
+                                host=key[0], age_s=round(age, 3),
+                                timeout_s=self.heartbeat_timeout)
+                self._hung_pending[key] = age
                 self.rendezvous.clear_heartbeat(key)
                 slot.proc.kill()
 
@@ -449,6 +506,13 @@ class ElasticDriver:
                       if s.proc.poll() is not None}
             if exited:
                 codes = {k: s.proc.returncode for k, s in exited.items()}
+                for k, s in exited.items():
+                    tag = (k, s.proc.pid)
+                    if tag not in self._exit_logged:
+                        self._exit_logged.add(tag)
+                        _journal.record(
+                            "worker_exit", exit_rank=s.info.rank,
+                            host=k[0], code=s.proc.returncode)
                 if all(c == 0 for c in codes.values()) and \
                         len(exited) == len(self.slots):
                     return 0  # clean completion
@@ -494,6 +558,29 @@ class ElasticDriver:
                     hlog.warning(
                         "elastic: worker failure(s) %s (reset %d)",
                         bad, self.resets)
+                    # Failure DETECTED: one journal detect event per
+                    # bad rank (the analyzer folds detects before the
+                    # respawn into one recovery), attributed as
+                    # "hung" when the liveness detector shot it and
+                    # "crash" otherwise. For hung workers the stale
+                    # age IS the runtime detect latency.
+                    for k in sorted(bad):
+                        slot = exited.get(k) or self.slots.get(k)
+                        age = self._hung_pending.pop(k, None)
+                        cause = "crash" if age is None else "hung"
+                        _journal.record(
+                            "detect", cause=cause,
+                            exit_rank=(slot.info.rank if slot
+                                       else None),
+                            host=k[0], code=bad[k],
+                            age_s=(round(age, 3)
+                                   if age is not None else None),
+                            reset=self.resets)
+                        _journal.count_recovery(cause)
+                        if age is not None:
+                            _journal.observe_phase("detect", age)
+                    self._recovery_marks = {
+                        "detected": time.monotonic()}
                     if self.reset_limit and \
                             self.resets > self.reset_limit:
                         print("[elastic] reset limit reached",
@@ -530,6 +617,10 @@ class ElasticDriver:
                             if proposed.get(h.host, 0) < time.time()]
                         if self._world_np(remaining) >= self.min_np:
                             self.blacklist = proposed
+                            _journal.record(
+                                "blacklist", host=host,
+                                window_s=round(window, 1),
+                                failures=self._host_failures[host])
                             hlog.warning(
                                 "elastic: blacklisting %s for %.0fs "
                                 "(failure %d of this host)", host,
@@ -570,6 +661,9 @@ class ElasticDriver:
         """Hard-failure recovery: kill the remaining gang and relaunch
         on the latest discovered hosts (see module docstring for why
         survivors cannot be kept on TPU)."""
+        _journal.record("gang_restart_begin", reset=self.resets,
+                        epoch=self.epoch)
+        t_detect = self._recovery_marks.get("detected")
         # Draining workers belong to the old world being torn down.
         for key in list(self._draining):
             slot, _ = self._draining.pop(key)
@@ -579,13 +673,18 @@ class ElasticDriver:
         for key, slot in list(self.slots.items()):
             if slot.proc.poll() is None:
                 slot.proc.terminate()
-        deadline = time.time() + 10
+        deadline = time.time() + self.teardown_grace
         for slot in self.slots.values():
             while slot.proc.poll() is None and time.time() < deadline:
                 time.sleep(0.05)
             if slot.proc.poll() is None:
                 slot.proc.kill()
         self.slots.clear()
+        _journal.record("teardown_done", reset=self.resets)
+        if t_detect is not None:
+            _journal.observe_phase("teardown",
+                                   time.monotonic() - t_detect)
+        self._recovery_marks["teardown_done"] = time.monotonic()
         waited = time.time() + self.elastic_timeout
         hosts = []
         while True:

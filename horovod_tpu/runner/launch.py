@@ -417,6 +417,13 @@ def make_parser() -> argparse.ArgumentParser:
                         "under DIR (or one rank's timeline file) into "
                         "a single clock-aligned Chrome trace, print "
                         "the straggler-attribution report, and exit")
+    p.add_argument("--incident-report", default=None, metavar="DIR",
+                   help="merge the lifecycle journals under DIR (a "
+                        "run's HOROVOD_JOURNAL_DIR) into a byte-"
+                        "deterministic incident_report.json — per-"
+                        "recovery MTTR decomposition, cause "
+                        "attribution, committed-step loss — print "
+                        "the timeline, and exit")
     # elastic (reference: horovodrun --host-discovery-script /
     # --min-num-proc / --max-num-proc)
     p.add_argument("--host-discovery-script", default=None,
@@ -460,7 +467,10 @@ def make_parser() -> argparse.ArgumentParser:
                            "aggregators instead of the rank-0 "
                            "coordinator (HOROVOD_CONTROL_TREE_ARITY; "
                            "0 = flat star, 32 = measured sweet spot "
-                           "at O(1k) ranks)")
+                           "at O(1k) ranks — but measured SLOWER on "
+                           "1-core gangs where aggregators serialize "
+                           "with the root, 114 vs 98 ms/round: see "
+                           "benchmarks/control_plane_scale.md)")
     tune.add_argument("--hierarchical-allreduce", action="store_true",
                       default=None,
                       help="ICI reduce-scatter + DCN allreduce + ICI "
@@ -468,6 +478,12 @@ def make_parser() -> argparse.ArgumentParser:
     tune.add_argument("--timeline-filename", default=None,
                       help="Chrome-trace JSON output path, rank 0 "
                            "(HOROVOD_TIMELINE)")
+    tune.add_argument("--journal-dir", default=None,
+                      help="crash-safe job-lifecycle journal "
+                           "directory (HOROVOD_JOURNAL_DIR): driver "
+                           "and every worker append typed JSONL "
+                           "lifecycle events; analyze afterwards "
+                           "with --incident-report DIR")
     tune.add_argument("--timeline-mark-cycles", action="store_true",
                       default=None,
                       help="mark engine cycles in the timeline "
@@ -527,6 +543,7 @@ _FLAG_ENV_MAP = [
     ("hierarchical_allreduce", "HOROVOD_HIERARCHICAL_ALLREDUCE",
      lambda v: "1"),
     ("timeline_filename", "HOROVOD_TIMELINE", str),
+    ("journal_dir", "HOROVOD_JOURNAL_DIR", str),
     ("timeline_mark_cycles", "HOROVOD_TIMELINE_MARK_CYCLES",
      lambda v: "1"),
     ("autotune", "HOROVOD_AUTOTUNE", lambda v: "1"),
@@ -580,6 +597,14 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(trace_report(args.timeline_merge))
         except (OSError, ValueError) as e:
             print(f"hvdrun --timeline-merge: {e}", file=sys.stderr)
+            return 1
+        return 0
+    if args.incident_report:
+        from .doctor import incident
+        try:
+            print(incident(args.incident_report))
+        except (OSError, ValueError) as e:
+            print(f"hvdrun --incident-report: {e}", file=sys.stderr)
             return 1
         return 0
     command = args.command

@@ -153,6 +153,12 @@ class BasicService:
             except WireError as e:
                 hlog.warning("%s service: rejected request from %s: %s",
                              self.name, peer[0], e)
+                # Lifecycle journal: rejected control-plane frames are
+                # the wire-seam evidence `doctor incident` correlates
+                # with wire.send/recv fault schedules.
+                from .. import journal as _journal
+                _journal.record("wire_reject", service=self.name,
+                                peer=peer[0], error=str(e)[:120])
                 # "denied" is reserved for auth mismatch (a bad secret
                 # does not heal — the client must fail fast, never
                 # retry). A garbled/truncated frame is transient wire

@@ -180,6 +180,9 @@ class TaskService:
 
     def _wait_one(self, p: subprocess.Popen, rank: int) -> None:
         rc = p.wait()
+        from .. import journal as _journal
+        _journal.record("task_exit", exit_rank=rank, code=rc,
+                        host=self.host_id)
         if self._driver is not None:
             self._driver.try_request({
                 "type": "task_exit",
@@ -219,6 +222,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     for part in argv[1].split(","):
         h, p = part.rsplit(":", 1)
         driver_addrs.append((h, int(p)))
+    # Per-host lifecycle journal (no hvd.init on this path, so arm it
+    # here): task-service spawn/exit events name the host, which is
+    # what the incident merge attributes multi-host failures with.
+    from .. import journal as _journal
+    _journal.configure(f"task-{host_id}")
     svc = TaskService(host_id, driver_addrs, _secret.from_env())
     svc.register()
     rc = svc.serve_forever()

@@ -459,6 +459,16 @@ _time_service: Optional[TimeService] = None
 _calibrator: Optional[ClockCalibrator] = None
 
 
+def current_calibration() -> Optional[Tuple[int, int]]:
+    """This rank's live (offset_ns, rtt_ns) estimate against rank 0,
+    or None when no calibrator is running (rank 0 itself, single
+    process, or no timeline at init). journal.py persists it so the
+    incident merge aligns journals across hosts on the same clock the
+    trace merge uses."""
+    cal = _calibrator
+    return cal._calibration if cal is not None else None
+
+
 def _start_clock_sync(cfg, topo, timeline) -> None:
     """Wire the calibration plane up at init: rank 0 binds the time
     verb, its address rides an object broadcast (the negotiation plane
@@ -644,6 +654,15 @@ def write_postmortem(reason: str, trigger: str = "manual",
             json.dump(doc, f, indent=1, sort_keys=True, default=str)
         os.replace(tmp, path)
         _m_postmortems.labels(trigger=trigger).inc()
+        # Postmortems are first-class journal events: `doctor
+        # incident` links each recovery to the dumps its dead workers
+        # left behind (basename only — the report must stay
+        # byte-deterministic across checkouts).
+        from . import journal as _journal
+        _journal.record("postmortem_written",
+                        file=os.path.basename(path),
+                        reason=str(reason)[:200], trigger=trigger,
+                        step=current_step())
         hlog.warning("tracing: postmortem written to %s (%s)",
                      path, reason)
         return path

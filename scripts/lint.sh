@@ -10,6 +10,15 @@
 # finding — this is the CI entry point; tests/test_lint.py runs the
 # hvdlint halves in-process as part of tier-1.
 #
+# Legs that cannot run on a given host (no ruff, no clang, no
+# sanitizer runtime) SKIP GRACEFULLY but never silently: each prints
+# a "SKIPPED-LEG:" line and the final verdict enumerates every
+# skipped leg, so a green run on a thin container is visibly NOT the
+# full gate. The full gate is ruff + hvdlint(AST) + hvdlint(jaxpr) +
+# cc -Werror + clang -Wthread-safety + fuzz_wire(ASan/UBSan); CI
+# hosts are expected to run all six (docs/user_guide.md "Static
+# analysis" records the expected-legs contract).
+#
 # Pre-commit fast path: `scripts/lint.sh --changed-only [REF]` makes
 # hvdlint analyze only the files touched since REF (default HEAD)
 # plus their call-graph neighbors, and runs the jaxpr tier only when
@@ -32,6 +41,14 @@ if [ "${1:-}" = "--changed-only" ]; then
 fi
 
 rc=0
+SKIPPED_LEGS=""
+
+skip_leg() {
+    # $1 = leg name, $2 = reason. Loud by design: the gate must not
+    # quietly thin on hosts missing a toolchain.
+    echo "SKIPPED-LEG: $1 ($2)"
+    SKIPPED_LEGS="${SKIPPED_LEGS:+$SKIPPED_LEGS, }$1"
+}
 
 echo "== ruff =="
 if command -v ruff >/dev/null 2>&1; then
@@ -39,7 +56,7 @@ if command -v ruff >/dev/null 2>&1; then
 elif python -c "import ruff" >/dev/null 2>&1; then
     python -m ruff check horovod_tpu tests bench.py setup.py || rc=1
 else
-    echo "ruff not installed; skipping (config lives in pyproject.toml)"
+    skip_leg "ruff" "not installed; config lives in pyproject.toml"
 fi
 
 echo "== hvdlint (AST tiers) =="
@@ -77,12 +94,19 @@ echo "== cc check (-Wall -Wextra -Werror) =="
 if command -v "${CXX:-g++}" >/dev/null 2>&1; then
     make -C horovod_tpu/core/cc check || rc=1
 else
-    echo "no C++ toolchain; skipping"
+    skip_leg "cc" "no C++ toolchain"
+fi
+
+# The clang -Wthread-safety leg rides inside `make check` when clang
+# is present; account for it explicitly so its absence is visible
+# here, not buried in make output.
+if ! command -v clang++ >/dev/null 2>&1; then
+    skip_leg "clang-thread-safety" "clang++ not installed; GUARDED_BY/REQUIRES annotations unchecked"
 fi
 
 # Wire-parser fuzz under ASan+UBSan (incl. SerializeAgg/ParseAgg):
-# sanitizer findings are check failures. Graceful skip when the
-# toolchain cannot link the sanitizers (same protocol as ruff).
+# sanitizer findings are check failures. Graceful-but-loud skip when
+# the toolchain cannot link the sanitizers (same protocol as ruff).
 echo "== fuzz_wire (ASan/UBSan) =="
 if command -v "${CXX:-g++}" >/dev/null 2>&1; then
     sanprobe=$(mktemp -d)
@@ -99,16 +123,18 @@ if command -v "${CXX:-g++}" >/dev/null 2>&1; then
             rc=1
         fi
     else
-        echo "toolchain cannot link ASan/UBSan; skipping fuzz run"
+        skip_leg "fuzz_wire-asan-ubsan" "toolchain cannot link ASan/UBSan"
     fi
     rm -rf "$sanprobe"
 else
-    echo "no C++ toolchain; skipping"
+    skip_leg "fuzz_wire-asan-ubsan" "no C++ toolchain"
 fi
 
 if [ "$rc" -ne 0 ]; then
     echo "lint: FAILED"
+elif [ -n "$SKIPPED_LEGS" ]; then
+    echo "lint: OK (SKIPPED LEGS: $SKIPPED_LEGS — this host did not run the full gate; see docs/user_guide.md 'Static analysis' for the expected-legs contract)"
 else
-    echo "lint: OK"
+    echo "lint: OK (all legs ran)"
 fi
 exit "$rc"

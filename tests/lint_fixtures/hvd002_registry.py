@@ -53,3 +53,23 @@ def lookup_of_never_registered_name():
 
 def lookup_of_registered_name_is_fine():
     return REGISTRY.get("hvdfix_single_registration_total")
+
+
+# -- recovery SLO metrics (round 11: journal.py's hvd_recovery_*) ----------
+
+_m_recovery_ok = REGISTRY.histogram(
+    "hvdfix_recovery_seconds",
+    "Registered exactly once: ok.", ("phase",))
+
+_m_recovery_dup = REGISTRY.histogram(  # EXPECT: HVD002
+    "hvdfix_recovery_seconds",
+    "Second registration site: the drift hazard HVD002 guards the "
+    "real hvd_recovery_seconds against.", ("phase",))
+
+
+def lookup_of_never_registered_recovery_metric():
+    return REGISTRY.get("hvdfix_recovery_oops_total")  # EXPECT: HVD002
+
+
+def lookup_of_registered_recovery_metric_is_fine():
+    return REGISTRY.get("hvdfix_recovery_seconds")

@@ -171,3 +171,40 @@ def lookalike_capture(x):
             return None
     _Sink().capture(x)
     return x
+
+
+# -- journal writes (round 11) ---------------------------------------------
+
+@jax.jit
+def decorated_journal_write(x):
+    from horovod_tpu import journal
+    journal.record("commit", step=1)  # EXPECT: HVD004
+    return x + 1
+
+
+@jax.jit
+def decorated_journal_event(x):
+    j = _FAKE_JOURNAL
+    j.event("commit", step=2)  # EXPECT: HVD004
+    return x * 2
+
+
+_FAKE_JOURNAL = None
+
+
+def journal_outside_tracing(x):
+    # journaling from plain (untraced) python is the intended use
+    from horovod_tpu import journal
+    journal.record("commit", step=3)
+    return x
+
+
+@jax.jit
+def lookalike_journal_event(x):
+    # .event() on a non-journal receiver (a threading.Event-style
+    # signal holder) is NOT a journal write
+    class _Signals:
+        def event(self, *a, **kw):
+            return None
+    _Signals().event("ready")
+    return x
