@@ -72,6 +72,10 @@ class StepConfig:
     numerics: bool = False
     dtype: str = "float32"
     threshold: int = _THRESHOLD
+    # Per-bucket wire compression ("none"/"fp16"/"bf16"/
+    # "powersgd:r"). Compressed cells trace with min_elements=1 so
+    # the 16-element chain weights qualify for the low-rank path.
+    compression: str = "none"
 
     @property
     def world(self) -> int:
@@ -110,6 +114,23 @@ def default_matrix() -> List[StepConfig]:
         name="world=2,overlap=on,numerics=on,dtype=bfloat16",
         mesh_axes=(("data", 2),),
         overlap=True, numerics=True, dtype="bfloat16"))
+    # Compressed-wire cells (check (e)): the finite-flag vote must be
+    # a separate exact f32 psum — never ride a lossy carrier — and
+    # the factor/cast wire groups must still match the plan in
+    # reverse-topological order.
+    out.append(StepConfig(
+        name="world=2,overlap=on,numerics=on,compression=powersgd:2",
+        mesh_axes=(("data", 2),),
+        overlap=True, numerics=True, compression="powersgd:2"))
+    out.append(StepConfig(
+        name="world=2,overlap=on,numerics=on,compression=bf16",
+        mesh_axes=(("data", 2),),
+        overlap=True, numerics=True, compression="bf16"))
+    out.append(StepConfig(
+        name="world=8,mesh=data4xseq2,overlap=on,numerics=on,"
+             "compression=powersgd:2",
+        mesh_axes=(("data", 4), ("seq", 2)),
+        overlap=True, numerics=True, compression="powersgd:2"))
     out.append(StepConfig(name="eager-plan,threshold=80",
                           kind="eager-plan", threshold=80))
     out.append(StepConfig(name="eager-plan,threshold=0",
@@ -186,18 +207,32 @@ def _trace_once(cfg: StepConfig, mesh):
     batch = jax.ShapeDtypeStruct((8, 4), params["layer0"]["w"].dtype)
     opt = optax.sgd(0.1)
     opt_state = jax.eval_shape(opt.init, params)
+    cme = 1 if cfg.compression != "none" else None
     saved = _numerics.guard_enabled
     _numerics.guard_enabled = lambda: cfg.numerics
     try:
         step = build_train_step(
             _chain_loss, opt, mesh, donate=False,
-            overlap=cfg.overlap, overlap_threshold=cfg.threshold)
-        jaxpr = jax.make_jaxpr(step)(params, opt_state, batch)
+            overlap=cfg.overlap, overlap_threshold=cfg.threshold,
+            compression=cfg.compression,
+            compression_min_elements=cme)
+        if cfg.compression.startswith("powersgd"):
+            from ..parallel.train import init_compression_state
+            cstate, _specs = init_compression_state(
+                params, mesh, overlap_threshold=cfg.threshold,
+                guard=cfg.numerics, compression=cfg.compression,
+                compression_min_elements=cme)
+            jaxpr = jax.make_jaxpr(step)(params, opt_state, batch,
+                                         cstate)
+        else:
+            jaxpr = jax.make_jaxpr(step)(params, opt_state, batch)
     finally:
         _numerics.guard_enabled = saved
     plan = plan_overlap(params, mesh,
                         overlap_threshold=cfg.threshold,
-                        guard=cfg.numerics)
+                        guard=cfg.numerics,
+                        compression=cfg.compression,
+                        compression_min_elements=cme)
     return R.collect_collectives(jaxpr), plan
 
 
@@ -217,9 +252,13 @@ def verify_step_config(cfg: StepConfig) -> List[str]:
     msgs += R.check_axes(ops_a, mesh_shape,
                          allow_scalar_size1=GRADS_PRE_SUMMED)
     msgs += R.check_dead(ops_a)
-    msgs += R.check_double_reduce(ops_a)
+    msgs += R.check_double_reduce(
+        ops_a, exempt=R.compressed_wire_positions(
+            ops_a, plan if cfg.overlap else None))
     if cfg.overlap:
         msgs += R.check_plan(ops_a, plan, mesh_shape)
+        msgs += R.check_compression(ops_a, plan, mesh_shape,
+                                    cfg.numerics)
     elif not GRADS_PRE_SUMMED:
         # Monolithic legacy leg: _sum_missing_axes owes one explicit
         # per-leaf psum chain per inexact leaf with live reduce axes.
@@ -300,9 +339,12 @@ def verify_traced(fn, example_args: Sequence[Any],
     msgs += R.check_axes(ops, mesh_shape,
                          allow_scalar_size1=GRADS_PRE_SUMMED)
     msgs += R.check_dead(ops)
-    msgs += R.check_double_reduce(ops)
+    msgs += R.check_double_reduce(
+        ops, exempt=R.compressed_wire_positions(ops, plan))
     if plan is not None:
         msgs += R.check_plan(ops, plan, mesh_shape)
+        msgs += R.check_compression(ops, plan, mesh_shape,
+                                    numerics_guard)
     msgs += R.check_numerics(ops, plan, mesh_shape, numerics_guard)
     return msgs
 
@@ -323,6 +365,7 @@ def _dependency_files() -> List[str]:
     rels = [
         ("parallel", "train.py"), ("parallel", "mesh.py"),
         ("parallel", "sharding.py"), ("ops", "bucketing.py"),
+        ("ops", "compression.py"),
         ("numerics.py",), ("common", "compat.py"),
         ("common", "config.py"), ("optim", "distributed_optimizer.py"),
         ("analysis", "jaxpr_verify.py"),

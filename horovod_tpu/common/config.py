@@ -53,6 +53,38 @@ KNOBS: List[Knob] = [
          "bucketed: their psum is the identity, so the pack/unpack "
          "round trip is pure overhead (elided since r08; "
          "single-chip programs lower with no bucket machinery)."),
+    Knob("HOROVOD_COMPRESSION", str, "none",
+         "Per-bucket gradient wire compression applied inside the "
+         "shared bucketing layer, both planes (jit bucketed psums and "
+         "the eager grouped allreduce): none (default; byte-identical "
+         "programs to the uncompressed builder, test-pinned), fp16 / "
+         "bf16 (cast wire, the reference's ceiling), or "
+         "powersgd[:rank] (low-rank factor wire with error feedback "
+         "— Vogels et al. NeurIPS 2019). The numerics finite-flag "
+         "vote never rides a compressed carrier: compressed buckets "
+         "carry the veto as a separate exact f32 psum (HVD007 "
+         "check (e))."),
+    Knob("HOROVOD_COMPRESSION_RANK", int, 4,
+         "PowerSGD approximation rank r when HOROVOD_COMPRESSION="
+         "powersgd carries no explicit :rank suffix. Wire per "
+         "compressed matrix drops from n*m to r*(n+m) elements; "
+         "rank<=4 already clears 4x on the VGG/transformer dense "
+         "buckets (BENCH_compression_ab_r13.json)."),
+    Knob("HOROVOD_COMPRESSION_WARMUP_STEPS", int, 0,
+         "Steps to run the EXACT reduction before switching to the "
+         "compressed wire. The eager plane counts steps in its "
+         "optimizer state and switches in place; the jit plane's "
+         "compressed step is a separate compiled program, so the "
+         "harness (bench.py convergence loop is the template) runs "
+         "the compression=none build for the first N steps and then "
+         "switches — one extra compile, no in-program branch (the "
+         "traced wire stays the plan HVD007 verified)."),
+    Knob("HOROVOD_COMPRESSION_MIN_ELEMENTS", int, 4096,
+         "PowerSGD bypass floor: leaves with fewer elements (and all "
+         "non-2D-reshapeable leaves — biases, scalars, norm gains) "
+         "take the exact path. Low-rank wire only pays for dense "
+         "matrices; below this size the factor handshake costs more "
+         "than it saves."),
     Knob("HOROVOD_CYCLE_TIME", float, 1.0,
          "Background engine cycle time in milliseconds: how often the "
          "pending-tensor queue is drained and negotiated."),
@@ -479,6 +511,10 @@ class Config:
     _ATTR_MAP = {
         "fusion_threshold": "HOROVOD_FUSION_THRESHOLD",
         "jit_overlap": "HOROVOD_JIT_OVERLAP",
+        "compression": "HOROVOD_COMPRESSION",
+        "compression_rank": "HOROVOD_COMPRESSION_RANK",
+        "compression_warmup_steps": "HOROVOD_COMPRESSION_WARMUP_STEPS",
+        "compression_min_elements": "HOROVOD_COMPRESSION_MIN_ELEMENTS",
         "cycle_time_ms": "HOROVOD_CYCLE_TIME",
         "batch_quiescence": "HOROVOD_BATCH_QUIESCENCE",
         "cache_capacity": "HOROVOD_CACHE_CAPACITY",

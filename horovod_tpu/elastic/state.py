@@ -222,10 +222,21 @@ class JaxState(ObjectState):
 
     def __init__(self, params: Any = None, opt_state: Any = None,
                  snapshot_path: Optional[str] = None,
-                 snapshot_backend: str = "auto", **kwargs):
+                 snapshot_backend: str = "auto",
+                 compression_state: Any = None, **kwargs):
         self.params = params
         self.opt_state = opt_state
         self._tree_attrs = ["params", "opt_state"]
+        if compression_state is not None:
+            # PowerSGD error-feedback state (jit plane: the explicit
+            # Q/residual tree build_train_step threads; the eager
+            # plane's lives inside opt_state already). First-class
+            # here so a restart restores the accumulated error
+            # instead of silently resetting it — dropped residual is
+            # gradient signal lost forever, and the convergence
+            # artifact's tolerance assumes it survives.
+            self.compression_state = compression_state
+            self._tree_attrs.append("compression_state")
         # Optional durable snapshot: on TPU a hard worker failure kills
         # the whole gang (the coordination service fatally terminates
         # survivors), so in-memory commits alone cannot recover from
@@ -263,6 +274,21 @@ class JaxState(ObjectState):
         super().save()
         self._tree_saved = {k: _to_host(getattr(self, k))
                             for k in self._tree_attrs}
+        if "compression_state" in self._tree_attrs:
+            # Journal the residual watermark at every commit (the
+            # snapshot is the recovery source; the journal line is
+            # what lets a post-mortem confirm no restart silently
+            # reset the error memory).
+            from .. import journal as _journal
+            cs = self._tree_saved.get("compression_state") or {}
+            es = list((cs.get("e") or {}).values())
+            _journal.record(
+                "compression_commit",
+                step=getattr(self, "step", None),
+                residual_leaves=len(es),
+                residual_norm=float(np.sqrt(sum(
+                    float((np.asarray(e, np.float64) ** 2).sum())
+                    for e in es))))
         # Journal durability marker: only a save that actually issued
         # a snapshot write advances the watermark a RESTARTED gang
         # can restore to (non-writing ranks may run a step ahead of

@@ -374,6 +374,42 @@ def record_collective(kind: str, pset_id, nbytes: int,
     pair[1].inc(tensors)
 
 
+_wire_cache: Dict[str, Tuple[_Bound, _Bound, _Bound]] = {}
+
+
+def record_wire(compression: str, raw_bytes: int,
+                wire_bytes: int) -> None:
+    """Gradient wire-byte accounting by compression tag ("none",
+    "bf16", "powersgd:4", ...). Called once per submission on the
+    eager plane and once per COMPILE on the jit plane (where the wire
+    is static per program — the trace-time record states what each
+    step of that program will move). `raw_bytes` is the uncompressed
+    payload, `wire_bytes` what actually hits the interconnect; the
+    saved-bytes counter and achieved-ratio gauge are derived here so
+    dashboards don't have to."""
+    trio = _wire_cache.get(compression)
+    if trio is None:
+        w = REGISTRY.counter(
+            "hvd_wire_bytes_total",
+            "Bytes actually moved on the gradient wire (post-"
+            "compression), by compression tag.",
+            ("compression",)).labels(compression=compression)
+        s = REGISTRY.counter(
+            "hvd_wire_bytes_saved_total",
+            "Raw-minus-wire gradient bytes elided by compression, "
+            "by compression tag.",
+            ("compression",)).labels(compression=compression)
+        r = REGISTRY.gauge(
+            "hvd_compression_ratio",
+            "Achieved raw/wire compression ratio of the most recent "
+            "submission, by compression tag.",
+            ("compression",)).labels(compression=compression)
+        trio = _wire_cache[compression] = (w, s, r)
+    trio[0].inc(wire_bytes)
+    trio[1].inc(max(0, raw_bytes - wire_bytes))
+    trio[2].set(raw_bytes / wire_bytes if wire_bytes else 0.0)
+
+
 # -- scrape endpoint --------------------------------------------------------
 
 class _Handler(BaseHTTPRequestHandler):

@@ -122,13 +122,29 @@ def partition_tree(tree: Any, threshold_bytes: int,
                              threshold_bytes, key_fn)
 
 
-def assignment_digest(buckets: Sequence[Bucket]) -> str:
+def assignment_digest(buckets: Sequence[Bucket],
+                      compression: Optional[Sequence[str]] = None
+                      ) -> str:
     """Canonical string form of a bucket assignment — what the
     determinism tests (and any cross-process assertion) compare.
-    Byte-identical assignments have byte-identical digests."""
-    return ";".join(
-        ",".join(str(i) for i in b.indices) + f":{b.nbytes}"
-        for b in buckets)
+    Byte-identical assignments have byte-identical digests.
+
+    `compression` (optional, one tag per bucket — "none", "bf16",
+    "powersgd:4", ...) extends each bucket's entry with `|c=<tag>`
+    when the tag is not "none", so the cross-process contract now
+    states the TRANSFORM each bucket's wire takes, not just its
+    membership: two processes that agree on the partition but
+    disagree on a bucket's compressor would compile different
+    programs, and the digest (checked by HVD007 against the traced
+    collectives) catches it. An all-"none" assignment keeps the
+    historical digest byte-identical."""
+    ents = []
+    for bi, b in enumerate(buckets):
+        ent = ",".join(str(i) for i in b.indices) + f":{b.nbytes}"
+        if compression is not None and compression[bi] != "none":
+            ent += f"|c={compression[bi]}"
+        ents.append(ent)
+    return ";".join(ents)
 
 
 class _SigLeaf(NamedTuple):

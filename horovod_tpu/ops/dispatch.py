@@ -1321,6 +1321,24 @@ def allreduce_group(tensors: List[jax.Array], pset: ProcessSet, op: int,
             compressors = None
         else:
             compressors = tuple(compressors)
+    if compressors is not None:
+        # Wire-byte accounting by compression tag: raw vs on-wire
+        # payload of the cast-compressed members (record_collective
+        # above already counted the raw bytes of the whole group).
+        from .compression import NoneCompressor, tag_of, wire_dtype_of
+        from ..metrics import record_wire as _record_wire
+        agg: dict = {}
+        for c, t in zip(compressors, tensors):
+            if c is NoneCompressor:
+                continue
+            size = int(np.prod(t.shape)) if t.shape else 1
+            raw_b = size * jnp.dtype(t.dtype).itemsize
+            wire_b = size * jnp.dtype(
+                wire_dtype_of(c, t.dtype)).itemsize
+            r, w = agg.get(tag_of(c), (0, 0))
+            agg[tag_of(c)] = (r + raw_b, w + wire_b)
+        for tag, (r, w) in agg.items():
+            _record_wire(tag, r, w)
     n = pset.size
     if n == 1:
         scale = prescale * postscale

@@ -34,6 +34,12 @@ from .. import numerics as _numerics
 from ..common.compat import GRADS_PRE_SUMMED, shard_map
 from ..ops.bucketing import (assignment_digest, partition_buckets,
                              split_by_dtype)
+from ..ops.compression import (CompressionSpec, effective_rank,
+                               gram_orthogonalize, init_q,
+                               matrix_shape, powersgd_eligible,
+                               powersgd_reduce, powersgd_wire_elements,
+                               resolve_compression, wire_dtype_of)
+from ..ops import compression as _compression
 from .mesh import FSDP_AXIS, batch_axes
 from .sharding import replicated
 
@@ -58,6 +64,31 @@ def overlap_threshold_bytes() -> int:
     from ..common.config import knob_default
     return int(_numerics._cfg("HOROVOD_FUSION_THRESHOLD",
                               knob_default("HOROVOD_FUSION_THRESHOLD")))
+
+
+def compression_spec(compression=None, rank=None,
+                     min_elements=None) -> CompressionSpec:
+    """Resolve the builder's compression config: explicit args win,
+    otherwise the HOROVOD_COMPRESSION knob family (Config-aware, same
+    read path as the overlap/threshold knobs)."""
+    from ..common.config import knob_default
+    name = compression
+    if name is None:
+        name = str(_numerics._cfg(
+            "HOROVOD_COMPRESSION", knob_default("HOROVOD_COMPRESSION")))
+    # An explicit rank wins; a "powersgd:r" suffix wins next; the
+    # rank knob is only the fallback (resolved here so Config
+    # overrides are honored like every other builder knob).
+    if rank is None and not any(c in str(name) for c in ":("):
+        rank = int(_numerics._cfg(
+            "HOROVOD_COMPRESSION_RANK",
+            knob_default("HOROVOD_COMPRESSION_RANK")))
+    if min_elements is None:
+        min_elements = int(_numerics._cfg(
+            "HOROVOD_COMPRESSION_MIN_ELEMENTS",
+            knob_default("HOROVOD_COMPRESSION_MIN_ELEMENTS")))
+    return resolve_compression(name, rank=rank,
+                               min_elements=min_elements)
 
 
 # Introspection for bench/tests, following dispatch.py's
@@ -120,6 +151,14 @@ class OverlapPlan(NamedTuple):
     digest: str
     leaf_raxes: Tuple[Tuple[str, ...], ...]
     loose_inexact: Tuple[int, ...]
+    # Per-bucket compression tag ("none" / "fp16" / "bf16" /
+    # "powersgd:r") — states WHAT transform each bucket's wire takes,
+    # so the verifier can tie the traced factor psums / cast wire to
+    # the plan and enforce check (e): a compressed bucket's
+    # finite-flag vote is a separate exact f32 psum, never a ride on
+    # the lossy carrier. All-"none" for uncompressed builds (the
+    # digest then stays byte-identical to the historical format).
+    bucket_compression: Tuple[str, ...] = ()
 
 
 def _live_axes(mesh: Mesh) -> Tuple[str, ...]:
@@ -130,13 +169,41 @@ def _live_axes(mesh: Mesh) -> Tuple[str, ...]:
     return tuple(a for a in mesh.shape if mesh.shape[a] > 1)
 
 
-def _plan_wire(idxs, leaves, guard) -> Tuple[WireGroup, ...]:
+def _plan_wire(idxs, leaves, guard,
+               comp: str = "none") -> Tuple[WireGroup, ...]:
     """Per-dtype wire groups for one bucket — the same split the
     bucket tag packs (split_by_dtype + _flag_carrier_group), computed
-    shape-only."""
+    shape-only.
+
+    `comp` is the bucket's compression tag. Cast compression
+    ("fp16"/"bf16") rewrites each floating group's wire dtype to the
+    cast target; "powersgd:r" replaces the payload groups entirely
+    with the two f32 factor psums (packed P then packed Q — the
+    order the tag emits them). Under ANY compression the flag never
+    rides (check (e)): the vote travels as its own exact f32 scalar
+    psum, which is not a wire GROUP (check_numerics matches it
+    separately), so no group carries `rides_flag` here."""
     dtypes = [leaves[i].dtype for i in idxs]
     shapes = [tuple(leaves[i].shape) for i in idxs]
+    if comp.startswith("powersgd"):
+        rank = int(comp.split(":", 1)[1])
+        np_el = sum(powersgd_wire_elements(s, rank)[0] for s in shapes)
+        nq_el = sum(powersgd_wire_elements(s, rank)[1] for s in shapes)
+        return (WireGroup("float32", np_el, False, None),
+                WireGroup("float32", nq_el, False, None))
     groups = split_by_dtype([jnp.dtype(d) for d in dtypes])
+    if comp in ("fp16", "bf16"):
+        caster = (_compression.FP16Compressor if comp == "fp16"
+                  else _compression.BF16Compressor)
+        out = []
+        for positions in groups:
+            wd = wire_dtype_of(caster, dtypes[positions[0]])
+            n = sum(int(np.prod(shapes[p])) if shapes[p] else 1
+                    for p in positions)
+            natural = (shapes[positions[0]] if len(positions) == 1
+                       else None)
+            out.append(WireGroup(str(wd), n, False, natural))
+        return tuple(out)
     has_inexact = any(jnp.issubdtype(jnp.dtype(d), jnp.inexact)
                       for d in dtypes)
     flag_gi = (_flag_carrier_group(groups, dtypes)
@@ -158,20 +225,36 @@ def _plan_wire(idxs, leaves, guard) -> Tuple[WireGroup, ...]:
 def plan_overlap(params: Any, mesh: Mesh,
                  param_specs: Any = None, *,
                  overlap_threshold: Optional[int] = None,
-                 guard: Optional[bool] = None) -> OverlapPlan:
+                 guard: Optional[bool] = None,
+                 compression: Optional[str] = None,
+                 compression_rank: Optional[int] = None,
+                 compression_min_elements: Optional[int] = None
+                 ) -> OverlapPlan:
     """The bucket plan `build_train_step(overlap=True)` will emit.
 
     Pure function of (leaf structure/shapes/dtypes, mesh shape,
-    specs, threshold, guard) — no devices, no tracing — so any
-    process (or the HVD007 verifier) can derive the agreed collective
-    schedule without building a step. Defaults mirror the builder:
-    threshold from HOROVOD_FUSION_THRESHOLD, guard from
-    numerics.guard_enabled()."""
+    specs, threshold, guard, compression config) — no devices, no
+    tracing — so any process (or the HVD007 verifier) can derive the
+    agreed collective schedule without building a step. Defaults
+    mirror the builder: threshold from HOROVOD_FUSION_THRESHOLD,
+    guard from numerics.guard_enabled(), compression from the
+    HOROVOD_COMPRESSION knob family.
+
+    Compression is a bucketing-layer transform: under powersgd,
+    eligible leaves (2-D-reshapeable, >= min_elements, replicated
+    over every live axis — model-sharded leaves bypass: their
+    residual would shard differently per leaf) form their own bucket
+    families so a compressed bucket never mixes with bypass leaves;
+    `bucket_compression` tags each bucket and the digest carries the
+    tags (`|c=powersgd:4`) so the cross-process contract states the
+    transform, not just the membership."""
     if param_specs is None:
         param_specs = P()
     bthresh = (overlap_threshold_bytes() if overlap_threshold is None
                else int(overlap_threshold))
     g = _numerics.guard_enabled() if guard is None else bool(guard)
+    spec = compression_spec(compression, compression_rank,
+                            compression_min_elements)
     leaves = jax.tree_util.tree_leaves(params)
     spec_tree = _broadcast_specs(param_specs, params)
     spec_leaves = jax.tree_util.tree_leaves(
@@ -183,23 +266,101 @@ def plan_overlap(params: Any, mesh: Mesh,
     bucketable = [i for i in range(len(leaves))
                   if raxes_of[i]
                   and jnp.issubdtype(leaves[i].dtype, jnp.inexact)]
+    if spec.kind == "powersgd":
+        lowrank_set = {
+            i for i in bucketable
+            if raxes_of[i] == live and powersgd_eligible(
+                leaves[i].shape, leaves[i].dtype, spec.min_elements)}
+
+        def key_fn(j, leaf):
+            return (raxes_of[bucketable[j]],
+                    bucketable[j] in lowrank_set)
+    else:
+        lowrank_set = set()
+
+        def key_fn(j, leaf):
+            return raxes_of[bucketable[j]]
     parts = partition_buckets(
-        [leaves[i] for i in bucketable], bthresh,
-        key_fn=lambda j, leaf: raxes_of[bucketable[j]])
+        [leaves[i] for i in bucketable], bthresh, key_fn=key_fn)
     bucket_idx = tuple(tuple(bucketable[j] for j in b.indices)
                        for b in parts)
     bucketed = {i for idxs in bucket_idx for i in idxs}
+    if spec.kind == "powersgd":
+        comp_tags = tuple(
+            f"powersgd:{spec.rank}" if idxs[0] in lowrank_set
+            else "none" for idxs in bucket_idx)
+    else:
+        comp_tags = tuple(spec.kind for _ in bucket_idx)
     return OverlapPlan(
         threshold=bthresh, guard=g, n_leaves=len(leaves),
         bucket_leaf_indices=bucket_idx,
         bucket_raxes=tuple(raxes_of[idxs[0]] for idxs in bucket_idx),
         bucket_nbytes=tuple(int(b.nbytes) for b in parts),
-        wire=tuple(_plan_wire(idxs, leaves, g) for idxs in bucket_idx),
-        digest=assignment_digest(parts),
+        wire=tuple(_plan_wire(idxs, leaves, g, comp_tags[bid])
+                   for bid, idxs in enumerate(bucket_idx)),
+        digest=assignment_digest(
+            parts, compression=(comp_tags if spec.kind != "none"
+                                else None)),
         leaf_raxes=tuple(raxes_of),
         loose_inexact=tuple(
             i for i in range(len(leaves)) if i not in bucketed
-            and jnp.issubdtype(leaves[i].dtype, jnp.inexact)))
+            and jnp.issubdtype(leaves[i].dtype, jnp.inexact)),
+        bucket_compression=comp_tags)
+
+
+def init_compression_state(params: Any, mesh: Mesh,
+                           param_specs: Any = None, *,
+                           compression: Optional[str] = None,
+                           compression_rank: Optional[int] = None,
+                           compression_min_elements: Optional[int]
+                           = None,
+                           overlap_threshold: Optional[int] = None,
+                           guard: Optional[bool] = None):
+    """Initial PowerSGD loop state for `build_train_step(
+    compression="powersgd...")` — returns `(state, specs)`.
+
+    `state` is the first-class compression pytree the compressed step
+    threads: `{"q": {leaf_idx: (m, r) f32}, "e": {leaf_idx:
+    (n_ranks*n, m) f32}}` keyed by flattened-leaf index (string keys
+    for stable pytree ordering). Q factors are deterministic
+    orthonormal warm starts (`ops.compression.init_q` — identical on
+    every process, the SPMD purity contract) and replicated; each
+    error-feedback residual is a GLOBAL array whose leading dim
+    stacks the per-rank local (n, m) residuals, sharded over the live
+    mesh axes by `specs["e"]` so every rank feeds its own slice back
+    in — per-rank error memory expressed as one addressable global
+    tree, which is exactly what elastic `JaxState` persists across
+    restarts (no silent reset; test-pinned).
+
+    Derives eligibility from the SAME `plan_overlap` the builder
+    traces, so the state keys match the compressed buckets by
+    construction; the builder re-checks at trace time and raises on
+    any mismatch rather than letting autodiff hand back zeros (which
+    would silently drop accumulated error)."""
+    plan = plan_overlap(params, mesh, param_specs,
+                        overlap_threshold=overlap_threshold,
+                        guard=guard, compression=compression,
+                        compression_rank=compression_rank,
+                        compression_min_elements=compression_min_elements)
+    live = _live_axes(mesh)
+    n_red = 1
+    for a in live:
+        n_red *= mesh.shape[a]
+    leaves = jax.tree_util.tree_leaves(params)
+    state = {"q": {}, "e": {}}
+    for bid, idxs in enumerate(plan.bucket_leaf_indices):
+        tag = plan.bucket_compression[bid]
+        if not tag.startswith("powersgd"):
+            continue
+        rank = int(tag.split(":", 1)[1])
+        for i in idxs:
+            shape = tuple(leaves[i].shape)
+            n, m = matrix_shape(shape)
+            state["q"][str(i)] = init_q(shape, rank, i)
+            state["e"][str(i)] = jnp.zeros((n_red * n, m),
+                                           jnp.float32)
+    specs = {"q": P(), "e": P(tuple(live)) if live else P()}
+    return state, specs
 
 
 def _fsdp_gather_fn(param_specs, mesh):
@@ -327,7 +488,8 @@ def _flag_carrier_group(groups, dtypes):
 def _make_bucket_tag(bucket_id: int, raxes: Tuple[str, ...],
                      all_axes: Tuple[str, ...],
                      shapes: Tuple, dtypes: Tuple, scale,
-                     guard: bool, vma: bool, probe):
+                     guard: bool, vma: bool, probe,
+                     wire_cast=None):
     """custom_vjp identity over one bucket of parameter leaves whose
     BACKWARD rule is the bucket's fused reduction: the cotangents are
     flattened and packed into one wire array per dtype (the in-jit
@@ -354,10 +516,31 @@ def _make_bucket_tag(bucket_id: int, raxes: Tuple[str, ...],
     callbacks on the packed wire array (cotangents ready) and on the
     reduced array (reduction done) timestamp each bucket's reduce
     span against the surrounding backprop in real execution order.
+
+    `wire_cast` (fp16/bf16 wire compression): floating wire arrays
+    are cast to this dtype before the psum and back after — the
+    reference's MemcpyInFusionBuffer cast, fused into the same XLA
+    region as the pack. The finite-flag must NEVER ride a lossy
+    carrier (a 0/1 vote COUNT in half precision stops being
+    integer-exact, and the carrier itself is now lossy — HVD007
+    check (e)), so under any cast the flag takes the separate exact
+    f32 psum path below (`flag_gi is None`), the invariant the
+    numerics PR carved out for exactly this case. None (the default)
+    changes NOTHING in the traced program — the HLO-identity test
+    pins compression=none to today's builder byte-for-byte.
     """
     sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
     groups = split_by_dtype([jnp.dtype(d) for d in dtypes])
-    flag_gi = _flag_carrier_group(groups, dtypes) if guard else None
+    flag_gi = (_flag_carrier_group(groups, dtypes)
+               if guard and wire_cast is None else None)
+
+    def _cast_dt(dt):
+        """Wire dtype of one group under the cast (identity for
+        non-floating and already-at-wire groups)."""
+        if wire_cast is not None and jnp.issubdtype(
+                jnp.dtype(dt), jnp.floating):
+            return jnp.dtype(wire_cast)
+        return jnp.dtype(dt)
     has_inexact = any(jnp.issubdtype(jnp.dtype(d), jnp.inexact)
                       for d in dtypes)
     # Axes the bucket's leaves are SHARDED over: the flag count must
@@ -403,6 +586,9 @@ def _make_bucket_tag(bucket_id: int, raxes: Tuple[str, ...],
                 # copy_reshape; this elides it.
                 p = positions[0]
                 ct = cts[p]
+                wd = _cast_dt(ct.dtype)
+                if wd != ct.dtype:
+                    ct = ct.astype(wd)
                 wire_nbytes = int(ct.size) * ct.dtype.itemsize
                 if probe is not None:
                     jax.debug.callback(
@@ -410,6 +596,8 @@ def _make_bucket_tag(bucket_id: int, raxes: Tuple[str, ...],
                             probe(b, "ready", nb),
                         ct.reshape(-1)[0])
                 red = _psum_r(ct)
+                if wd != cts[p].dtype:
+                    red = red.astype(cts[p].dtype)
                 if probe is not None:
                     jax.debug.callback(
                         lambda _t, b=bucket_id, nb=wire_nbytes:
@@ -425,6 +613,10 @@ def _make_bucket_tag(bucket_id: int, raxes: Tuple[str, ...],
             if rides:
                 concat = jnp.concatenate(
                     [concat, flag.astype(concat.dtype).reshape(1)])
+            gdt = concat.dtype
+            wd = _cast_dt(gdt)
+            if wd != gdt:
+                concat = concat.astype(wd)
             wire_nbytes = int(concat.size) * concat.dtype.itemsize
             if probe is not None:
                 # Data dependency on one element anchors the callback
@@ -440,6 +632,8 @@ def _make_bucket_tag(bucket_id: int, raxes: Tuple[str, ...],
                     lambda _t, b=bucket_id, nb=wire_nbytes:
                         probe(b, "reduced", nb),
                     red[0])
+            if wd != gdt:
+                red = red.astype(gdt)
             if rides:
                 rflag = red[-1].astype(jnp.float32)
                 red = red[:-1]
@@ -463,6 +657,108 @@ def _make_bucket_tag(bucket_id: int, raxes: Tuple[str, ...],
     return tag
 
 
+def _make_powersgd_tag(bucket_id: int, raxes: Tuple[str, ...],
+                       shapes: Tuple, dtypes: Tuple, scale,
+                       guard: bool, vma: bool, probe,
+                       rank: int, n_devices: int):
+    """custom_vjp identity over one PowerSGD bucket: the backward
+    rule runs the low-rank factor handshake of
+    `ops.compression.powersgd_reduce` instead of the dense psum —
+    compress (M @ Q), all-reduce the packed P factors, one
+    Gram-matrix orthogonalization, all-reduce the packed Q' factors,
+    decompress (P @ Q'^T) — all inside the same overlap boundary the
+    dense tag occupies, so XLA schedules the (much smaller) factor
+    psums under the remaining backprop exactly like dense buckets.
+
+    Loop state rides autodiff's own channel: the warm Q factors and
+    error-feedback residuals enter as extra primal inputs and the
+    UPDATED factors/residuals leave as their cotangents (the same
+    only-way-out-of-a-bwd-rule trick the finite-flag uses via its
+    dummy), so `build_train_step` threads compression state through
+    `jax.value_and_grad` with no second tracing mechanism.
+
+    The numerics finite-flag vote stays EXACT (HVD007 check (e)):
+    computed on the RAW cotangents and psum'd as its own f32 scalar —
+    it never touches the factor wire. The vote also gates the state
+    update: on a vetoed (non-finite) step the new Q/residual are the
+    OLD Q/residual, so a poisoned step cannot corrupt the error
+    memory (mirror of guard_non_finite freezing the inner optimizer
+    state on skip).
+
+    PowerSGD-eligible leaves are replicated over every live mesh axis
+    (plan_overlap's eligibility gate), so `raxes` here is the full
+    live set and no rem-axes flag fold is needed."""
+    nleaves = len(shapes)
+    mats = [matrix_shape(s) for s in shapes]
+    ranks = [effective_rank(s, rank) for s in shapes]
+    wire_total = 4 * sum(n * r + m * r
+                         for (n, m), r in zip(mats, ranks))
+
+    def _psum_r(x):
+        for a in raxes:
+            x = lax.psum(x, a)
+        return x
+
+    def _primal(xs):
+        if vma:
+            return tuple(lax.pvary(x, raxes) for x in xs)
+        return tuple(xs)
+
+    @jax.custom_vjp
+    def tag(dummy, *args):
+        return _primal(args[2 * nleaves:])
+
+    def fwd(dummy, *args):
+        return (_primal(args[2 * nleaves:]),
+                (args[:nleaves], args[nleaves:2 * nleaves]))
+
+    def bwd(res, cts):
+        qs, es = res
+        flag = None
+        if guard:
+            flag = _numerics.local_finite_flag(list(cts))
+        ms = [cts[i].astype(jnp.float32).reshape(mats[i])
+              for i in range(nleaves)]
+        calls = {"n": 0}
+
+        def psum_fn(flat):
+            first = calls["n"] == 0
+            calls["n"] += 1
+            if probe is not None and first:
+                jax.debug.callback(
+                    lambda _t, b=bucket_id, nb=wire_total:
+                        probe(b, "ready", nb),
+                    flat[0])
+            red = _psum_r(flat)
+            if probe is not None and not first:
+                jax.debug.callback(
+                    lambda _t, b=bucket_id, nb=wire_total:
+                        probe(b, "reduced", nb),
+                    red[0])
+            return red
+
+        outs, new_qs, new_es = powersgd_reduce(
+            ms, list(qs), list(es), psum_fn, n_devices)
+        rflag = jnp.zeros((), jnp.float32)
+        if flag is not None:
+            rflag = _psum_r(flag)
+            ok = rflag > n_devices - 0.5
+            new_qs = [jnp.where(ok, nq, q)
+                      for nq, q in zip(new_qs, qs)]
+            new_es = [jnp.where(ok, ne, e)
+                      for ne, e in zip(new_es, es)]
+        grads = []
+        for i in range(nleaves):
+            o = outs[i]
+            if scale is not None:
+                o = o * jnp.asarray(scale, o.dtype)
+            grads.append(o.reshape(shapes[i]).astype(dtypes[i]))
+        return (rflag,) + tuple(new_qs) + tuple(new_es) + tuple(grads)
+
+    tag.defvjp(fwd, bwd)
+    return tag
+
+
 def build_train_step(
     loss_fn: Callable[..., Any],
     optimizer: optax.GradientTransformation,
@@ -478,9 +774,28 @@ def build_train_step(
     overlap: Optional[bool] = None,
     overlap_threshold: Optional[int] = None,
     overlap_probe: Optional[Callable] = None,
+    compression: Optional[str] = None,
+    compression_rank: Optional[int] = None,
+    compression_min_elements: Optional[int] = None,
 ) -> Callable:
     """Build `step(params, opt_state, batch) -> (params, opt_state,
     metrics)` as a single jitted shard_map over `mesh`.
+
+    Gradient wire compression (`compression`, default = the
+    HOROVOD_COMPRESSION knob family, "none"): a per-bucket transform
+    inside the overlap boundary. "fp16"/"bf16" cast each bucket's
+    wire; "powersgd[:r]" low-rank-compresses eligible dense matrices
+    with error feedback and CHANGES THE STEP SIGNATURE to
+    `step(params, opt_state, batch, compression_state) -> (params,
+    opt_state, metrics, compression_state)` — build the state with
+    `init_compression_state` (same config) and persist it in elastic
+    `JaxState(compression_state=...)` so restarts keep the residual.
+    compression="none" lowers BYTE-IDENTICAL HLO to today's builder
+    (test-pinned); any compression requires the overlap path (the
+    buckets are the carrier). HOROVOD_COMPRESSION_WARMUP_STEPS is a
+    harness-level contract on this plane: run the compression="none"
+    build for the first N steps, then switch programs (see the knob's
+    registry doc).
 
     check_vma=False disables shard_map's static replication checker —
     required when the loss contains Pallas kernels whose pallas_call
@@ -672,6 +987,15 @@ def build_train_step(
                    else bool(overlap)) and _OVERLAP_SUPPORTED
     bthresh = (overlap_threshold_bytes() if overlap_threshold is None
                else int(overlap_threshold))
+    cspec = compression_spec(compression, compression_rank,
+                             compression_min_elements)
+    if cspec.kind != "none" and not use_overlap:
+        raise ValueError(
+            f"HOROVOD_COMPRESSION={cspec.tag()} requires the bucketed "
+            "overlap path (the buckets are the compression carrier); "
+            "enable HOROVOD_JIT_OVERLAP / overlap=True or set "
+            "compression='none'")
+    use_powersgd = cspec.kind == "powersgd"
     vma_leg = GRADS_PRE_SUMMED and hasattr(lax, "pvary")
     axis_names = tuple(mesh.shape.keys())
     live_axes = _live_axes(mesh)
@@ -687,14 +1011,18 @@ def build_train_step(
     else:
         default_scale = _base_scale
 
-    def _bucketed_value_and_grad(params, batch):
+    def _bucketed_value_and_grad(params, batch, cstate=None):
         """value_and_grad with per-bucket custom_vjp boundaries: each
         bucket's fused psum is emitted INSIDE the backward pass, as
         soon as its cotangents exist (reverse topological bucket
         order), instead of as one end-of-step block — XLA's async
         collectives then hide the reduction under the remaining
-        backprop. Returns (loss, aux, reduced_grads) — the guard's
-        unanimity vote is already folded in via imprint_non_finite.
+        backprop. Returns (loss, aux, reduced_grads, new_cstate) —
+        the guard's unanimity vote is already folded in via
+        imprint_non_finite, and `new_cstate` is the updated PowerSGD
+        compression state (warm Q factors + error-feedback residual,
+        exiting the custom_vjp boundary as the cotangent of the state
+        inputs; None unless compression is powersgd).
 
         The bucket assignment comes from `plan_overlap` — the same
         introspectable plan the HVD007 jaxpr verifier checks the
@@ -715,41 +1043,110 @@ def build_train_step(
         leg)."""
         leaves, treedef = jax.tree_util.tree_flatten(params)
         plan = plan_overlap(params, mesh, param_specs,
-                            overlap_threshold=bthresh, guard=guard)
+                            overlap_threshold=bthresh, guard=guard,
+                            compression=cspec.tag(),
+                            compression_min_elements=cspec.min_elements)
         bucket_idx = plan.bucket_leaf_indices
+        comp_tags = plan.bucket_compression
+        raw_bytes = int(sum(plan.bucket_nbytes))
+        wire_bytes = int(sum(
+            g.n * jnp.dtype(g.dtype).itemsize
+            for groups in plan.wire for g in groups))
         _last_overlap_info.clear()
         _last_overlap_info.update(
             enabled=True, traced=True, threshold=bthresh,
             buckets=len(bucket_idx),
             bucket_bytes=list(plan.bucket_nbytes),
             bucket_leaves=[len(idxs) for idxs in bucket_idx],
-            n_leaves=len(leaves), digest=plan.digest)
+            n_leaves=len(leaves), digest=plan.digest,
+            compression=cspec.tag(), raw_bucket_bytes=raw_bytes,
+            wire_bucket_bytes=wire_bytes)
+        if cspec.kind != "none" and raw_bytes:
+            # Per-program wire accounting at trace time (the jit
+            # plane's wire is static per compile — the per-step
+            # counters live on the eager plane): one record per
+            # compiled program states what the wire costs.
+            from ..metrics import record_wire
+            record_wire(cspec.tag(), raw_bytes, wire_bytes)
         tags = []
         for bid, idxs in enumerate(bucket_idx):
-            tags.append(_make_bucket_tag(
-                bid, plan.bucket_raxes[bid], live_axes,
-                tuple(tuple(leaves[i].shape) for i in idxs),
-                tuple(leaves[i].dtype for i in idxs),
-                default_scale, guard, vma_leg, overlap_probe))
+            bshapes = tuple(tuple(leaves[i].shape) for i in idxs)
+            bdtypes = tuple(leaves[i].dtype for i in idxs)
+            ctag = comp_tags[bid]
+            if ctag.startswith("powersgd"):
+                tags.append(_make_powersgd_tag(
+                    bid, plan.bucket_raxes[bid], bshapes, bdtypes,
+                    default_scale, guard, vma_leg, overlap_probe,
+                    int(ctag.split(":", 1)[1]), n_devices))
+            else:
+                tags.append(_make_bucket_tag(
+                    bid, plan.bucket_raxes[bid], live_axes,
+                    bshapes, bdtypes,
+                    default_scale, guard, vma_leg, overlap_probe,
+                    wire_cast=(jnp.dtype(jnp.float16)
+                               if ctag == "fp16" else
+                               jnp.dtype(jnp.bfloat16)
+                               if ctag == "bf16" else None)))
         dummies = tuple(jnp.zeros((), jnp.float32) for _ in bucket_idx)
+        lowrank_leaves = [i for bid, idxs in enumerate(bucket_idx)
+                         if comp_tags[bid].startswith("powersgd")
+                         for i in idxs]
+        if use_powersgd:
+            have = set() if cstate is None else set(cstate["q"])
+            want = {str(i) for i in lowrank_leaves}
+            if have != want:
+                raise ValueError(
+                    "compression_state does not match the compressed "
+                    f"leaf set (state has {sorted(have)}, plan "
+                    f"compresses {sorted(want)}); build it with "
+                    "init_compression_state under the SAME mesh/"
+                    "specs/threshold/compression config — a mismatch "
+                    "would silently zero the error-feedback residual")
 
-        def wrapped(leaves_t, dummies_t, batch):
-            lvs = list(leaves_t)
-            for tag, idxs, d in zip(tags, bucket_idx, dummies_t):
-                ys = tag(d, *[lvs[i] for i in idxs])
+        def apply_tags(lvs, dummies_t, cstate_t):
+            for bid, (tag, idxs, d) in enumerate(
+                    zip(tags, bucket_idx, dummies_t)):
+                if comp_tags[bid].startswith("powersgd"):
+                    qs = [cstate_t["q"][str(i)] for i in idxs]
+                    es = [cstate_t["e"][str(i)] for i in idxs]
+                    ys = tag(d, *qs, *es, *[lvs[i] for i in idxs])
+                else:
+                    ys = tag(d, *[lvs[i] for i in idxs])
                 for i, y in zip(idxs, ys):
                     lvs[i] = y
-            p = jax.tree_util.tree_unflatten(treedef, lvs)
-            return eff_loss(p, batch)
+            return lvs
 
-        vg = jax.value_and_grad(wrapped, argnums=(0, 1),
-                                has_aux=loss_has_aux)
-        if loss_has_aux:
-            (loss, aux), (glvs, gflags) = vg(tuple(leaves), dummies,
-                                             batch)
+        if use_powersgd:
+            def wrapped(leaves_t, dummies_t, cstate_t, batch):
+                lvs = apply_tags(list(leaves_t), dummies_t, cstate_t)
+                p = jax.tree_util.tree_unflatten(treedef, lvs)
+                return eff_loss(p, batch)
+
+            vg = jax.value_and_grad(wrapped, argnums=(0, 1, 2),
+                                    has_aux=loss_has_aux)
+            if loss_has_aux:
+                (loss, aux), (glvs, gflags, new_cstate) = vg(
+                    tuple(leaves), dummies, cstate, batch)
+            else:
+                loss, (glvs, gflags, new_cstate) = vg(
+                    tuple(leaves), dummies, cstate, batch)
+                aux = None
         else:
-            loss, (glvs, gflags) = vg(tuple(leaves), dummies, batch)
-            aux = None
+            def wrapped(leaves_t, dummies_t, batch):
+                lvs = apply_tags(list(leaves_t), dummies_t, None)
+                p = jax.tree_util.tree_unflatten(treedef, lvs)
+                return eff_loss(p, batch)
+
+            vg = jax.value_and_grad(wrapped, argnums=(0, 1),
+                                    has_aux=loss_has_aux)
+            if loss_has_aux:
+                (loss, aux), (glvs, gflags) = vg(tuple(leaves),
+                                                 dummies, batch)
+            else:
+                loss, (glvs, gflags) = vg(tuple(leaves), dummies,
+                                          batch)
+                aux = None
+            new_cstate = None
         glvs = list(glvs)
         bucketed = {i for idxs in bucket_idx for i in idxs}
         # Un-bucketed inexact leaves: same treatment the monolithic
@@ -789,7 +1186,7 @@ def build_train_step(
             grads = grad_reducer(grads)
         if ok is not None:
             grads = _numerics.imprint_non_finite(grads, ok)
-        return loss, aux, grads
+        return loss, aux, grads, new_cstate
 
     # Metric averaging: legacy leg only pmeans over LIVE batch axes
     # (pmean over a size-1 axis is an identity psum + div-by-1 — dead
@@ -799,18 +1196,7 @@ def build_train_step(
     metric_baxes = (baxes if GRADS_PRE_SUMMED
                     else tuple(a for a in baxes if mesh.shape[a] > 1))
 
-    def local_step(params, opt_state, batch):
-        if use_overlap:
-            loss, aux, grads = _bucketed_value_and_grad(params, batch)
-        else:
-            if loss_has_aux:
-                (loss, aux), grads = jax.value_and_grad(
-                    eff_loss, has_aux=True)(params, batch)
-            else:
-                loss, grads = jax.value_and_grad(eff_loss)(params,
-                                                           batch)
-                aux = None
-            grads = reduce_grads(grads)
+    def _finish_step(loss, aux, grads, params, opt_state):
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         metrics = {"loss": _pmean_axes(loss, metric_baxes)}
@@ -821,6 +1207,36 @@ def build_train_step(
                 lambda a: _pmean_axes(a, metric_baxes), aux)
         return params, opt_state, metrics
 
+    if use_powersgd:
+        # PowerSGD threads explicit loop state: the step takes and
+        # returns the compression state (warm Q + error-feedback
+        # residual) as a 4th argument/result, the same way the
+        # optimizer state rides the step. Q is replicated; the
+        # residual is the stacked per-rank error memory, sharded
+        # over the live reduce axes so each rank feeds back exactly
+        # the error ITS compressed contribution left behind.
+        def local_step(params, opt_state, batch, cstate):
+            loss, aux, grads, new_cstate = _bucketed_value_and_grad(
+                params, batch, cstate)
+            params, opt_state, metrics = _finish_step(
+                loss, aux, grads, params, opt_state)
+            return params, opt_state, metrics, new_cstate
+    else:
+        def local_step(params, opt_state, batch):
+            if use_overlap:
+                loss, aux, grads, _ = _bucketed_value_and_grad(
+                    params, batch)
+            else:
+                if loss_has_aux:
+                    (loss, aux), grads = jax.value_and_grad(
+                        eff_loss, has_aux=True)(params, batch)
+                else:
+                    loss, grads = jax.value_and_grad(eff_loss)(
+                        params, batch)
+                    aux = None
+                grads = reduce_grads(grads)
+            return _finish_step(loss, aux, grads, params, opt_state)
+
     # Reset the introspection dict at BUILD time on both branches so
     # last_overlap_info() never reports a previous builder's bucket
     # plan for a step that has not traced yet (traced=False flips
@@ -829,13 +1245,28 @@ def build_train_step(
     _last_overlap_info.update(enabled=use_overlap, threshold=bthresh,
                               traced=False)
 
-    step = shard_map(
-        local_step, mesh=mesh,
-        in_specs=(param_specs, opt_state_specs, batch_spec),
-        out_specs=(param_specs, opt_state_specs, P()),
-        check_vma=check_vma,
-    )
-    donate_argnums = (0, 1) if donate else ()
+    if use_powersgd:
+        cstate_specs = {
+            "q": P(),
+            "e": P(tuple(live_axes)) if live_axes else P(),
+        }
+        step = shard_map(
+            local_step, mesh=mesh,
+            in_specs=(param_specs, opt_state_specs, batch_spec,
+                      cstate_specs),
+            out_specs=(param_specs, opt_state_specs, P(),
+                       cstate_specs),
+            check_vma=check_vma,
+        )
+        donate_argnums = (0, 1, 3) if donate else ()
+    else:
+        step = shard_map(
+            local_step, mesh=mesh,
+            in_specs=(param_specs, opt_state_specs, batch_spec),
+            out_specs=(param_specs, opt_state_specs, P()),
+            check_vma=check_vma,
+        )
+        donate_argnums = (0, 1) if donate else ()
     return jax.jit(step, donate_argnums=donate_argnums)
 
 

@@ -75,7 +75,7 @@ if [ "$CHANGED_ONLY" = "1" ]; then
                  git ls-files --others --exclude-standard 2>/dev/null; } \
                | sort -u )
     if ! printf '%s\n' "$changed" | grep -qE \
-        '^horovod_tpu/(parallel/|ops/bucketing\.py|numerics\.py|analysis/)'
+        '^horovod_tpu/(parallel/|ops/bucketing\.py|ops/compression\.py|numerics\.py|analysis/)'
     then
         run_jaxpr=0
         echo "== hvdlint (jaxpr tier): skipped (no semantic-tier files changed) =="

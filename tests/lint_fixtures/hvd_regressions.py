@@ -19,6 +19,12 @@ are IR-level defects the AST pass cannot see, which is the point):
   * PR 8 bug #2 — the legacy-jax psum transpose re-reduced an
     already-reduced gradient over the same axis, so gradients arrived
     exactly |axis|x too large.
+  * PR 13 — the first compression draft let the finite-flag ride the
+    fp16-cast wire carrier (one fused n+1 psum in half precision).
+    A veto count accumulated in a lossy dtype rounds n-1 up to n past
+    a few hundred ranks, silently disabling the numerics guard at
+    exactly the scale it exists for; HVD007's check (e) must flag the
+    planned ride and the missing separate exact f32 vote.
 """
 
 import subprocess
@@ -127,3 +133,45 @@ def pr8_legacy_double_reduce_builder():
                              out_specs=P()))
     args = (jax.ShapeDtypeStruct((4,), jnp.float32),)
     return step, args, {"data": 2}
+
+
+def pr13_flag_rides_compressed_carrier_builder():
+    """PR 13, jaxpr tier: the first gradient-compression draft reused
+    the dense flag-carrier packing verbatim, so a bucket cast to fp16
+    for the wire carried its finite-flag as element n+1 OF THE FP16
+    PSUM — the veto count crossed the network in half precision and
+    no exact vote existed anywhere. HVD007's check (e) must flag both
+    the planned ride and the missing separate f32 vote. Returns
+    (jitted step, example args, mesh axis sizes, buggy plan) for
+    analysis.jaxpr_verify.verify_traced(..., plan=...,
+    numerics_guard=True)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from horovod_tpu.common.compat import shard_map
+    from horovod_tpu.parallel.train import OverlapPlan, WireGroup
+
+    mesh = Mesh(np.array(jax.devices("cpu")[:2]), ("data",))
+
+    def local(g, flag):
+        # the draft's fused ride: cast, append the flag, one lossy psum
+        wire = jnp.concatenate([g.astype(jnp.float16).ravel(),
+                                flag.astype(jnp.float16)])
+        red = lax.psum(wire, "data")
+        return red[:-1].astype(jnp.float32), red[-1]
+
+    step = jax.jit(shard_map(local, mesh=mesh,
+                             in_specs=(P(), P()), out_specs=(P(), P())))
+    args = (jax.ShapeDtypeStruct((16,), jnp.float32),
+            jax.ShapeDtypeStruct((1,), jnp.float32))
+    plan = OverlapPlan(
+        threshold=4096, guard=True, n_leaves=1,
+        bucket_leaf_indices=((0,),), bucket_raxes=(("data",),),
+        bucket_nbytes=(64,),
+        wire=((WireGroup("float16", 17, True, None),),),
+        digest="1:64|c=fp16", leaf_raxes=(("data",),),
+        loose_inexact=(), bucket_compression=("fp16",))
+    return step, args, {"data": 2}, plan

@@ -381,6 +381,22 @@ class TestHistoricalRegressions:
         msgs = verify_traced(step, args, mesh_shape)
         assert any("double reduction" in m for m in msgs), msgs
 
+    def test_round13_flag_on_lossy_carrier_is_flagged(self):
+        """PR 13 (first compression draft) as a traced program: the
+        finite-flag riding the fp16 wire carrier. HVD007's check (e)
+        must flag both the planned ride and the absent exact f32
+        vote."""
+        from horovod_tpu.analysis.jaxpr_verify import verify_traced
+        mod = self._fixture_module()
+        (step, args, mesh_shape,
+         plan) = mod.pr13_flag_rides_compressed_carrier_builder()
+        msgs = verify_traced(step, args, mesh_shape,
+                             numerics_guard=True, plan=plan)
+        assert any("riding its lossy wire carrier" in m
+                   for m in msgs), msgs
+        assert any("no separate exact f32 vote" in m
+                   for m in msgs), msgs
+
 
 class TestChangedOnly:
     def test_focus_restricts_findings_to_neighbors(self):
