@@ -361,6 +361,32 @@ KNOBS: List[Knob] = [
          "Discovery circuit breaker: consecutive discovery-script "
          "failures are served from the last-known-good host list for "
          "up to this many seconds before failures propagate again."),
+    Knob("HOROVOD_ELASTIC_SLICE_ATOMIC", _parse_bool, True,
+         "Slice-atomic membership for multi-slice pods: when the "
+         "discovery script tags hosts with slice=<id>, any member-"
+         "host failure blacklists the WHOLE slice (escalating window "
+         "keyed by slice id) and an incomplete (rump) slice is "
+         "parked, never assigned ranks, until every expected member "
+         "is back. Off = slices still group TPU_PROCESS_ADDRESSES "
+         "and keep ranks contiguous, but admission falls back to "
+         "per-host. No effect on slice-less host lists."),
+    Knob("HOROVOD_ELASTIC_SLICE_FORGET_SECONDS", float, 0.0,
+         "Seconds a slice may stay rump before the driver re-"
+         "baselines its expected membership to the hosts actually "
+         "present (a deliberate shrink stops looking like an outage "
+         "after this long). 0 disables: a rump slice parks until its "
+         "full membership returns or the driver restarts."),
+    Knob("HOROVOD_ELASTIC_PREEMPT_GRACE", float, 5.0,
+         "host.preempt fault action: seconds between the SIGTERM "
+         "storm to a host's workers (the spot-eviction notice) and "
+         "the SIGKILL (the VM poweroff). XLA's preemption notifier "
+         "catches SIGTERM without exiting, so the kill is what "
+         "actually ends the workers — as on a real spot VM."),
+    Knob("HOROVOD_ELASTIC_SLICE_ID", str, "",
+         "TPU slice this worker's host belongs to, set per worker by "
+         "the elastic driver when discovery reports slice ids (absent "
+         "for single-slice jobs). Journal metadata records it so "
+         "doctor incident can attribute recoveries to slices."),
     # -- numerics (numerical integrity) --------------------------------------
     Knob("HOROVOD_NUMERICS_GUARD", _parse_bool, False,
          "Coordinated skip-step guard (numerics.py): each rank's "
@@ -397,9 +423,9 @@ KNOBS: List[Knob] = [
          "'wire.send:drop:p=0.05;elastic.step:crash:at=40'. Points: "
          "wire.send, wire.recv, rendezvous.http, discovery.poll, "
          "elastic.step, dispatch.entry, numerics.grad, "
-         "numerics.param. Actions: drop, delay, corrupt, error, "
-         "crash, hang, nan, inf, flip. Empty = every injection point "
-         "compiles to a no-op."),
+         "numerics.param, host.preempt. Actions: drop, delay, "
+         "corrupt, error, crash, hang, nan, inf, flip, preempt. "
+         "Empty = every injection point compiles to a no-op."),
     Knob("HOROVOD_FAULTS_SEED", int, 0,
          "Seed for the fault-injection schedule; each rule draws from "
          "a private stream keyed on (seed, point, action), so the "
@@ -567,6 +593,11 @@ class Config:
         "blacklist_window": "HOROVOD_ELASTIC_BLACKLIST_WINDOW",
         "blacklist_window_max": "HOROVOD_ELASTIC_BLACKLIST_WINDOW_MAX",
         "discovery_staleness_window": "HOROVOD_DISCOVERY_STALENESS_WINDOW",
+        "elastic_slice_atomic": "HOROVOD_ELASTIC_SLICE_ATOMIC",
+        "elastic_slice_forget_seconds":
+            "HOROVOD_ELASTIC_SLICE_FORGET_SECONDS",
+        "elastic_preempt_grace": "HOROVOD_ELASTIC_PREEMPT_GRACE",
+        "elastic_slice_id": "HOROVOD_ELASTIC_SLICE_ID",
         "numerics_guard": "HOROVOD_NUMERICS_GUARD",
         "numerics_max_consecutive_skips":
             "HOROVOD_NUMERICS_MAX_CONSECUTIVE_SKIPS",

@@ -21,6 +21,10 @@ with params "k=v" separated by ",". Params:
     times=M   stop after M fires (0 = unlimited)
     rank=R    fire only in the process whose HOROVOD_RANK is R
     ms=F      delay duration for the "delay" action (default 100)
+    host=H    fire only on hits the seam tags with host H (legal
+              only at host-tagged points — see HOST_TAGGED_POINTS;
+              untagged hits do not count toward at/after/every, so a
+              preemption storm targets one host deterministically)
     once=PATH filesystem latch: fire at most once ACROSS process
               restarts (a gang restart re-arms schedules from env;
               the latch is how "crash exactly once" survives it)
@@ -30,7 +34,9 @@ seam's exception class), "crash" (os._exit(43)), "drop" / "corrupt" /
 "hang" / "nan" / "inf" / "flip" (returned to the seam, which
 implements the data-plane effect — a dropped wire frame, a flipped
 byte, a parked worker, a poisoned gradient element, a bit-flipped
-parameter). Each point
+parameter), "preempt" (returned to the elastic driver's host.preempt
+seam: SIGTERM storm to every worker of the tagged host, the
+spot-eviction signal shape). Each point
 only accepts the actions its seam implements (see POINTS); the parser
 rejects the rest so a spec can never log fires that inject nothing.
 
@@ -100,9 +106,19 @@ POINTS: Dict[str, frozenset] = {
     # flips one parameter bit — simulated silent data corruption for
     # the replica-divergence sentinel to detect.
     "numerics.param": frozenset({"flip", "delay", "error", "crash"}),
+    # runner/elastic/driver.py monitor loop, fired once per live host
+    # per tick with tag=<host>: "preempt" SIGTERM-storms all of that
+    # host's workers (spot eviction), then the driver SIGKILLs past
+    # the preemption grace (the VM poweroff).
+    "host.preempt": frozenset({"preempt", "delay"}),
 }
 
 ACTIONS = frozenset().union(*POINTS.values())
+
+# Points whose seam tags each hit with a host name; only these may
+# carry a host= selector (anywhere else the rule could never fire and
+# the spec must fail loudly instead).
+HOST_TAGGED_POINTS = frozenset({"host.preempt"})
 
 CRASH_EXIT_CODE = 43
 
@@ -129,6 +145,7 @@ class _Rule:
             self.ms = float(params.pop("ms", 100.0))
             rank = params.pop("rank", None)
             self.rank = int(rank) if rank is not None else None
+            self.host = params.pop("host", None)
             self.once = params.pop("once", None)
         except ValueError as e:
             raise ValueError(
@@ -137,6 +154,10 @@ class _Rule:
             raise ValueError(
                 f"unknown fault param(s) {sorted(params)} in "
                 f"{point}:{action}")
+        if self.host is not None and point not in HOST_TAGGED_POINTS:
+            raise ValueError(
+                f"fault param host= is only legal at host-tagged "
+                f"points {sorted(HOST_TAGGED_POINTS)}, not {point!r}")
         if not 0.0 <= self.p <= 1.0:
             raise ValueError(f"fault p={self.p} outside [0, 1]")
         self.hits = 0
@@ -146,8 +167,14 @@ class _Rule:
         # index) alone.
         self.rng = random.Random(f"{seed}:{point}:{action}:{index}")
 
-    def should_fire(self) -> bool:
+    def should_fire(self, tag: Optional[str] = None) -> bool:
         """Called under the plan lock; advances the hit counter."""
+        if self.host is not None and tag != self.host:
+            # Filtered BEFORE the hit counter: at=N then means "the
+            # Nth time the seam visits THIS host", independent of how
+            # many other hosts share the tick — deterministic storm
+            # targeting.
+            return False
         self.hits += 1
         if self.rank is not None:
             # Launcher-set env, read at fire time: faults parse before
@@ -190,26 +217,29 @@ class _Plan:
         for r in rules:
             self._by_point.setdefault(r.point, []).append(r)
 
-    def fire(self, point: str, exc) -> Optional[str]:
+    def fire(self, point: str, exc,
+             tag: Optional[str] = None) -> Optional[str]:
         rules = self._by_point.get(point)
         if not rules:
             return None
         for rule in rules:
             with self._lock:
-                go = rule.should_fire()
+                go = rule.should_fire(tag)
                 hits, fired = rule.hits, rule.fired
             if not go:
                 continue
             _m_fired.labels(point=point, action=rule.action).inc()
-            hlog.warning("faults: firing %s at %s (hit %d, fired %d)",
-                         rule.action, point, hits, fired)
+            hlog.warning("faults: firing %s at %s%s (hit %d, fired %d)",
+                         rule.action, point,
+                         f" [{tag}]" if tag else "", hits, fired)
             # Journal BEFORE the action applies: for "crash" this
             # fsync'd line is the process's last word, and it is what
             # lets `doctor incident` attribute the recovery to the
             # exact injected seam instead of just "exit 43".
             from . import journal as _journal
+            extra = {"tag": tag} if tag is not None else {}
             _journal.record("fault_fired", point=point,
-                            action=rule.action, hit=hits)
+                            action=rule.action, hit=hits, **extra)
             if rule.action == "delay":
                 time.sleep(rule.ms / 1000.0)
                 return "delay"
@@ -286,16 +316,19 @@ def active() -> bool:
     return _plan is not None
 
 
-def fire(point: str, exc=None) -> Optional[str]:
+def fire(point: str, exc=None, tag: Optional[str] = None
+         ) -> Optional[str]:
     """The seam entry. Disarmed: one load + compare, nanoseconds
     (guarded by test_faults.py's overhead test). Armed: evaluates the
     point's rules; "delay" sleeps here, "error" raises `exc` (or
     FaultInjected), "crash" exits the process, and "drop" / "corrupt" /
-    "hang" are returned for the seam to apply."""
+    "hang" / "preempt" are returned for the seam to apply. `tag` is
+    the seam-supplied hit tag (the host name at host-tagged points)
+    matched against a rule's host= selector."""
     plan = _plan
     if plan is None:
         return None
-    return plan.fire(point, exc)
+    return plan.fire(point, exc, tag)
 
 
 # Arm from the environment at import: workers, the elastic driver and

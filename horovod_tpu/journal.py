@@ -79,7 +79,7 @@ _m_recovery = _METRICS.histogram(
 _m_recoveries = _METRICS.counter(
     "hvd_recoveries_total",
     "Recoveries the elastic driver ran, by detected cause "
-    "(crash / hung / internal_error).", ("cause",))
+    "(crash / hung / preempt / internal_error).", ("cause",))
 _m_step_loss = _METRICS.counter(
     "hvd_committed_step_loss_total",
     "Committed steps a recovery failed to resume at (journal "
@@ -100,6 +100,7 @@ CRITICAL_EVENTS = frozenset({
     "snapshot_loaded", "sync_done", "watermark", "first_commit",
     "numerics_escalation", "replica_divergence", "postmortem",
     "postmortem_written", "blacklist", "job_done",
+    "slice_lost", "slice_admitted", "host_preempt",
 })
 
 
@@ -141,6 +142,13 @@ class Journal:
         return mono, unix
 
     def _write_meta(self) -> None:
+        # The slice field appears only for workers launched with a
+        # slice id (multi-slice pods) — single-slice journals keep
+        # their historical meta shape.
+        extra: Dict[str, Any] = {}
+        slice_id = _config.env_value("HOROVOD_ELASTIC_SLICE_ID")
+        if slice_id:
+            extra["slice"] = slice_id
         self.event("journal_meta", _critical=True,
                    schema=SCHEMA,
                    anchor_mono_ns=self._anchor_mono,
@@ -148,7 +156,8 @@ class Journal:
                    host=_config.env_value("HOROVOD_HOSTNAME") or "",
                    epoch=_config.env_value("HOROVOD_ELASTIC_EPOCH"),
                    faults=_config.env_value("HOROVOD_FAULTS"),
-                   faults_seed=_config.env_value("HOROVOD_FAULTS_SEED"))
+                   faults_seed=_config.env_value("HOROVOD_FAULTS_SEED"),
+                   **extra)
 
     def event(self, type_: str, _critical: bool = False,
               **fields: Any) -> None:
@@ -515,6 +524,8 @@ def _cause_of(rec: dict, worker_events: List[dict]) -> dict:
         "rank": rec.get("cause_rank"),
         "host": rec.get("cause_host"),
     }
+    if rec.get("cause_slice") is not None:
+        cause["slice"] = rec["cause_slice"]
     if rec.get("exit_code") is not None:
         cause["exit_code"] = rec["exit_code"]
     if rec.get("stale_age_s") is not None:
@@ -536,6 +547,13 @@ def _cause_of(rec: dict, worker_events: List[dict]) -> dict:
                            "replica_divergence", "internal_error"):
             seam = e["type"]
             t_seam = t_fail
+    # A preemption is driver-originated: the host_preempt event (the
+    # SIGTERM storm) is the seam and the failure instant — the dying
+    # workers' own last journal lines are ordinary commits.
+    if rec.get("t_preempt") is not None:
+        seam = "host.preempt:preempt"
+        t_seam = float(rec["t_preempt"])
+        t_fail = t_seam
     # A seam only explains the failure if it was (nearly) the rank's
     # last act — a fault fired minutes before a natural death is
     # coincidence, not cause.
@@ -559,24 +577,44 @@ def build_incidents(events: List[dict]) -> Tuple[List[dict],
     recoveries: List[dict] = []
     epochs: List[dict] = []
     cur: Optional[dict] = None
+    # host -> time of the driver's last SIGTERM storm against it (the
+    # host.preempt seam); a following preempt-caused detect of that
+    # host anchors its failure instant here.
+    last_preempt: Dict[str, float] = {}
     for e in driver:
         t = float(e["t"])
         ty = e["type"]
-        if ty == "detect":
+        if ty == "host_preempt":
+            if e.get("host") is not None:
+                last_preempt[str(e["host"])] = t
+        elif ty == "detect":
             if cur is None or cur.get("t_respawn") is not None:
                 cur = {"t_detect": t,
                        "cause_kind": str(e.get("cause", "crash")),
                        "cause_rank": e.get("exit_rank"),
                        "cause_host": e.get("host"),
+                       "cause_slice": e.get("slice"),
                        "exit_code": e.get("code"),
                        "stale_age_s": e.get("age_s"),
                        "reset": e.get("reset"),
                        "triggers": []}
+                if (cur["cause_kind"] == "preempt"
+                        and e.get("host") in last_preempt):
+                    cur["t_preempt"] = last_preempt[e["host"]]
                 recoveries.append(cur)
-            cur["triggers"].append(
-                {"t": _rel(t, t0), "rank": e.get("exit_rank"),
-                 "host": e.get("host"), "cause": e.get("cause"),
-                 "code": e.get("code")})
+            trig = {"t": _rel(t, t0), "rank": e.get("exit_rank"),
+                    "host": e.get("host"), "cause": e.get("cause"),
+                    "code": e.get("code")}
+            if e.get("slice") is not None:
+                trig["slice"] = e["slice"]
+            cur["triggers"].append(trig)
+        elif ty == "slice_lost" and cur is not None:
+            cur.setdefault("slices_lost", []).append(
+                {"slice": e.get("slice"),
+                 "hosts": e.get("hosts"),
+                 "cause": e.get("cause"),
+                 "window_s": e.get("window_s"),
+                 "failures": e.get("failures")})
         elif ty == "gang_restart_begin" and cur is not None:
             cur.setdefault("t_restart", t)
         elif ty == "teardown_done" and cur is not None:
@@ -586,24 +624,29 @@ def build_incidents(events: List[dict]) -> Tuple[List[dict],
             in_recovery = (cur is not None
                            and cur.get("t_epoch") is None
                            and cur.get("t_teardown") is not None)
-            epochs.append({
+            entry = {
                 "epoch": epoch,
                 "t": _rel(t, t0),
                 "size": e.get("size"),
                 "hosts": e.get("hosts"),
                 "kind": ("recovery" if in_recovery
                          else ("start" if not epochs else "resize")),
-            })
+            }
+            if e.get("slices") is not None:
+                entry["slices"] = e["slices"]
+            epochs.append(entry)
             if in_recovery:
                 cur["t_epoch"] = t
                 cur["epoch"] = epoch
         elif ty == "respawn_done" and cur is not None:
             cur.setdefault("t_respawn", t)
         elif ty == "blacklist" and cur is not None:
-            cur.setdefault("blacklisted", []).append(
-                {"host": e.get("host"),
-                 "window_s": e.get("window_s"),
-                 "failures": e.get("failures")})
+            entry = {"host": e.get("host"),
+                     "window_s": e.get("window_s"),
+                     "failures": e.get("failures")}
+            if e.get("slice") is not None:
+                entry["slice"] = e["slice"]
+            cur.setdefault("blacklisted", []).append(entry)
         elif ty == "postmortem" and cur is not None:
             cur.setdefault("postmortems", []).append(
                 {"rank": e.get("exit_rank", e.get("rank")),
@@ -675,7 +718,7 @@ def build_incidents(events: List[dict]) -> Tuple[List[dict],
             "restore": _phase(rec.get("t_respawn"), t_restore_end),
             "first_commit": _phase(t_restore_end, t_first_commit),
         }
-        out.append({
+        entry = {
             "index": i,
             "cause": cause,
             "reset": rec.get("reset"),
@@ -694,7 +737,12 @@ def build_incidents(events: List[dict]) -> Tuple[List[dict],
             "blacklisted": rec.get("blacklisted", []),
             "postmortems": rec.get("postmortems", []),
             "triggers": rec["triggers"],
-        })
+        }
+        # Multi-slice attribution rides along only when the driver
+        # journaled it (single-slice reports keep their r11 shape).
+        if rec.get("slices_lost"):
+            entry["slices_lost"] = rec["slices_lost"]
+        out.append(entry)
     return out, epochs
 
 
@@ -709,6 +757,7 @@ def _timeline_entries(events: List[dict], t0: float) -> List[list]:
         "first_commit", "numerics_escalation", "replica_divergence",
         "init_done", "job_done", "hosts_updated", "assignment",
         "postmortem_written", "task_exit",
+        "slice_lost", "slice_admitted", "host_preempt",
     }
     out = []
     for e in events:
@@ -744,6 +793,14 @@ def incident_report(dir_: str) -> Dict[str, Any]:
     for r in recoveries:
         k = r["cause"]["kind"]
         by_cause[k] = by_cause.get(k, 0) + 1
+    # Slice attribution appears only when some recovery carries it —
+    # a single-slice job's report keeps its historical key set.
+    by_slice: Dict[str, int] = {}
+    for r in recoveries:
+        for sl in r.get("slices_lost", []):
+            sid = str(sl.get("slice"))
+            by_slice[sid] = by_slice.get(sid, 0) + 1
+    summary_extra = ({"by_slice": by_slice} if by_slice else {})
     return {
         "schema": REPORT_SCHEMA,
         "source": {
@@ -769,6 +826,7 @@ def incident_report(dir_: str) -> Dict[str, Any]:
             "total_downtime_s": (round(sum(mttrs), 6) if mttrs
                                  else None),
             "max_mttr_s": (max(mttrs) if mttrs else None),
+            **summary_extra,
         },
         "timeline": _timeline_entries(events, t0),
     }
@@ -800,12 +858,19 @@ def render_incident_report(report: Dict[str, Any]) -> str:
         c = r["cause"]
         head = (f"\n#{r['index']} {c['kind']} on {c['host']} "
                 f"(rank {c['rank']}"
+                + (f", slice {c['slice']}" if c.get("slice") else "")
                 + (f", exit {c['exit_code']}"
                    if c.get("exit_code") is not None else "")
                 + (f", seam {c['seam']}" if c.get("seam") else "")
                 + f") -> epoch {r['epoch']}  "
                   f"MTTR {r['mttr_s']} s")
         lines.append(head)
+        for sl in r.get("slices_lost", []):
+            lines.append(
+                f"    slice lost: {sl['slice']} "
+                f"({','.join(sl.get('hosts') or [])}) "
+                f"cause {sl['cause']} -> blacklisted "
+                f"{sl['window_s']} s (failure {sl['failures']})")
         for ph in ("detect", "teardown", "rendezvous", "respawn",
                    "restore", "first_commit"):
             v = r["phases"][ph]

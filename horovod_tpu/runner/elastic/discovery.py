@@ -43,9 +43,39 @@ class FixedHosts(HostDiscovery):
         return list(self._hosts)
 
 
+def parse_discovery_line(line: str) -> HostSlots:
+    """Parse one discovery-script stdout line.
+
+    Grammar: "host[:slots] [slice=<id>]". The slice column is
+    optional; without it the host belongs to the job's single
+    implicit slice (today's contract, unchanged). Unknown key=value
+    attributes fail loudly — a typo'd column must not silently
+    degrade a multi-slice pod to per-host membership."""
+    fields = line.split()
+    spec, attrs = fields[0], fields[1:]
+    slice_id = None
+    for attr in attrs:
+        k, sep, v = attr.partition("=")
+        if k == "slice" and sep:
+            if not v:
+                raise ValueError(
+                    f"bad discovery line {line!r}: empty slice id")
+            slice_id = v
+        else:
+            raise ValueError(
+                f"bad discovery line {line!r}: unknown attribute "
+                f"{attr!r} (expected slice=<id>)")
+    if ":" in spec:
+        h, s = spec.rsplit(":", 1)
+        return HostSlots(h.strip(), int(s), slice_id)
+    return HostSlots(spec, 1, slice_id)
+
+
 class HostDiscoveryScript(HostDiscovery):
-    """Runs the user script; its stdout lines are "host:slots"
-    (reference: HostDiscoveryScript; same output contract)."""
+    """Runs the user script; its stdout lines are "host:slots" with an
+    optional "slice=<id>" column (reference: HostDiscoveryScript; the
+    base contract is identical, the slice column is the multi-slice
+    extension)."""
 
     def __init__(self, script: str, timeout: float = 30.0):
         self.script = script
@@ -64,11 +94,7 @@ class HostDiscoveryScript(HostDiscovery):
             line = line.strip()
             if not line or line.startswith("#"):
                 continue
-            if ":" in line:
-                h, s = line.rsplit(":", 1)
-                out.append(HostSlots(h.strip(), int(s)))
-            else:
-                out.append(HostSlots(line, 1))
+            out.append(parse_discovery_line(line))
         return out
 
 
@@ -110,5 +136,11 @@ class ResilientDiscovery(HostDiscovery):
         return hosts
 
 
-def hosts_key(hosts: List[HostSlots]) -> Dict[str, int]:
-    return {h.host: h.slots for h in hosts}
+def hosts_key(hosts: List[HostSlots]) -> Dict[str, object]:
+    """Membership-change detection key. Slice-less hosts keep the
+    legacy host->slots shape; a host with a slice id keys as
+    (slots, slice) so a host migrating between slices registers as a
+    membership change even when its slot count doesn't."""
+    return {h.host: (h.slots if h.slice_id is None
+                     else (h.slots, h.slice_id))
+            for h in hosts}

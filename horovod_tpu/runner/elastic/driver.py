@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Tuple
 
+from ... import faults as _faults
 from ... import journal as _journal
 from ...common import config as _config
 from ...common import logging as hlog
@@ -44,6 +45,7 @@ from ..launch import (_prefix_pump, _ssh_command,
 from ..service import BasicClient
 from .discovery import HostDiscovery, ResilientDiscovery, hosts_key
 from .rendezvous import RendezvousServer
+from .slices import SliceTracker
 
 import os
 
@@ -55,6 +57,18 @@ _m_hung = _METRICS.counter(
     "hvd_elastic_hung_workers_total",
     "Workers killed by the liveness detector after their rendezvous "
     "heartbeat went stale (hung-but-alive, recovered like a crash).")
+_m_slices = _METRICS.gauge(
+    "hvd_elastic_slices",
+    "Slices currently admitted to the membership (a slice-less job "
+    "counts as one implicit slice).")
+_m_rump_hosts = _METRICS.gauge(
+    "hvd_elastic_rump_hosts",
+    "Hosts parked because their slice is incomplete (a rump slice is "
+    "never assigned ranks; it waits for its missing members).")
+_m_slice_evictions = _METRICS.counter(
+    "hvd_elastic_slice_evictions_total",
+    "Whole-slice blacklist evictions, by failure cause (any member-"
+    "host failure evicts the entire slice).", ("cause",))
 
 
 class _Slot:
@@ -116,6 +130,22 @@ class ElasticDriver:
         # heartbeat, which the same knob switches on worker-side).
         self.heartbeat_timeout = _config.env_value(
             "HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT", env=_env)
+        # Slice-atomic membership: discovery may tag hosts with a
+        # slice id; any member failure then evicts the whole slice
+        # and rump (incomplete) slices are parked, never ranked.
+        self.slices = SliceTracker(
+            atomic=_config.env_value(
+                "HOROVOD_ELASTIC_SLICE_ATOMIC", env=_env),
+            forget_seconds=_config.env_value(
+                "HOROVOD_ELASTIC_SLICE_FORGET_SECONDS", env=_env))
+        self._slice_failures: Dict[str, int] = {}
+        # host.preempt fault seam: SIGTERM-stormed slots awaiting the
+        # grace SIGKILL (spot VMs power off after the eviction
+        # notice; XLA's preemption notifier catches SIGTERM without
+        # exiting, so the kill models the poweroff).
+        self.preempt_grace = _config.env_value(
+            "HOROVOD_ELASTIC_PREEMPT_GRACE", env=_env)
+        self._preempt_pending: Dict[Tuple[str, int], float] = {}
         # Removed-slot drain: (host, local_rank) -> (_Slot, deadline).
         self._draining: Dict[Tuple[str, int], Tuple[_Slot, float]] = {}
         self.drain_grace = _config.env_value(
@@ -150,14 +180,80 @@ class ElasticDriver:
         now = time.time()
         _m_blacklisted.set(
             sum(1 for t in self.blacklist.values() if t >= now))
+        # Expected slice membership learns from the RAW poll: a
+        # blacklisted member still counts toward its slice, so the
+        # survivors stay a rump (parked) instead of re-admitting as a
+        # "complete" smaller slice.
+        self.slices.observe(hosts)
         live = [h for h in hosts
                 if self.blacklist.get(h.host, 0) < now]
-        return live
+        admitted, rumps, newly = self.slices.admit(live, now)
+        admitted = self._cap_whole_slices(admitted)
+        _m_slices.set(len({h.slice_id for h in admitted})
+                      if admitted else 0)
+        _m_rump_hosts.set(len(rumps))
+        for sid in sorted(newly):
+            members = sorted(h.host for h in admitted
+                             if h.slice_id == sid)
+            _journal.record("slice_admitted", slice=sid,
+                            hosts=members,
+                            slots=sum(h.slots for h in admitted
+                                      if h.slice_id == sid))
+            hlog.info("elastic: slice %s admitted (%s)", sid,
+                      ",".join(members))
+        if rumps:
+            hlog.debug("elastic: parking rump hosts %s",
+                       sorted(h.host for h in rumps))
+        return admitted
+
+    def _cap_whole_slices(self, hosts: List[HostSlots]
+                          ) -> List[HostSlots]:
+        """max_np must not cut a slice in half: when slices are in
+        play, a slice that doesn't wholly fit under the cap is parked
+        (scale-up in whole-slice units only). Slice-less host lists
+        keep the legacy behavior (assign_ranks truncates at np)."""
+        if not self.max_np or all(h.slice_id is None for h in hosts):
+            return hosts
+        out: List[HostSlots] = []
+        remaining = self.max_np
+        seen: List[Optional[str]] = []
+        for sid in (h.slice_id for h in hosts):
+            if sid not in seen:
+                seen.append(sid)
+        for sid in seen:
+            group = [h for h in hosts if h.slice_id == sid]
+            gsize = sum(h.slots for h in group)
+            if sid is None:
+                # The implicit slice is not atomic; it absorbs
+                # whatever capacity is left, host by host.
+                for h in group:
+                    if remaining <= 0:
+                        break
+                    take = min(h.slots, remaining)
+                    out.append(h if take == h.slots
+                               else HostSlots(h.host, take))
+                    remaining -= take
+            elif gsize <= remaining:
+                out.extend(group)
+                remaining -= gsize
+            else:
+                hlog.info(
+                    "elastic: slice %s (%d slots) does not fit under "
+                    "max_np=%d; parked", sid, gsize, self.max_np)
+        return out
 
     def _blacklist_window_for(self, host: str) -> float:
         """Current window for `host` given its failure count so far
         (exponential per repeated failure, capped)."""
         n = self._host_failures.get(host, 0)
+        return min(self.blacklist_window * (2 ** max(0, n - 1)),
+                   self.blacklist_window_max)
+
+    def _slice_window_for(self, slice_id: str) -> float:
+        """Blacklist window for a whole slice, keyed by slice id: the
+        escalation survives the failing host changing between
+        incidents (the slice is the flapping unit, not the host)."""
+        n = self._slice_failures.get(slice_id, 0)
         return min(self.blacklist_window * (2 ** max(0, n - 1)),
                    self.blacklist_window_max)
 
@@ -319,9 +415,19 @@ class ElasticDriver:
         self._clean_since = None
         infos, table = self._assignments(hosts)
         self.rendezvous.publish(self.epoch, table)
+        # The slices field appears only for multi-slice worlds so a
+        # single-slice job's journal keeps its historical shape.
+        slice_ranks: Dict[str, List[int]] = {}
+        for i in infos:
+            if i.slice_id is not None:
+                slice_ranks.setdefault(i.slice_id, []).append(i.rank)
+        extra = ({"slices": {s: [min(r), max(r)]
+                             for s, r in slice_ranks.items()}}
+                 if slice_ranks else {})
         _journal.record("epoch_published", epoch=self.epoch,
                         size=len(infos),
-                        hosts={str(i.rank): i.host for i in infos})
+                        hosts={str(i.rank): i.host for i in infos},
+                        **extra)
         t = self._recovery_marks.pop("teardown_done", None)
         if t is not None:
             _journal.observe_phase("rendezvous", time.monotonic() - t)
@@ -492,7 +598,134 @@ class ElasticDriver:
                 self.rendezvous.clear_heartbeat(key)
                 slot.proc.kill()
 
-    def _monitor(self, current: Dict[str, int]) -> int:
+    def _blacklist_failed(self, bad_causes: Dict[str, str]) -> None:
+        """Blacklist the failed hosts — slice-atomically when the
+        host belongs to a slice (ANY member failure evicts the whole
+        slice: its survivors cannot form a working ICI mesh, and
+        letting them rejoin as a rump would wedge the next world).
+        Never blacklists below min_np capacity (a single-host job
+        must restart on the same host, not starve out the window).
+        The window escalates exponentially per repeated failure of
+        the same unit — slice id for sliced hosts, hostname otherwise
+        — capped, so a flapping unit cannot rejoin-and-kill on a
+        fixed cadence forever."""
+        handled_slices: set = set()
+        for host in sorted(bad_causes):
+            cause = bad_causes[host]
+            sid = (self.slices.slice_of(host)
+                   if self.slices.atomic else None)
+            if sid is not None:
+                if sid in handled_slices:
+                    continue
+                handled_slices.add(sid)
+                members = sorted(self.slices.members(sid) | {host})
+                self._slice_failures[sid] = \
+                    self._slice_failures.get(sid, 0) + 1
+                failures = self._slice_failures[sid]
+                window = self._slice_window_for(sid)
+            else:
+                members = [host]
+                self._host_failures[host] = \
+                    self._host_failures.get(host, 0) + 1
+                failures = self._host_failures[host]
+                window = self._blacklist_window_for(host)
+            proposed = dict(self.blacklist)
+            for m in members:
+                proposed[m] = time.time() + window
+            try:
+                avail = (self.discovery
+                         .find_available_hosts_and_slots())
+            except Exception as e:
+                hlog.warning(
+                    "elastic: discovery failed during "
+                    "failure handling: %s", e)
+                avail = []
+            remaining = [
+                h for h in avail
+                if proposed.get(h.host, 0) < time.time()]
+            if self._world_np(remaining) >= self.min_np:
+                self.blacklist = proposed
+                if sid is not None:
+                    _m_slice_evictions.labels(cause=cause).inc()
+                    _journal.record(
+                        "slice_lost", slice=sid, hosts=members,
+                        cause=cause, window_s=round(window, 1),
+                        failures=failures)
+                    hlog.warning(
+                        "elastic: slice %s lost (%s); blacklisting "
+                        "all %d member hosts for %.0fs (failure %d "
+                        "of this slice)", sid, cause, len(members),
+                        window, failures)
+                for m in members:
+                    extra = ({"slice": sid}
+                             if sid is not None else {})
+                    _journal.record(
+                        "blacklist", host=m,
+                        window_s=round(window, 1),
+                        failures=failures, **extra)
+                    if sid is None:
+                        hlog.warning(
+                            "elastic: blacklisting %s for %.0fs "
+                            "(failure %d of this host)", m,
+                            window, failures)
+            else:
+                hlog.info(
+                    "elastic: not blacklisting %s (would "
+                    "drop below min_np)",
+                    sid if sid is not None else host)
+
+    def _check_preempt_faults(self) -> None:
+        """host.preempt fault seam: one fire() per live host per
+        monitor tick (sorted order, so `host=` targeting is
+        deterministic under a fixed HOROVOD_FAULTS_SEED). The armed
+        action "preempt" SIGTERM-storms every worker of that host —
+        the spot-eviction signal shape — then the reaper SIGKILLs
+        whatever survives the preemption grace, modeling the VM
+        poweroff that follows the eviction notice."""
+        live_hosts = sorted({k[0] for k, s in self.slots.items()
+                             if s.proc.poll() is None})
+        for host in live_hosts:
+            act = _faults.fire("host.preempt", tag=host)
+            if act == "preempt":
+                self._preempt_host(host)
+
+    def _preempt_host(self, host: str) -> None:
+        keys = sorted(k for k, s in self.slots.items()
+                      if k[0] == host and s.proc.poll() is None)
+        if not keys:
+            return
+        sid = self.slices.slice_of(host)
+        extra = {"slice": sid} if sid is not None else {}
+        _journal.record(
+            "host_preempt", host=host,
+            ranks=[self.slots[k].info.rank for k in keys],
+            grace_s=self.preempt_grace, **extra)
+        hlog.warning(
+            "elastic: preempting host %s (SIGTERM storm to %d "
+            "worker(s), SIGKILL after %.1fs grace)", host,
+            len(keys), self.preempt_grace)
+        deadline = time.time() + self.preempt_grace
+        for k in keys:
+            self._preempt_pending[k] = deadline
+            self.slots[k].proc.terminate()
+
+    def _reap_preempted(self) -> None:
+        """SIGKILL preempted workers that outlived the grace (XLA's
+        preemption notifier catches SIGTERM without exiting; the real
+        spot VM powers off regardless)."""
+        now = time.time()
+        for key in list(self._preempt_pending):
+            slot = self.slots.get(key)
+            if slot is None:
+                # Gang restart already recycled the slot; a stale
+                # entry must not mis-attribute a future failure of
+                # the same (host, local_rank) as a preemption.
+                del self._preempt_pending[key]
+            elif slot.proc.poll() is None and \
+                    now > self._preempt_pending[key]:
+                slot.proc.kill()
+
+    def _monitor(self, current: Dict[str, object]) -> int:
         last_poll = 0.0
         while True:
             time.sleep(0.1)
@@ -500,6 +733,9 @@ class ElasticDriver:
                 self._reap_draining()
             if self.heartbeat_timeout > 0:
                 self._check_hung_workers()
+            self._check_preempt_faults()
+            if self._preempt_pending:
+                self._reap_preempted()
 
             # 1) process exits
             exited = {k: s for k, s in self.slots.items()
@@ -564,10 +800,19 @@ class ElasticDriver:
                     # "hung" when the liveness detector shot it and
                     # "crash" otherwise. For hung workers the stale
                     # age IS the runtime detect latency.
+                    bad_causes: Dict[str, str] = {}
                     for k in sorted(bad):
                         slot = exited.get(k) or self.slots.get(k)
                         age = self._hung_pending.pop(k, None)
-                        cause = "crash" if age is None else "hung"
+                        if self._preempt_pending.pop(k, None) \
+                                is not None:
+                            cause = "preempt"
+                        else:
+                            cause = "crash" if age is None else "hung"
+                        bad_causes.setdefault(k[0], cause)
+                        sid = self.slices.slice_of(k[0])
+                        extra = ({"slice": sid}
+                                 if sid is not None else {})
                         _journal.record(
                             "detect", cause=cause,
                             exit_rank=(slot.info.rank if slot
@@ -575,7 +820,7 @@ class ElasticDriver:
                             host=k[0], code=bad[k],
                             age_s=(round(age, 3)
                                    if age is not None else None),
-                            reset=self.resets)
+                            reset=self.resets, **extra)
                         _journal.count_recovery(cause)
                         if age is not None:
                             _journal.observe_phase("detect", age)
@@ -591,44 +836,7 @@ class ElasticDriver:
                     # the dead workers' last evidence of what they
                     # were waiting on.
                     self._collect_postmortems(bad)
-                    # Blacklist failing hosts — but never below
-                    # min_np capacity (a single-host job must restart
-                    # on the same host, not starve out the window).
-                    # The window escalates exponentially per repeated
-                    # failure of the same host (capped), so a
-                    # flapping host cannot rejoin-and-kill on a fixed
-                    # cadence forever.
-                    for host in {k[0] for k in bad}:
-                        self._host_failures[host] = \
-                            self._host_failures.get(host, 0) + 1
-                        window = self._blacklist_window_for(host)
-                        proposed = dict(self.blacklist)
-                        proposed[host] = time.time() + window
-                        try:
-                            avail = (self.discovery
-                                     .find_available_hosts_and_slots())
-                        except Exception as e:
-                            hlog.warning(
-                                "elastic: discovery failed during "
-                                "failure handling: %s", e)
-                            avail = []
-                        remaining = [
-                            h for h in avail
-                            if proposed.get(h.host, 0) < time.time()]
-                        if self._world_np(remaining) >= self.min_np:
-                            self.blacklist = proposed
-                            _journal.record(
-                                "blacklist", host=host,
-                                window_s=round(window, 1),
-                                failures=self._host_failures[host])
-                            hlog.warning(
-                                "elastic: blacklisting %s for %.0fs "
-                                "(failure %d of this host)", host,
-                                window, self._host_failures[host])
-                        else:
-                            hlog.info(
-                                "elastic: not blacklisting %s (would "
-                                "drop below min_np)", host)
+                    self._blacklist_failed(bad_causes)
                     self._gang_restart()
                     try:
                         current = hosts_key(self._discover())
@@ -680,6 +888,10 @@ class ElasticDriver:
             if slot.proc.poll() is None:
                 slot.proc.kill()
         self.slots.clear()
+        # (host, local_rank) keys are reused by the next incarnation:
+        # stale pending entries would mis-attribute its failures.
+        self._preempt_pending.clear()
+        self._hung_pending.clear()
         _journal.record("teardown_done", reset=self.resets)
         if t_detect is not None:
             _journal.observe_phase("teardown",

@@ -1,0 +1,129 @@
+"""Slice-atomic admission for multi-slice elastic membership.
+
+A TPU slice is gang-scheduled: its hosts share one ICI mesh and the
+libtpu runtime cannot start with a subset of them. The membership
+layer therefore has to treat the slice, not the host, as the unit of
+admission — a 4-host slice that lost one host is a *rump* and must be
+parked (never assigned ranks) until the missing host returns, and
+scale-up is admitted only in whole-slice units.
+
+`SliceTracker` learns each slice's expected membership from discovery
+output (the peak host->slots set ever observed for that slice id) and
+partitions every live host list into admitted hosts — ordered
+slice-major so rank assignment keeps each slice's ranks contiguous —
+and parked rump hosts. Hosts without a slice id form the job's single
+implicit slice and are always admitted, preserving the single-slice
+contract byte for byte.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from ...common import logging as hlog
+from ..hosts import HostSlots
+
+
+class SliceTracker:
+    """Tracks expected slice membership and admits whole slices.
+
+    ``observe()`` is fed the *raw* discovery output (pre-blacklist) so
+    a blacklisted member still counts toward its slice's expected
+    membership; ``admit()`` is fed the blacklist-filtered list and
+    decides who may hold ranks right now.
+    """
+
+    def __init__(self, atomic: bool = True,
+                 forget_seconds: float = 0.0):
+        self.atomic = atomic
+        self.forget_seconds = float(forget_seconds)
+        # slice id -> expected host -> expected slots (peak observed)
+        self._expected: Dict[str, Dict[str, int]] = {}
+        self._host_slice: Dict[str, str] = {}
+        # slice id -> time it first went rump (for the forget window)
+        self._rump_since: Dict[str, float] = {}
+        # slices admitted by the last admit() call
+        self.admitted: Set[str] = set()
+
+    # -- expected membership -------------------------------------------
+
+    def observe(self, hosts: List[HostSlots]) -> None:
+        for h in hosts:
+            if h.slice_id is None:
+                continue
+            prev = self._host_slice.get(h.host)
+            if prev is not None and prev != h.slice_id:
+                # Operator re-homed the host; it no longer counts
+                # toward its old slice's expected membership.
+                self._expected.get(prev, {}).pop(h.host, None)
+            exp = self._expected.setdefault(h.slice_id, {})
+            exp[h.host] = max(exp.get(h.host, 0), h.slots)
+            self._host_slice[h.host] = h.slice_id
+
+    def slice_of(self, host: str) -> Optional[str]:
+        return self._host_slice.get(host)
+
+    def members(self, slice_id: str) -> Set[str]:
+        return set(self._expected.get(slice_id, ()))
+
+    # -- admission -----------------------------------------------------
+
+    def _complete(self, slice_id: str,
+                  live: Dict[str, int]) -> bool:
+        exp = self._expected.get(slice_id, {})
+        return all(live.get(host, 0) >= slots
+                   for host, slots in exp.items())
+
+    def admit(self, hosts: List[HostSlots],
+              now: float) -> Tuple[List[HostSlots], List[HostSlots],
+                                   Set[str]]:
+        """Partition a live host list into (admitted, rumps).
+
+        Returns ``(admitted, rump_hosts, newly_admitted_slice_ids)``.
+        ``admitted`` is ordered slice-major, groups in first-appearance
+        order of the input list with each group's hosts in input
+        order, so ``assign_ranks`` gives every slice a contiguous rank
+        interval.  Slice-less hosts form one implicit always-admitted
+        group.  With ``atomic`` off every slice admits as-is (grouping
+        and ordering are kept; only the rump parking is disabled).
+        """
+        groups: Dict[Optional[str], List[HostSlots]] = {}
+        order: List[Optional[str]] = []
+        for h in hosts:
+            if h.slice_id not in groups:
+                groups[h.slice_id] = []
+                order.append(h.slice_id)
+            groups[h.slice_id].append(h)
+
+        admitted: List[HostSlots] = []
+        rumps: List[HostSlots] = []
+        admitted_ids: Set[str] = set()
+        for sid in order:
+            group = groups[sid]
+            if sid is None:
+                admitted.extend(group)
+                continue
+            live = {h.host: h.slots for h in group}
+            ok = (not self.atomic) or self._complete(sid, live)
+            if not ok and self.forget_seconds > 0:
+                since = self._rump_since.setdefault(sid, now)
+                if now - since >= self.forget_seconds:
+                    # The missing members have been gone long enough
+                    # that this is a reconfiguration, not an outage:
+                    # re-baseline expectations to current membership.
+                    hlog.warning(
+                        "elastic: slice %s rump for %.0fs >= forget "
+                        "window; re-baselining expected membership "
+                        "to %s", sid, now - since, sorted(live))
+                    self._expected[sid] = dict(live)
+                    ok = True
+            if ok:
+                self._rump_since.pop(sid, None)
+                admitted.extend(group)
+                admitted_ids.add(sid)
+            else:
+                self._rump_since.setdefault(sid, now)
+                rumps.extend(group)
+        newly = admitted_ids - self.admitted
+        self.admitted = admitted_ids
+        return admitted, rumps, newly

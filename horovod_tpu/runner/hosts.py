@@ -11,17 +11,27 @@ from __future__ import annotations
 import dataclasses
 from typing import List, Optional, Tuple
 
-LOCALHOSTS = ("localhost", "127.0.0.1", "::1")
+LOCALHOSTS = ("localhost", "::1")
+
+
+def _is_local_host(host: str) -> bool:
+    # Any 127.0.0.0/8 address is loopback by spec; treating the whole
+    # block as local lets a single machine stand in for several
+    # "hosts" (127.0.0.2, 127.0.0.3, ...) in multi-slice soaks.
+    return host in LOCALHOSTS or host.startswith("127.")
 
 
 @dataclasses.dataclass(frozen=True)
 class HostSlots:
     host: str
     slots: int
+    # TPU slice the host belongs to. None = the job's single implicit
+    # slice (today's contract, byte-for-byte).
+    slice_id: Optional[str] = None
 
     @property
     def is_local(self) -> bool:
-        return self.host in LOCALHOSTS
+        return _is_local_host(self.host)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -33,13 +43,14 @@ class RankInfo:
     cross_rank: int
     cross_size: int
     host: str
+    slice_id: Optional[str] = None
 
     @property
     def is_local(self) -> bool:
-        return self.host in LOCALHOSTS
+        return _is_local_host(self.host)
 
     def env(self) -> dict:
-        return {
+        env = {
             "HOROVOD_RANK": str(self.rank),
             "HOROVOD_SIZE": str(self.size),
             "HOROVOD_LOCAL_RANK": str(self.local_rank),
@@ -47,6 +58,11 @@ class RankInfo:
             "HOROVOD_CROSS_RANK": str(self.cross_rank),
             "HOROVOD_CROSS_SIZE": str(self.cross_size),
         }
+        # Only multi-slice jobs see the extra variable: a slice-less
+        # host list publishes exactly the legacy six keys.
+        if self.slice_id is not None:
+            env["HOROVOD_ELASTIC_SLICE_ID"] = self.slice_id
+        return env
 
 
 # Per-chip launch mode (reference contract: one rank per accelerator,
@@ -76,12 +92,15 @@ def per_chip_env(info: RankInfo, all_infos: List["RankInfo"],
     Both TPU_VISIBLE_CHIPS and TPU_VISIBLE_DEVICES are set — libtpu
     versions differ on the name; the unused one is ignored.
 
-    The job's slots are assumed to form ONE slice (the hvdrun -H
-    contract lists the slice's hosts); TPU_PROCESS_ADDRESSES lists
-    every slot host:port in rank order so the per-process TPU runtimes
-    can rendezvous."""
+    The ICI mesh is per slice: TPU_PROCESS_ADDRESSES / the process
+    grid cover only the slots whose host shares this slot's slice, so
+    each slice's TPU runtimes rendezvous among themselves (inter-slice
+    traffic is DCN, coordinated at the JAX level, not libtpu's).
+    When no host carries a slice id the whole job is one implicit
+    slice and the output is identical to the historical flat list."""
     from ..common.config import env_value
-    nproc = len(all_infos)
+    group = [i for i in all_infos if i.slice_id == info.slice_id]
+    nproc = len(group)
     bounds = (process_bounds
               or env_value("HOROVOD_TPU_PROCESS_BOUNDS")
               or _PROCESS_BOUNDS_DEFAULT.get(nproc, f"{nproc},1,1"))
@@ -89,7 +108,12 @@ def per_chip_env(info: RankInfo, all_infos: List["RankInfo"],
              or env_value("HOROVOD_TPU_CHIPS_PER_PROCESS_BOUNDS")
              or "1,1,1")
     addrs = ",".join(f"{i.host}:{port_base + i.local_rank}"
-                     for i in all_infos)
+                     for i in group)
+    # Task ids are slice-relative: each slice's libtpu mesh numbers
+    # its processes 0..n-1 (slice ranks are contiguous, so this is
+    # rank minus the slice's first rank).
+    task_id = next(n for n, i in enumerate(group)
+                   if i.rank == info.rank)
     return {
         "TPU_VISIBLE_CHIPS": str(info.local_rank),
         "TPU_VISIBLE_DEVICES": str(info.local_rank),
@@ -97,12 +121,16 @@ def per_chip_env(info: RankInfo, all_infos: List["RankInfo"],
         "TPU_PROCESS_BOUNDS": bounds,
         "TPU_PROCESS_ADDRESSES": addrs,
         "TPU_PROCESS_PORT": str(port_base + info.local_rank),
-        "CLOUD_TPU_TASK_ID": str(info.rank),
+        "CLOUD_TPU_TASK_ID": str(task_id),
     }
 
 
 def parse_hosts(hosts: Optional[str], np_: int) -> List[HostSlots]:
-    """Parse "-H h1:2,h2:2"; default = all ranks on localhost."""
+    """Parse "-H h1:2,h2:2"; default = all ranks on localhost.
+
+    An optional "@slice" suffix assigns the host to a named TPU slice
+    ("h1:4@pod0,h2:4@pod0,h3:4@pod1"); without it the whole list forms
+    one implicit slice, exactly as before."""
     if not hosts:
         return [HostSlots("localhost", np_)]
     out = []
@@ -110,6 +138,12 @@ def parse_hosts(hosts: Optional[str], np_: int) -> List[HostSlots]:
         part = part.strip()
         if not part:
             continue
+        slice_id = None
+        if "@" in part:
+            part, slice_id = part.rsplit("@", 1)
+            if not slice_id:
+                raise ValueError(
+                    f"bad host spec {part!r}@: empty slice id")
         if ":" in part:
             h, s = part.rsplit(":", 1)
             try:
@@ -121,7 +155,7 @@ def parse_hosts(hosts: Optional[str], np_: int) -> List[HostSlots]:
             h, slots = part, 1
         if slots <= 0:
             raise ValueError(f"bad host spec {part!r}: slots must be > 0")
-        out.append(HostSlots(h, slots))
+        out.append(HostSlots(h, slots, slice_id))
     total = sum(h.slots for h in out)
     if total < np_:
         raise ValueError(
@@ -130,15 +164,19 @@ def parse_hosts(hosts: Optional[str], np_: int) -> List[HostSlots]:
 
 
 def assign_ranks(hostslots: List[HostSlots], np_: int) -> List[RankInfo]:
-    """Host-major rank assignment (reference: gloo_run's host_alloc)."""
-    infos: List[Tuple[str, int, int]] = []  # (host, local_rank, cross)
+    """Host-major rank assignment (reference: gloo_run's host_alloc).
+
+    The input order is preserved, so a slice-major host list yields
+    contiguous ranks per slice (the elastic driver relies on this to
+    keep control-tree subtrees slice-local)."""
+    infos: List[Tuple[HostSlots, int, int]] = []  # (hs, local_rank, cross)
     cross = 0
     for hs in hostslots:
         used = 0
         for lr in range(hs.slots):
             if len(infos) >= np_:
                 break
-            infos.append((hs.host, lr, cross))
+            infos.append((hs, lr, cross))
             used += 1
         if used:
             cross += 1
@@ -146,11 +184,12 @@ def assign_ranks(hostslots: List[HostSlots], np_: int) -> List[RankInfo]:
             break
     cross_size = cross
     local_sizes = {}
-    for host, lr, cr in infos:
+    for hs, lr, cr in infos:
         local_sizes[cr] = max(local_sizes.get(cr, 0), lr + 1)
     return [
         RankInfo(rank=i, size=np_, local_rank=lr,
                  local_size=local_sizes[cr], cross_rank=cr,
-                 cross_size=cross_size, host=host)
-        for i, (host, lr, cr) in enumerate(infos)
+                 cross_size=cross_size, host=hs.host,
+                 slice_id=hs.slice_id)
+        for i, (hs, lr, cr) in enumerate(infos)
     ]

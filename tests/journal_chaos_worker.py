@@ -86,6 +86,12 @@ def main():
 
     @hvd.elastic.run
     def train(state):
+        # (Re)entering the loop — fresh spawn, gang restart, or
+        # resize — republish this rank's position first: a rank that
+        # sat out a partial-world period (whole-slice blacklist)
+        # otherwise leaves a stale pace file every peer would wait on
+        # forever once it rejoins.
+        _publish_step(hvd.rank(), int(state.step))
         while state.step < TOTAL_STEPS:
             _pace_wait(state)
             # one "training step": local-only compute (no cross-
