@@ -92,6 +92,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import horovod_tpu as hvd  # noqa: E402
 from horovod_tpu.models.resnet import create_resnet50, init_resnet  # noqa: E402
 from horovod_tpu.parallel import build_train_step  # noqa: E402
+from horovod_tpu.parallel.aot import aot_compile  # noqa: E402
 from horovod_tpu.parallel.mesh import data_parallel_mesh  # noqa: E402
 
 
@@ -120,27 +121,6 @@ def peak_tflops(device) -> float:
         if kind.startswith(k):
             return v
     return 0.0
-
-
-def aot_compile(step_fn, *args):
-    """AOT-compile the step once and reuse the executable for both the
-    benchmark loop and XLA's cost analysis (compiling separately for
-    cost_analysis would double the multi-ten-second ResNet compile).
-    Returns (callable, flops_per_execution)."""
-    try:
-        compiled = step_fn.lower(*args).compile()
-    except Exception as e:  # pragma: no cover - backend-dependent
-        log(f"bench: AOT compile unavailable ({e}); using jit path")
-        return step_fn, 0.0
-    flops = 0.0
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, list):
-            ca = ca[0]
-        flops = float(ca.get("flops", 0.0))
-    except Exception as e:  # pragma: no cover - backend-dependent
-        log(f"bench: cost analysis unavailable ({e})")
-    return compiled, flops
 
 
 def _metrics_snapshot():
@@ -1694,6 +1674,152 @@ def convergence_compression_main() -> None:
         "unit": "nats", "vs_baseline": 1.0}), flush=True)
 
 
+def serving_main() -> None:
+    """`--serving`: measure the elastic inference frontend
+    (horovod_tpu/serving.py) on this host and write
+    benchmarks/BENCH_serving_r15.json — p50/p99 request latency vs
+    offered QPS, a scale-out curve over pool sizes, an autoscale
+    soak, and the chaos retry accounting (an injected serving.batch
+    worker death mid-run must lose zero requests). The artifact pins
+    the padded-bucket ladder digest so a reader can tie the measured
+    numbers to the exact executable-shape set they were taken
+    against."""
+    from horovod_tpu import faults as hfaults
+    from horovod_tpu import serving as hserving
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.environ.get("BENCH_SERVING_OUT") or os.path.join(
+        here, "benchmarks", "BENCH_serving_r15.json")
+
+    d_model = int(os.environ.get("BENCH_SERVING_DMODEL", "256"))
+    rng = np.random.RandomState(0)
+    w1 = jnp.asarray(rng.randn(d_model, 4 * d_model) * 0.05,
+                     jnp.float32)
+    w2 = jnp.asarray(rng.randn(4 * d_model, d_model) * 0.05,
+                     jnp.float32)
+
+    def forward(x):
+        return jnp.tanh(x @ w1) @ w2
+
+    senv = dict(os.environ)
+    senv.update({
+        "HOROVOD_SERVING_MAX_BATCH": senv.get(
+            "HOROVOD_SERVING_MAX_BATCH", "8"),
+        "HOROVOD_SERVING_LATENCY_BUDGET_MS": senv.get(
+            "HOROVOD_SERVING_LATENCY_BUDGET_MS", "5"),
+        "HOROVOD_SERVING_MAX_WORKERS": "4",
+        "HOROVOD_SERVING_SCALE_INTERVAL_S": "0.05",
+        "HOROVOD_SERVING_WORKER_TIMEOUT_S": "5",
+    })
+
+    def run_leg(n_requests, qps, workers, autoscale=False,
+                fault_spec=None):
+        if fault_spec:
+            hfaults.configure(fault_spec, seed=15)
+        fe = hserving.ServingFrontend(
+            forward, (d_model,), env=senv, start_pool=False,
+            autoscale=autoscale)
+        fe.start_pool(workers)
+        gap = (1.0 / qps) if qps else 0.0
+        futs = []
+        t0 = time.perf_counter()
+        for i in range(n_requests):
+            futs.append(fe.submit(rng.randn(d_model)))
+            if gap:
+                time.sleep(gap)
+        for f in futs:
+            f.result(timeout=60)
+        wall = time.perf_counter() - t0
+        stats = fe.stats()
+        fe.close()
+        if fault_spec:
+            hfaults.configure("", seed=0)
+        lats = sorted(1e3 * (f.t_done - f.t_submit) for f in futs)
+        return {
+            "offered_qps": qps or None,
+            "achieved_qps": round(n_requests / wall, 1),
+            "p50_ms": round(np.percentile(lats, 50), 3),
+            "p99_ms": round(np.percentile(lats, 99), 3),
+            "requests": n_requests,
+            "wall_s": round(wall, 3),
+        }, stats
+
+    # Warm the jit/AOT caches once so leg 1's first batch is not a
+    # compile measurement.
+    _, warm_stats = run_leg(8, 0, 1)
+    ladder = warm_stats["ladder"]
+
+    latency_vs_qps = {}
+    for qps in (50, 100, 200):
+        leg, _ = run_leg(min(2 * qps, 300), qps, 2)
+        latency_vs_qps[f"qps{qps}"] = leg
+        log(f"bench[serving]: qps={qps} p50={leg['p50_ms']}ms "
+            f"p99={leg['p99_ms']}ms")
+
+    scaleout = {}
+    for w in (1, 2, 4):
+        leg, _ = run_leg(256, 0, w)
+        scaleout[f"workers{w}"] = {
+            "achieved_qps": leg["achieved_qps"],
+            "p99_ms": leg["p99_ms"]}
+        log(f"bench[serving]: workers={w} "
+            f"qps={leg['achieved_qps']}")
+
+    auto_leg, auto_stats = run_leg(256, 0, 1, autoscale=True)
+    autoscale = {
+        "achieved_qps": auto_leg["achieved_qps"],
+        "scale_events": auto_stats["scale_events"],
+        "final_workers": auto_stats["workers"],
+    }
+
+    retry_leg, retry_stats = run_leg(
+        64, 200, 2, fault_spec="serving.batch:error:at=3")
+    retry = {
+        "fault_spec": "serving.batch:error:at=3",
+        "completed": retry_stats["completed"],
+        "failed": retry_stats["failed"],
+        "dropped": retry_stats["dropped"],
+        "retries": retry_stats["retries"],
+        "duplicates_suppressed": retry_stats["duplicates_suppressed"],
+    }
+    if retry_stats["dropped"] or retry_stats["retries"] < 1:
+        log("bench[serving]: WARNING retry leg did not behave "
+            f"({retry})")
+
+    doc = {
+        "what": "Elastic inference serving measured on this host "
+                "(horovod_tpu/serving.py): request latency vs "
+                "offered QPS through the dynamic batcher, scale-out "
+                "over pool sizes, an autoscale soak, and the retry "
+                "accounting for an injected mid-batch worker death "
+                "- zero dropped requests is the acceptance bar.",
+        "generated_by": "python bench.py --serving",
+        "model": {"kind": "mlp", "d_model": d_model,
+                  "dtype": "float32"},
+        "ladder": ladder,
+        "config": {
+            "max_batch": int(senv["HOROVOD_SERVING_MAX_BATCH"]),
+            "latency_budget_ms": float(
+                senv["HOROVOD_SERVING_LATENCY_BUDGET_MS"]),
+        },
+        "latency_vs_qps": latency_vs_qps,
+        "scaleout": scaleout,
+        "autoscale": autoscale,
+        "retry": retry,
+        "metrics": _metrics_snapshot(),
+        "journal": _journal_digest(),
+    }
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    log(f"bench[serving]: written to {out_path}")
+    print(json.dumps({
+        "metric": "serving_p99_ms_at_100qps",
+        "value": latency_vs_qps["qps100"]["p99_ms"],
+        "unit": "ms", "vs_baseline": 1.0}), flush=True)
+
+
 def trajectory_main() -> None:
     """`--trajectory`: consolidate the committed per-round artifacts
     into one byte-deterministic BENCH_trajectory.json — the headline
@@ -1781,6 +1907,24 @@ def trajectory_main() -> None:
             "source": "benchmarks/BENCH_compression_ab_r13.json + "
                       "benchmarks/SCALING_projection_r13.json",
         },
+        "r15_serving": {
+            "p99_ms_at_100qps": read(
+                "benchmarks/BENCH_serving_r15.json",
+                "latency_vs_qps", "qps100", "p99_ms"),
+            "scaleout_4worker_qps": read(
+                "benchmarks/BENCH_serving_r15.json",
+                "scaleout", "workers4", "achieved_qps"),
+            "chaos_dropped_requests": read(
+                "benchmarks/BENCH_serving_r15.json",
+                "retry", "dropped"),
+            "chaos_retries": read(
+                "benchmarks/BENCH_serving_r15.json",
+                "retry", "retries"),
+            "ladder_digest": read(
+                "benchmarks/BENCH_serving_r15.json",
+                "ladder", "digest"),
+            "source": "benchmarks/BENCH_serving_r15.json",
+        },
     }
     with open(out_path, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True)
@@ -1788,7 +1932,7 @@ def trajectory_main() -> None:
     log(f"bench[trajectory]: written to {out_path}")
     print(json.dumps({
         "metric": "trajectory_rounds_recorded",
-        "value": len(headline) + 4, "unit": "rounds",
+        "value": len(headline) + 5, "unit": "rounds",
         "vs_baseline": 1.0}), flush=True)
 
 
@@ -2118,6 +2262,8 @@ if __name__ == "__main__":
                  "would be silently ignored)")
     if "--scaling-report" in sys.argv:
         scaling_report_main()
+    elif "--serving" in sys.argv:
+        serving_main()
     elif "--compression-ab" in sys.argv:
         compression_ab_main()
     elif "--convergence-compression" in sys.argv:

@@ -111,6 +111,13 @@ POINTS: Dict[str, frozenset] = {
     # host's workers (spot eviction), then the driver SIGKILLs past
     # the preemption grace (the VM poweroff).
     "host.preempt": frozenset({"preempt", "delay"}),
+    # serving.py worker batch execution, fired once per dispatched
+    # batch with tag=<worker id>: "error" kills the worker mid-batch
+    # (the frontend retries the batch on a survivor), "hang" parks
+    # the worker holding the batch so the heartbeat/deadline detector
+    # must requeue it — the exactly-once path a late completion from
+    # the revenant worker then exercises.
+    "serving.batch": frozenset({"delay", "error", "crash", "hang"}),
 }
 
 ACTIONS = frozenset().union(*POINTS.values())

@@ -101,6 +101,10 @@ CRITICAL_EVENTS = frozenset({
     "numerics_escalation", "replica_divergence", "postmortem",
     "postmortem_written", "blacklist", "job_done",
     "slice_lost", "slice_admitted", "host_preempt",
+    # Serving (round 15): retries and pool resizes are rare,
+    # incident-grade edges (batch_admitted stays batched — it is
+    # per-batch hot-path volume).
+    "batch_retried", "scale_event",
 })
 
 

@@ -38,6 +38,13 @@ LATENCY_BUCKETS = (1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0, 10.0, 100.0)
 BYTES_BUCKETS = (1024.0, 8192.0, 65536.0, 524288.0, 4194304.0,
                  33554432.0, 268435456.0, 2147483648.0)
 COUNT_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
+# Request-serving latencies live in a narrower band than the dispatch
+# ladder above: SLO-relevant edges from sub-millisecond (cache-warm
+# forward on an idle pool) through the ~10 ms admission budget out to
+# multi-second queue-collapse territory, 1-2.5-5 spaced so p50/p99
+# interpolation is stable where serving actually operates.
+SERVING_LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                           0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 # Recovery phases span a sub-second in-process restore to a
 # multi-minute blacklist-then-respawn on a starved pool (journal.py's
 # hvd_recovery_seconds{phase} SLO histograms).

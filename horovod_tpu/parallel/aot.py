@@ -1,0 +1,49 @@
+"""Ahead-of-time compilation of jitted step/forward functions.
+
+Promoted out of bench.py (round 15) so the benchmark harness and the
+serving subsystem (serving.py) share one warmup/AOT path: lower the
+jitted function against exemplar arguments once, compile, and reuse
+the executable — both for the hot loop (no trace/compile on the
+first timed call) and for XLA's cost analysis (compiling a second
+time just to read flops would double a multi-ten-second ResNet
+compile).
+
+The fallback contract matters more than the fast path: on backends
+where ``lower().compile()`` or ``cost_analysis()`` is unavailable,
+the caller gets the original jitted callable back (the jit cache
+then owns compilation) and flops=0.0, never an exception — bench
+prints "unavailable" metrics and serving falls back to per-bucket
+jit warmup, but neither dies.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple
+
+from ..common import logging as hlog
+
+
+def aot_compile(step_fn: Callable[..., Any], *args
+                ) -> Tuple[Callable[..., Any], float]:
+    """AOT-compile ``step_fn`` (a jitted callable) for ``args``.
+
+    Returns ``(callable, flops_per_execution)``. The callable is the
+    compiled executable when lowering succeeds (exact-shape,
+    exact-placement: callers must feed arguments matching ``args``),
+    or ``step_fn`` itself when the backend cannot AOT-compile; flops
+    is 0.0 whenever cost analysis is unavailable.
+    """
+    try:
+        compiled = step_fn.lower(*args).compile()
+    except Exception as e:  # pragma: no cover - backend-dependent
+        hlog.info("aot: AOT compile unavailable (%s); using jit path", e)
+        return step_fn, 0.0
+    flops = 0.0
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        flops = float(ca.get("flops", 0.0))
+    except Exception as e:  # pragma: no cover - backend-dependent
+        hlog.info("aot: cost analysis unavailable (%s)", e)
+    return compiled, flops

@@ -164,6 +164,11 @@ class ElasticDriver:
         self.journal = _journal.configure("driver", env=_env)
         _journal.record("driver_start", command=command,
                         min_np=min_np, max_np=max_np)
+        # Pool-membership listeners (serving.py's elastic worker
+        # pool): called with (epoch, infos) after every epoch
+        # publication, outside any driver lock, exceptions contained
+        # — a misbehaving consumer must not take down membership.
+        self._membership_listeners: List = []
         # Slots killed by the liveness detector: their imminent
         # nonzero exit must be attributed as "hung", not "crash".
         self._hung_pending: Dict[Tuple[str, int], float] = {}
@@ -432,7 +437,19 @@ class ElasticDriver:
         if t is not None:
             _journal.observe_phase("rendezvous", time.monotonic() - t)
             self._recovery_marks["published"] = time.monotonic()
+        for listener in list(self._membership_listeners):
+            try:
+                listener(self.epoch, infos)
+            except Exception as e:  # noqa: BLE001 — contain consumers
+                hlog.warning("elastic: membership listener failed: %s", e)
         return infos, table
+
+    def add_membership_listener(self, fn) -> None:
+        """Register ``fn(epoch, infos)`` to be called after every
+        epoch publication — the hook an elastic serving pool
+        (serving.py) sizes itself from. Listener exceptions are
+        logged and contained."""
+        self._membership_listeners.append(fn)
 
     def _reconcile(self, infos: List[RankInfo], table: Dict) -> None:
         """Start missing slot processes; drain processes whose slot

@@ -1,0 +1,930 @@
+"""Elastic inference serving on the existing control plane.
+
+The north star is a system "serving heavy traffic from millions of
+users", and after the training-side rounds every ingredient a serving
+tier needs already exists in this repo: AOT compilation
+(parallel/aot.py), elastic membership with liveness detection
+(runner/elastic/driver.py), queue/latency gauges (metrics.py), the
+fault grammar (faults.py) and the lifecycle journal (journal.py).
+This module composes them — it adds no new distributed primitive.
+
+Architecture (driver-side `ServingFrontend` + an elastic worker pool):
+
+- **Admission / dynamic batching.** `submit()` enqueues one request;
+  a batcher thread cuts a batch when it reaches
+  HOROVOD_SERVING_MAX_BATCH or when the oldest queued request has
+  waited HOROVOD_SERVING_LATENCY_BUDGET_MS — throughput when traffic
+  is heavy, bounded latency when it is not.
+
+- **Padded-bucket shapes.** Batches are padded to a deterministic
+  power-of-two `BucketLadder` over the batch axis (and, when
+  HOROVOD_SERVING_MAX_LEN > 0, a variable leading sequence axis), so
+  every batch hits one of a small, closed set of executable shapes
+  that workers AOT-compile at warmup: no request shape ever triggers
+  a recompile. Like `OverlapPlan`, the ladder is pinned by a
+  canonical digest every process derives identically.
+
+- **Elastic pool.** Workers are in-process threads (`start_pool`,
+  one per local device round-robin) and/or remote processes pulling
+  batches over the HMAC-signed control-plane wire
+  (`serve_endpoint()` / `remote_worker_loop()` — the same
+  BasicService idiom as the launcher services). The pool autoscales
+  off the queue-depth gauge between HOROVOD_SERVING_MIN_WORKERS and
+  HOROVOD_SERVING_MAX_WORKERS, and `on_membership` plugs directly
+  into `ElasticDriver.add_membership_listener` so elastic membership
+  epochs drive pool size.
+
+- **Exactly-once completion.** A worker that dies mid-batch — the
+  `serving.batch` fault seam, a missed per-batch deadline
+  (HOROVOD_SERVING_WORKER_TIMEOUT_S, the serving-side heartbeat
+  detector), or a real process kill — gets its in-flight batches
+  requeued at the head of the dispatch queue (journal record
+  `batch_retried`). Each request's future carries a completion latch:
+  late results from a revenant worker are suppressed and counted,
+  never double-delivered, and a request is failed (visibly — never
+  silently dropped) only after HOROVOD_SERVING_RETRY_LIMIT
+  re-dispatches.
+
+Observability: the `hvd_serving_*` metric family (request-latency
+histogram on the SERVING_LATENCY_BUCKETS ladder, queue depth, pool
+size, retries, suppressed duplicates, compile count) plus typed
+journal records `batch_admitted` / `batch_retried` / `scale_event`.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import (Any, Callable, Dict, List, NamedTuple, Optional,
+                    Sequence, Tuple)
+
+import numpy as np
+
+from . import faults as _faults
+from . import journal as _journal
+from .common import config as _config
+from .common import logging as hlog
+from .metrics import (COUNT_BUCKETS, REGISTRY as _METRICS,
+                      SERVING_LATENCY_BUCKETS)
+from .parallel.aot import aot_compile
+
+LADDER_SCHEMA = "serving-ladder-v1"
+
+_m_requests = _METRICS.counter(
+    "hvd_serving_requests_total",
+    "Serving requests by terminal outcome (ok / failed). Zero "
+    "dropped requests means submitted == ok + failed at close.",
+    ("outcome",))
+_m_batches = _METRICS.counter(
+    "hvd_serving_batches_total",
+    "Dynamic batches admitted, by padded batch-bucket size.",
+    ("bucket",))
+_m_retries = _METRICS.counter(
+    "hvd_serving_retries_total",
+    "Batches re-dispatched after a worker died mid-batch, by cause.",
+    ("cause",))
+_m_latency = _METRICS.histogram(
+    "hvd_serving_request_latency_seconds",
+    "Submit-to-completion latency per request (queueing + padding + "
+    "executable run + any retries).",
+    buckets=SERVING_LATENCY_BUCKETS)
+_m_batch_size = _METRICS.histogram(
+    "hvd_serving_batch_fill",
+    "Real (unpadded) requests per admitted batch.",
+    buckets=COUNT_BUCKETS)
+_m_queue = _METRICS.gauge(
+    "hvd_serving_queue_depth",
+    "Requests admitted but not yet dispatched to a worker (the "
+    "autoscaler's scale-out signal).")
+_m_workers = _METRICS.gauge(
+    "hvd_serving_workers",
+    "Live members of the serving worker pool.")
+_m_compiles = _METRICS.counter(
+    "hvd_serving_compiles_total",
+    "Executable compilations across the pool — bounded by "
+    "workers x ladder shapes; growth under traffic means a request "
+    "shape escaped the bucket ladder.")
+_m_padding = _METRICS.counter(
+    "hvd_serving_padding_rows_total",
+    "Padding rows executed (bucket size minus real batch fill) — "
+    "the throughput cost of the no-recompile pin.")
+_m_dupes = _METRICS.counter(
+    "hvd_serving_duplicates_suppressed_total",
+    "Late completions from revenant workers rejected by the "
+    "per-request exactly-once latch.")
+
+
+class ServingError(RuntimeError):
+    """A request failed visibly (retry budget exhausted / shutdown)."""
+
+
+class _WorkerDied(RuntimeError):
+    """Internal: the serving.batch seam's 'error' action."""
+
+
+# ---------------------------------------------------------------------------
+# Bucket ladder
+
+
+class BucketLadder(NamedTuple):
+    """Deterministic padded-shape ladder. `digest` is the canonical
+    string every process derives identically from the same knobs —
+    the cross-process pin (same idiom as OverlapPlan's assignment
+    digest): frontends and workers that disagree on it would compile
+    different executable sets, and comparing digests catches that
+    before any batch is dispatched."""
+
+    batch_buckets: Tuple[int, ...]
+    len_buckets: Tuple[int, ...]  # () = fixed-shape requests
+    digest: str
+
+    def batch_bucket(self, n: int) -> int:
+        for b in self.batch_buckets:
+            if b >= n:
+                return b
+        raise ServingError(
+            f"batch of {n} exceeds ladder max {self.batch_buckets[-1]}")
+
+    def len_bucket(self, length: int) -> int:
+        for b in self.len_buckets:
+            if b >= length:
+                return b
+        raise ServingError(
+            f"request length {length} exceeds ladder max "
+            f"{self.len_buckets[-1]}")
+
+    def shapes(self, feature_shape: Sequence[int]
+               ) -> List[Tuple[int, ...]]:
+        """Every padded executable shape the ladder admits."""
+        feats = tuple(feature_shape)
+        if not self.len_buckets:
+            return [(b,) + feats for b in self.batch_buckets]
+        return [(b, l) + feats
+                for b in self.batch_buckets for l in self.len_buckets]
+
+
+def _pow2_ladder(lo: int, hi: int) -> Tuple[int, ...]:
+    rungs = []
+    b = lo
+    while b < hi:
+        rungs.append(b)
+        b *= 2
+    rungs.append(hi)
+    return tuple(rungs)
+
+
+def build_ladder(max_batch: Optional[int] = None,
+                 max_len: Optional[int] = None,
+                 env: Optional[Dict[str, str]] = None) -> BucketLadder:
+    """Build the ladder from the HOROVOD_SERVING_* knobs (or explicit
+    overrides): powers of two up to max_batch on the batch axis, and
+    — when max_len > 0 — powers of two from 16 up to max_len on the
+    variable leading axis."""
+    if max_batch is None:
+        max_batch = _config.env_value("HOROVOD_SERVING_MAX_BATCH",
+                                      env=env)
+    if max_len is None:
+        max_len = _config.env_value("HOROVOD_SERVING_MAX_LEN", env=env)
+    if max_batch < 1:
+        raise ValueError(f"HOROVOD_SERVING_MAX_BATCH must be >= 1, "
+                         f"got {max_batch}")
+    batch = _pow2_ladder(1, max_batch)
+    lens: Tuple[int, ...] = ()
+    if max_len and max_len > 0:
+        lens = ((max_len,) if max_len <= 16
+                else _pow2_ladder(16, max_len))
+    digest = "{}|b={}|l={}".format(
+        LADDER_SCHEMA, ",".join(str(b) for b in batch),
+        ",".join(str(l) for l in lens) or "-")
+    return BucketLadder(batch, lens, digest)
+
+
+# ---------------------------------------------------------------------------
+# Requests and batches
+
+
+class ServingFuture:
+    """One request's handle. `result()` blocks until the request
+    completes (the padded row of the executable's output) or fails
+    with ServingError. The `_finish` latch is the exactly-once
+    guarantee: whichever worker finishes first wins, every later
+    completion is suppressed and counted."""
+
+    def __init__(self, req_id: str, payload: np.ndarray):
+        self.id = req_id
+        self.payload = payload
+        self.t_submit = time.monotonic()
+        self.t_done: Optional[float] = None
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+
+    def _finish(self, value: Any = None,
+                error: Optional[BaseException] = None) -> bool:
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self._value, self._error = value, error
+            self.t_done = time.monotonic()
+            self._event.set()
+            return True
+
+    @property
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"request {self.id} still pending")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class _Batch:
+    __slots__ = ("id", "requests", "bucket_b", "bucket_len",
+                 "attempts", "t_admitted")
+
+    def __init__(self, bid: str, requests: List[ServingFuture],
+                 bucket_b: int, bucket_len: int):
+        self.id = bid
+        self.requests = requests
+        self.bucket_b = bucket_b
+        self.bucket_len = bucket_len
+        self.attempts = 0
+        self.t_admitted = time.monotonic()
+
+    @property
+    def done(self) -> bool:
+        return all(r.done for r in self.requests)
+
+
+class _RemoteMember:
+    """A pool member living in another process, known only through
+    its pulls on the wire; liveness is per-batch (the dispatch
+    deadline), not per-connection."""
+
+    __slots__ = ("wid", "t_joined")
+
+    def __init__(self, wid: str):
+        self.wid = wid
+        self.t_joined = time.monotonic()
+
+
+# ---------------------------------------------------------------------------
+# Local (in-process) worker
+
+
+class _LocalWorker:
+    """One pool member: a thread owning a per-shape executable cache,
+    AOT-compiled at warmup for every ladder shape (pinned against
+    recompiles by `compiles`, which traffic must never grow)."""
+
+    def __init__(self, frontend: "ServingFrontend", wid: str, device):
+        self.frontend = frontend
+        self.wid = wid
+        self.device = device
+        self.compiles = 0
+        self._compiled: Dict[Tuple[int, ...], Callable] = {}
+        self._thread = threading.Thread(
+            target=self._run, name=f"hvd-serving-{wid}", daemon=True)
+        self._thread.start()
+
+    def _get_exec(self, shape: Tuple[int, ...]) -> Callable:
+        import jax
+        import jax.numpy as jnp
+        fn = self._compiled.get(shape)
+        if fn is None:
+            ex = jnp.zeros(shape, self.frontend._dtype.name)
+            if self.device is not None:
+                ex = jax.device_put(ex, self.device)
+            fn, _ = aot_compile(self.frontend._jitted, ex)
+            self._compiled[shape] = fn
+            self.compiles += 1
+            _m_compiles.inc()
+        return fn
+
+    def _run(self) -> None:
+        fe = self.frontend
+        try:
+            for shape in fe.ladder.shapes(fe._feature_shape):
+                self._get_exec(shape)
+        except Exception as e:  # noqa: BLE001 — warmup must not hang pool
+            hlog.error("serving: worker %s warmup failed: %s",
+                       self.wid, e)
+            fe._worker_failed(self.wid, "warmup")
+            return
+        while True:
+            if fe._retired(self.wid):
+                return
+            batch = fe._next_batch(self.wid, timeout=0.05)
+            if batch is None:
+                if fe._closing:
+                    return
+                continue
+            try:
+                act = _faults.fire("serving.batch", exc=_WorkerDied,
+                                   tag=self.wid)
+            except _WorkerDied:
+                # Injected mid-batch death: this member is gone; the
+                # frontend requeues the batch on a survivor.
+                fe._worker_failed(self.wid, "fault_error")
+                return
+            if act == "hang":
+                # Park holding the batch until well past the dispatch
+                # deadline (the watchdog requeues it), then fall
+                # through and attempt completion anyway — the revenant
+                # path the exactly-once latch must absorb.
+                t_end = time.monotonic() + 4 * fe._worker_timeout
+                while time.monotonic() < t_end and not fe._closing:
+                    time.sleep(0.02)
+            try:
+                rows = self._execute(batch)
+            except Exception as e:  # noqa: BLE001
+                hlog.error("serving: worker %s failed batch %s: %s",
+                           self.wid, batch.id, e)
+                fe._worker_failed(self.wid, "execute_error")
+                return
+            fe._complete_batch(batch, rows, self.wid)
+
+    def _execute(self, batch: _Batch) -> List[np.ndarray]:
+        import jax
+        import jax.numpy as jnp
+        fe = self.frontend
+        arr = fe._pad(batch)
+        x = jnp.asarray(arr)
+        if self.device is not None:
+            x = jax.device_put(x, self.device)
+        y = np.asarray(self._get_exec(arr.shape)(x))
+        return fe._unpad(batch, y)
+
+
+# ---------------------------------------------------------------------------
+# Frontend
+
+
+class ServingFrontend:
+    """Driver-side request admission, dynamic batching, dispatch,
+    retry, and pool management. See the module docstring for the
+    architecture; every tunable is a declared HOROVOD_SERVING_* knob
+    (env overridable per-instance via ``env=``)."""
+
+    def __init__(self, forward_fn: Callable,
+                 feature_shape: Sequence[int],
+                 dtype: str = "float32", *,
+                 env: Optional[Dict[str, str]] = None,
+                 start_pool: bool = True,
+                 autoscale: bool = True):
+        import jax
+        self._env = env
+        self._forward = forward_fn
+        self._jitted = jax.jit(forward_fn)
+        self._feature_shape = tuple(int(d) for d in feature_shape)
+        self._dtype = np.dtype(dtype)
+        self.ladder = build_ladder(env=env)
+        ev = lambda name: _config.env_value(name, env=env)  # noqa: E731
+        self._max_batch = ev("HOROVOD_SERVING_MAX_BATCH")
+        self._budget_s = ev("HOROVOD_SERVING_LATENCY_BUDGET_MS") / 1e3
+        self._min_workers = ev("HOROVOD_SERVING_MIN_WORKERS")
+        self._max_workers = ev("HOROVOD_SERVING_MAX_WORKERS")
+        self._scale_interval = ev("HOROVOD_SERVING_SCALE_INTERVAL_S")
+        self._scale_up_queue = ev("HOROVOD_SERVING_SCALE_UP_QUEUE")
+        self._scale_down_idle = ev("HOROVOD_SERVING_SCALE_DOWN_IDLE_S")
+        self._retry_limit = ev("HOROVOD_SERVING_RETRY_LIMIT")
+        self._worker_timeout = ev("HOROVOD_SERVING_WORKER_TIMEOUT_S")
+
+        self._lock = threading.RLock()
+        self._queue_cond = threading.Condition(self._lock)
+        self._dispatch_cond = threading.Condition(self._lock)
+        self._queue: deque = deque()          # ServingFuture
+        self._ready: deque = deque()          # _Batch
+        self._inflight: Dict[str, Tuple[_Batch, str, float]] = {}
+        self._batches: Dict[str, _Batch] = {}
+        self._workers: Dict[str, Any] = {}
+        self._closing = False
+        self._draining = False
+        self._remote = False
+        self._service = None
+        self._secret = ""
+        self._req_seq = 0
+        self._batch_seq = 0
+        self._worker_seq = 0
+        self._last_nonempty = time.monotonic()
+        self.submitted = 0
+        self.completed = 0
+        self.failed = 0
+        self.admitted = 0
+        self.retries = 0
+        self.dupes = 0
+        self.scale_events = 0
+
+        _journal.configure("serving", env=env)
+        self._batcher = threading.Thread(
+            target=self._batch_loop, name="hvd-serving-batcher",
+            daemon=True)
+        self._batcher.start()
+        self._watchdog = threading.Thread(
+            target=self._watchdog_loop, name="hvd-serving-watchdog",
+            daemon=True)
+        self._watchdog.start()
+        if autoscale:
+            self._autoscaler = threading.Thread(
+                target=self._autoscale_loop,
+                name="hvd-serving-autoscaler", daemon=True)
+            self._autoscaler.start()
+        if start_pool:
+            self.start_pool(self._min_workers)
+
+    # -- pool management ----------------------------------------------------
+
+    def start_pool(self, n: Optional[int] = None,
+                   reason: str = "start") -> None:
+        """Grow the local pool to ``n`` workers (default the floor),
+        round-robin over local devices."""
+        target = self._min_workers if n is None else n
+        with self._lock:
+            cur = len(self._workers)
+        if target > cur:
+            self._resize(target, reason)
+
+    def _add_local_worker(self) -> None:
+        import jax
+        devices = jax.local_devices()
+        with self._lock:
+            wid = f"w{self._worker_seq}"
+            self._worker_seq += 1
+            dev = (devices[(self._worker_seq - 1) % len(devices)]
+                   if len(devices) > 1 else None)
+            self._workers[wid] = _LocalWorker(self, wid, dev)
+            _m_workers.set(len(self._workers))
+
+    def _resize(self, target: int, reason: str,
+                **extra: Any) -> None:
+        target = max(self._min_workers,
+                     min(self._max_workers, target))
+        with self._lock:
+            before = len(self._workers)
+            qdepth = len(self._ready)
+        if target == before:
+            return
+        while len(self._workers) < target:
+            self._add_local_worker()
+        with self._lock:
+            while len(self._workers) > target:
+                # Retire the newest idle-eligible member; its loop
+                # observes the membership loss and exits cleanly.
+                wid = next(reversed(self._workers))
+                self._workers.pop(wid)
+            after = len(self._workers)
+            _m_workers.set(after)
+            self.scale_events += 1
+        _journal.record(
+            "scale_event",
+            direction="up" if after > before else "down",
+            workers_from=before, workers_to=after,
+            queue_depth=qdepth, reason=reason, **extra)
+
+    def on_membership(self, epoch: int, infos: Sequence[Any]) -> None:
+        """ElasticDriver membership listener: size the pool to the
+        published world (clamped to the knob floor/ceiling). Register
+        with ``driver.add_membership_listener(frontend.on_membership)``."""
+        self._resize(len(infos), "membership", epoch=epoch)
+
+    def _retired(self, wid: str) -> bool:
+        with self._lock:
+            return wid not in self._workers
+
+    def _worker_failed(self, wid: str, cause: str) -> None:
+        with self._lock:
+            known = self._workers.pop(wid, None)
+            _m_workers.set(len(self._workers))
+            doomed = [b for b, (bt, owner, _) in
+                      list(self._inflight.items()) if owner == wid]
+            batches = [self._inflight.pop(bid)[0] for bid in doomed]
+            before = len(self._workers) + (1 if known else 0)
+            if known is not None:
+                self.scale_events += 1
+        if known is not None:
+            _journal.record("scale_event", direction="down",
+                            workers_from=before, workers_to=before - 1,
+                            queue_depth=len(self._ready),
+                            reason=f"worker_death:{cause}", worker=wid)
+        for batch in batches:
+            self._retry(batch, cause, wid)
+
+    # -- admission / batching -----------------------------------------------
+
+    def submit(self, x: Any) -> ServingFuture:
+        arr = np.asarray(x, dtype=self._dtype)
+        if self.ladder.len_buckets:
+            want = self._feature_shape
+            if arr.ndim != len(want) + 1 or arr.shape[1:] != want:
+                raise ValueError(
+                    f"request shape {arr.shape} != (L, {want})")
+            self.ladder.len_bucket(arr.shape[0])  # validates length
+        elif arr.shape != self._feature_shape:
+            raise ValueError(
+                f"request shape {arr.shape} != {self._feature_shape}")
+        with self._lock:
+            if self._closing or self._draining:
+                raise ServingError("frontend is shutting down")
+            self._req_seq += 1
+            fut = ServingFuture(f"r{self._req_seq}", arr)
+            self._queue.append(fut)
+            self.submitted += 1
+            self._last_nonempty = time.monotonic()
+            _m_queue.set(self._pending_locked())
+            self._queue_cond.notify()
+        return fut
+
+    def _pending_locked(self) -> int:
+        return (len(self._queue)
+                + sum(len(b.requests) for b in self._ready))
+
+    def _cut_ready_locked(self) -> bool:
+        if not self._queue:
+            return False
+        if self._draining or len(self._queue) >= self._max_batch:
+            return True
+        oldest = self._queue[0].t_submit
+        return (time.monotonic() - oldest) >= self._budget_s
+
+    def _batch_loop(self) -> None:
+        while True:
+            with self._queue_cond:
+                while not self._cut_ready_locked():
+                    if self._closing and not self._queue:
+                        return
+                    wait = None
+                    if self._queue:
+                        wait = max(0.001, self._budget_s - (
+                            time.monotonic()
+                            - self._queue[0].t_submit))
+                    self._queue_cond.wait(wait)
+                batch = self._admit_locked()
+                self._dispatch_cond.notify_all()
+            _journal.record(
+                "batch_admitted", batch=batch.id,
+                size=len(batch.requests), bucket=batch.bucket_b,
+                bucket_len=batch.bucket_len or None,
+                queue_depth=len(self._ready),
+                wait_ms=round(1e3 * (time.monotonic()
+                                     - batch.requests[0].t_submit), 3))
+
+    def _admit_locked(self) -> _Batch:
+        take = min(len(self._queue), self._max_batch)
+        reqs = [self._queue.popleft() for _ in range(take)]
+        bucket_b = self.ladder.batch_bucket(take)
+        bucket_len = 0
+        if self.ladder.len_buckets:
+            bucket_len = max(self.ladder.len_bucket(r.payload.shape[0])
+                             for r in reqs)
+        self._batch_seq += 1
+        batch = _Batch(f"b{self._batch_seq}", reqs, bucket_b,
+                       bucket_len)
+        self._batches[batch.id] = batch
+        self._ready.append(batch)
+        self.admitted += 1
+        _m_batches.labels(bucket=str(bucket_b)).inc()
+        _m_batch_size.observe(float(take))
+        _m_padding.inc(float(bucket_b - take))
+        _m_queue.set(self._pending_locked())
+        return batch
+
+    # -- dispatch / completion ----------------------------------------------
+
+    def _next_batch(self, wid: str,
+                    timeout: float) -> Optional[_Batch]:
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            if (self._remote and wid not in self._workers
+                    and not self._closing):
+                self._workers[wid] = _RemoteMember(wid)
+                _m_workers.set(len(self._workers))
+        with self._dispatch_cond:
+            while not self._ready:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._closing:
+                    return None
+                self._dispatch_cond.wait(remaining)
+            batch = self._ready.popleft()
+            self._inflight[batch.id] = (
+                batch, wid,
+                time.monotonic() + self._worker_timeout)
+            _m_queue.set(self._pending_locked())
+            return batch
+
+    def _pad(self, batch: _Batch) -> np.ndarray:
+        if batch.bucket_len:
+            out = np.zeros((batch.bucket_b, batch.bucket_len)
+                           + self._feature_shape, dtype=self._dtype)
+            for i, r in enumerate(batch.requests):
+                out[i, :r.payload.shape[0]] = r.payload
+        else:
+            out = np.zeros((batch.bucket_b,) + self._feature_shape,
+                           dtype=self._dtype)
+            for i, r in enumerate(batch.requests):
+                out[i] = r.payload
+        return out
+
+    def _unpad(self, batch: _Batch, y: np.ndarray) -> List[np.ndarray]:
+        rows = []
+        for i, r in enumerate(batch.requests):
+            row = y[i]
+            if (batch.bucket_len and row.ndim >= 1
+                    and row.shape[0] == batch.bucket_len):
+                # The forward kept the padded length axis: return only
+                # the request's true length.
+                row = row[:r.payload.shape[0]]
+            rows.append(np.asarray(row))
+        return rows
+
+    def _complete_batch(self, batch: _Batch,
+                        rows: Sequence[np.ndarray],
+                        wid: str) -> int:
+        now = time.monotonic()
+        won = 0
+        dup = 0
+        for req, row in zip(batch.requests, rows):
+            if req._finish(value=row):
+                won += 1
+                _m_requests.labels(outcome="ok").inc()
+                _m_latency.observe(now - req.t_submit)
+            else:
+                dup += 1
+                _m_dupes.inc()
+        with self._lock:
+            self.completed += won
+            self.dupes += dup
+            ent = self._inflight.get(batch.id)
+            if ent is not None and (ent[1] == wid or batch.done):
+                self._inflight.pop(batch.id, None)
+            if batch.done:
+                self._batches.pop(batch.id, None)
+                try:
+                    self._ready.remove(batch)
+                except ValueError:
+                    pass
+            _m_queue.set(self._pending_locked())
+            if not self._queue and not self._ready:
+                self._last_nonempty = now
+        return won
+
+    def _retry(self, batch: _Batch, cause: str, wid: str) -> None:
+        if batch.done:
+            return
+        batch.attempts += 1
+        if batch.attempts > self._retry_limit:
+            lost = 0
+            for req in batch.requests:
+                if req._finish(error=ServingError(
+                        f"request {req.id} failed after "
+                        f"{batch.attempts} dispatch attempts "
+                        f"(last cause: {cause})")):
+                    lost += 1
+                    _m_requests.labels(outcome="failed").inc()
+            with self._lock:
+                self.failed += lost
+                self._batches.pop(batch.id, None)
+            return
+        with self._lock:
+            self.retries += 1
+        _m_retries.labels(cause=cause).inc()
+        _journal.record("batch_retried", batch=batch.id,
+                        attempt=batch.attempts, cause=cause,
+                        worker=wid,
+                        pending=sum(1 for r in batch.requests
+                                    if not r.done))
+        with self._lock:
+            self._ready.appendleft(batch)
+            _m_queue.set(self._pending_locked())
+            self._dispatch_cond.notify_all()
+
+    def _watchdog_loop(self) -> None:
+        while not self._closing:
+            time.sleep(min(0.05, self._worker_timeout / 4))
+            now = time.monotonic()
+            with self._lock:
+                expired = sorted({wid for _, (b, wid, dl)
+                                  in self._inflight.items()
+                                  if dl < now})
+            for wid in expired:
+                hlog.warning("serving: worker %s missed the batch "
+                             "deadline; requeueing its work", wid)
+                self._worker_failed(wid, "timeout")
+
+    def _autoscale_loop(self) -> None:
+        while not self._closing:
+            time.sleep(self._scale_interval)
+            if self._remote or self._closing or self._draining:
+                continue
+            with self._lock:
+                qdepth = len(self._ready)
+                n = len(self._workers)
+                busy = bool(self._inflight or self._queue
+                            or self._ready)
+                idle_for = time.monotonic() - self._last_nonempty
+            if n < self._min_workers:
+                # A death took the pool below the floor; restore it.
+                self._resize(self._min_workers, "floor")
+            elif qdepth > self._scale_up_queue * max(1, n) \
+                    and n < self._max_workers:
+                self._resize(n + 1, "queue_depth")
+            elif (not busy and n > self._min_workers
+                    and idle_for > self._scale_down_idle):
+                self._resize(n - 1, "idle")
+
+    # -- remote transport ---------------------------------------------------
+
+    def serve_endpoint(self, port: int = 0,
+                       secret: Optional[str] = None
+                       ) -> Tuple[int, str]:
+        """Expose the dispatch queue to remote pool members over the
+        HMAC-signed control-plane wire; returns (port, secret) for
+        `remote_worker_loop` peers. Pool membership then comes from
+        pulls (and `on_membership`), and local autoscaling is off."""
+        from .runner import secret as _secret_mod
+        from .runner.service import BasicService
+        self._secret = (secret if secret is not None
+                        else (_secret_mod.from_env()
+                              or _secret_mod.make_secret()))
+        svc = BasicService("serving", self._secret)
+        svc.handle("pull", self._h_pull)
+        svc.handle("push", self._h_push)
+        with self._lock:
+            self._service = svc
+            self._remote = True
+        return svc.port, self._secret
+
+    def _h_pull(self, req: dict, peer) -> dict:
+        wid = str(req.get("worker") or f"{peer[0]}:{peer[1]}")
+        if self._closing:
+            return {"stop": True}
+        batch = self._next_batch(wid, timeout=float(
+            req.get("wait", 0.2)))
+        if batch is None:
+            return {"batch": None, "stop": self._closing}
+        arr = self._pad(batch)
+        return {"batch": {
+            "id": batch.id,
+            "shape": list(arr.shape),
+            "dtype": self._dtype.name,
+            "lens": [int(r.payload.shape[0]) if batch.bucket_len
+                     else -1 for r in batch.requests],
+            "payload": arr.tolist(),
+        }}
+
+    def _h_push(self, req: dict, peer) -> dict:
+        wid = str(req.get("worker") or f"{peer[0]}:{peer[1]}")
+        bid = str(req.get("batch"))
+        batch = self._batches.get(bid)
+        if batch is None:
+            # Completed and pruned — a revenant's late push.
+            with self._lock:
+                self.dupes += 1
+            _m_dupes.inc()
+            return {"ok": 0}
+        y = np.asarray(req.get("outputs"), dtype=self._dtype)
+        return {"ok": self._complete_batch(
+            batch, self._unpad(batch, y), wid)}
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Stop admission, flush every queued request through the
+        pool; True when nothing is left pending."""
+        with self._lock:
+            self._draining = True
+            self._queue_cond.notify_all()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not (self._queue or self._ready or self._inflight
+                        or self._batches):
+                    return True
+            time.sleep(0.01)
+        return False
+
+    def close(self, timeout: float = 30.0) -> None:
+        drained = self.drain(timeout)
+        if not drained:
+            hlog.warning("serving: close() draining timed out; "
+                         "failing the stragglers")
+            with self._lock:
+                stuck = list(self._batches.values())
+            lost = 0
+            for batch in stuck:
+                for req in batch.requests:
+                    if req._finish(error=ServingError(
+                            "frontend closed before completion")):
+                        lost += 1
+                        _m_requests.labels(outcome="failed").inc()
+            with self._lock:
+                self.failed += lost
+        with self._lock:
+            self._closing = True
+            self._queue_cond.notify_all()
+            self._dispatch_cond.notify_all()
+            self._workers.clear()
+            _m_workers.set(0)
+        if self._service is not None:
+            # Leave the endpoint answering {"stop": True} briefly so
+            # remote members exit cleanly, then close it.
+            time.sleep(0.2)
+            self._service.close()
+        self._batcher.join(timeout=2)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            compiles = sum(getattr(w, "compiles", 0)
+                           for w in self._workers.values())
+            workers = len(self._workers)
+        return {
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "failed": self.failed,
+            "dropped": self.submitted - self.completed - self.failed,
+            "batches": self.admitted,
+            "retries": self.retries,
+            "duplicates_suppressed": self.dupes,
+            "scale_events": self.scale_events,
+            "workers": workers,
+            "compiles": compiles,
+            "ladder": {
+                "batch_buckets": list(self.ladder.batch_buckets),
+                "len_buckets": list(self.ladder.len_buckets),
+                "digest": self.ladder.digest,
+            },
+        }
+
+
+# ---------------------------------------------------------------------------
+# Remote worker loop
+
+
+def remote_worker_loop(addr: str, port: int,
+                       forward_fn: Callable,
+                       feature_shape: Sequence[int],
+                       dtype: str = "float32",
+                       wid: Optional[str] = None,
+                       secret: Optional[str] = None,
+                       env: Optional[Dict[str, str]] = None,
+                       max_batches: int = 0) -> int:
+    """Pool-member loop for a separate process: pull padded batches
+    from a `ServingFrontend.serve_endpoint()`, execute the
+    AOT-compiled forward, push results. Returns the number of batches
+    executed; exits when the frontend says stop (or after
+    ``max_batches`` > 0, for tests). The `serving.batch` seam fires
+    once per pulled batch — `crash` here is a real mid-batch process
+    death."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    from .runner import secret as _secret_mod
+    from .runner.service import BasicClient
+
+    if wid is None:
+        wid = f"pid{os.getpid()}"
+    if secret is None:
+        secret = _secret_mod.from_env()
+    if _journal._journal is None:
+        # Don't steal an already-armed journal: under the elastic
+        # runner this process journals as its rank, and fault_fired /
+        # batch records must stay attributable to that rank.
+        _journal.configure(f"serving-{wid}", env=env)
+    cli = BasicClient(addr, port, secret, timeout=10.0)
+    ladder = build_ladder(env=env)
+    jitted = jax.jit(forward_fn)
+    compiled: Dict[Tuple[int, ...], Callable] = {}
+    for shape in ladder.shapes(feature_shape):
+        fn, _ = aot_compile(jitted, jnp.zeros(shape, dtype))
+        compiled[shape] = fn
+        _m_compiles.inc()
+    done = 0
+    while True:
+        reply = cli.try_request({"type": "pull", "worker": wid,
+                                 "wait": 0.2}, retries=2)
+        if reply is None:
+            time.sleep(0.05)
+            continue
+        if reply.get("stop"):
+            return done
+        b = reply.get("batch")
+        if not b:
+            continue
+        _faults.fire("serving.batch", exc=_WorkerDied, tag=wid)
+        shape = tuple(b["shape"])
+        x = np.asarray(b["payload"], dtype=b["dtype"]).reshape(shape)
+        fn = compiled.get(shape)
+        y = np.asarray(fn(jnp.asarray(x)) if fn is not None
+                       else jitted(jnp.asarray(x)))
+        cli.try_request({"type": "push", "worker": wid,
+                         "batch": b["id"], "outputs": y.tolist()},
+                        retries=2)
+        done += 1
+        if max_batches and done >= max_batches:
+            return done

@@ -1,0 +1,56 @@
+"""HVD001 fixture: serving-loop dispatch patterns (round 15).
+
+The serving frontend fans batches out across pool members; done with
+collectives, the fan-out must be entered by EVERY member uniformly. A
+rank-gated dispatch (only the frontend rank enters the collective) is
+the classic serving deadlock and must be flagged; the uniform fan-out
+below it must stay clean. Same marker contract as the other fixtures:
+trailing EXPECT comments name the exact (rule, line) pairs
+tests/test_lint.py asserts.
+"""
+
+import horovod_tpu as hvd
+
+
+def rank_gated_batch_dispatch(batch):
+    # frontend-style guard: only rank 0 enters the fan-out, every
+    # other member never reaches the collective
+    if hvd.rank() == 0:
+        return hvd.broadcast(batch, root_rank=0)  # EXPECT: HVD001
+    return batch
+
+
+def rank_gated_result_gather(parts):
+    if hvd.rank() != 0:
+        return parts
+    return hvd.allgather(parts)  # EXPECT: HVD001
+
+
+def _fan_out(batch):
+    return hvd.broadcast(batch, root_rank=0)
+
+
+def size_gated_fanout_helper(batch):
+    # uniform within one pool epoch, but an epoch hazard when the
+    # pool resizes mid-flight — exactly the serving autoscale case
+    if hvd.size() > 1:
+        return _fan_out(batch)  # EXPECT: HVD001
+    return batch
+
+
+# -- negatives: none of these may be reported ------------------------------
+
+def uniform_fan_out(batch):
+    # every member enters the broadcast + gather pair — the correct
+    # collective serving fan-out shape
+    shard = hvd.broadcast(batch, root_rank=0)
+    return hvd.allgather(shard)
+
+
+def uniform_batch_loop(batches):
+    # dispatch loop over admitted batches: per-batch collectives are
+    # fine as long as every member runs the same loop
+    out = []
+    for b in batches:
+        out.append(hvd.allreduce(b, name="serving_fanout"))
+    return out

@@ -1,0 +1,66 @@
+"""HVD004 fixture: serving-worker side-effects inside the traced
+forward (round 15).
+
+serving.py's contract is that the seam fire, the metrics, and the
+journal records all live in the UNTRACED worker loop around the
+AOT-compiled forward. These positives are the tempting wrong version
+— instrumenting the forward itself — which would bake one trace-time
+sample into the executable; the negatives are the loop shape the
+subsystem actually uses.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu import faults
+from horovod_tpu.metrics import REGISTRY
+
+_m_fix_batches = REGISTRY.counter(
+    "hvdfix_serving_batches_total",
+    "Seeded serving trace-impurity target.")
+
+
+@jax.jit
+def forward_counts_batches(x):
+    _m_fix_batches.inc()  # EXPECT: HVD004
+    return jnp.tanh(x)
+
+
+@jax.jit
+def forward_fires_seam(x):
+    faults.fire("serving.batch")  # EXPECT: HVD004
+    return x * 2
+
+
+@jax.jit
+def forward_times_itself(x):
+    t0 = time.perf_counter()  # EXPECT: HVD004
+    return x * t0
+
+
+@jax.jit
+def forward_journals_admission(x):
+    from horovod_tpu import journal
+    journal.record("batch_admitted", batch="b1")  # EXPECT: HVD004
+    return x + 1
+
+
+# -- negatives: the worker-loop shape serving.py actually uses -------------
+
+@jax.jit
+def pure_forward(x):
+    return jnp.tanh(x)
+
+
+def worker_loop_effects_outside_trace(x):
+    # seam fire, metric, latency clock and journal record wrap the
+    # compiled forward from plain python — the intended split
+    faults.fire("serving.batch")
+    _m_fix_batches.inc()
+    t0 = time.perf_counter()
+    y = pure_forward(x)
+    from horovod_tpu import journal
+    journal.record("batch_admitted", batch="b2")
+    return y, time.perf_counter() - t0

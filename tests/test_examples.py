@@ -142,3 +142,15 @@ class TestParallelismExamples:
                        "--xla_force_host_platform_device_count=8"})
         assert r.returncode == 0, r.stdout + r.stderr
         assert "expert-parallel MoE OK" in r.stdout
+
+
+@pytest.mark.integration
+def test_serving_inference_chaos():
+    """The serving example end to end with the injected mid-batch
+    worker death: zero dropped requests is asserted inside the
+    example and re-checked here."""
+    r = run_example("serving_inference.py",
+                    ["--chaos", "--requests", "60", "--qps", "400"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK (zero dropped requests)" in r.stdout
+    assert "dropped=0" in r.stdout
