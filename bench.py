@@ -1674,22 +1674,75 @@ def convergence_compression_main() -> None:
         "unit": "nats", "vs_baseline": 1.0}), flush=True)
 
 
+def _regen_serving_attribution(here):
+    """Regenerate benchmarks/SERVING_ATTRIBUTION_r16.json from the
+    COMMITTED trace recording (benchmarks/serving_trace_r16/): a pure
+    function of those bytes, so reruns are byte-identical — and
+    `doctor serve` on the same directory produces the same bytes as
+    its in-dir serving_report.json. Returns the report, or None when
+    no recording is committed."""
+    from horovod_tpu import journal as hjournal
+    from horovod_tpu import serving_trace as hserving_trace
+
+    record_dir = os.environ.get("BENCH_SERVING_RECORD_DIR") \
+        or os.path.join(here, "benchmarks", "serving_trace_r16")
+    out = os.environ.get("BENCH_SERVING_ATTRIBUTION_OUT") \
+        or os.path.join(here, "benchmarks",
+                        "SERVING_ATTRIBUTION_r16.json")
+    if not (os.path.isdir(record_dir)
+            and hjournal.find_journal_files(record_dir)):
+        log(f"bench[serving]: no recorded traces under {record_dir}; "
+            "skipping attribution regeneration")
+        return None
+    path, report = hserving_trace.write_serving_report(record_dir)
+    with open(path, "rb") as f:
+        data = f.read()
+    with open(out, "wb") as f:
+        f.write(data)
+    log(f"bench[serving]: attribution written to {out} "
+        f"(and {path})")
+    return report
+
+
+def serving_attribution_main() -> None:
+    """`--serving-attribution`: ONLY the deterministic regeneration
+    of benchmarks/SERVING_ATTRIBUTION_r16.json from the committed
+    trace recording — no measurement legs, so tests can pin the
+    bytes cheaply."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    report = _regen_serving_attribution(here)
+    attr = (report or {}).get("attribution") or {}
+    print(json.dumps({
+        "metric": "serving_attribution_dominant_share",
+        "value": attr.get("dominant_share", 0.0),
+        "unit": "fraction", "vs_baseline": 1.0}), flush=True)
+
+
 def serving_main() -> None:
     """`--serving`: measure the elastic inference frontend
     (horovod_tpu/serving.py) on this host and write
-    benchmarks/BENCH_serving_r15.json — p50/p99 request latency vs
-    offered QPS, a scale-out curve over pool sizes, an autoscale
-    soak, and the chaos retry accounting (an injected serving.batch
-    worker death mid-run must lose zero requests). The artifact pins
-    the padded-bucket ladder digest so a reader can tie the measured
-    numbers to the exact executable-shape set they were taken
-    against."""
+    benchmarks/BENCH_serving_r16.json — p50/p99 request latency vs
+    offered QPS, a scale-out curve over pool sizes with its
+    per-phase lifecycle decomposition (serving_trace block), an
+    autoscale soak, and the chaos retry accounting (an injected
+    serving.batch worker death mid-run must lose zero requests).
+    With BENCH_SERVING_RECORD=1 the 1- and 2-worker scale-out legs
+    journal their request traces into benchmarks/serving_trace_r16/
+    (the committed recording behind SERVING_ATTRIBUTION_r16.json);
+    every run then regenerates that attribution artifact from the
+    committed bytes. The artifact pins the padded-bucket ladder
+    digest so a reader can tie the measured numbers to the exact
+    executable-shape set they were taken against."""
     from horovod_tpu import faults as hfaults
+    from horovod_tpu import journal as hjournal
     from horovod_tpu import serving as hserving
 
     here = os.path.dirname(os.path.abspath(__file__))
     out_path = os.environ.get("BENCH_SERVING_OUT") or os.path.join(
-        here, "benchmarks", "BENCH_serving_r15.json")
+        here, "benchmarks", "BENCH_serving_r16.json")
+    record = bool(os.environ.get("BENCH_SERVING_RECORD"))
+    record_dir = os.environ.get("BENCH_SERVING_RECORD_DIR") \
+        or os.path.join(here, "benchmarks", "serving_trace_r16")
 
     d_model = int(os.environ.get("BENCH_SERVING_DMODEL", "256"))
     rng = np.random.RandomState(0)
@@ -1713,12 +1766,16 @@ def serving_main() -> None:
     })
 
     def run_leg(n_requests, qps, workers, autoscale=False,
-                fault_spec=None):
+                fault_spec=None, tag=None, record_to=None):
         if fault_spec:
             hfaults.configure(fault_spec, seed=15)
+        env = dict(senv)
+        if record_to:
+            os.makedirs(record_to, exist_ok=True)
+            env["HOROVOD_JOURNAL_DIR"] = record_to
         fe = hserving.ServingFrontend(
-            forward, (d_model,), env=senv, start_pool=False,
-            autoscale=autoscale)
+            forward, (d_model,), env=env, start_pool=False,
+            autoscale=autoscale, trace_tag=tag)
         fe.start_pool(workers)
         gap = (1.0 / qps) if qps else 0.0
         futs = []
@@ -1731,7 +1788,14 @@ def serving_main() -> None:
             f.result(timeout=60)
         wall = time.perf_counter() - t0
         stats = fe.stats()
+        if record_to:
+            fe.write_timeline(os.path.join(
+                record_to, f"serving-{tag}.trace.json"))
         fe.close()
+        if record_to:
+            # Detach so the next leg's frontend opens its own role
+            # file instead of appending to this leg's journal.
+            hjournal.disarm()
         if fault_spec:
             hfaults.configure("", seed=0)
         lats = sorted(1e3 * (f.t_done - f.t_submit) for f in futs)
@@ -1757,11 +1821,15 @@ def serving_main() -> None:
             f"p99={leg['p99_ms']}ms")
 
     scaleout = {}
+    serving_trace = {}
     for w in (1, 2, 4):
-        leg, _ = run_leg(256, 0, w)
+        rec = record_dir if (record and w in (1, 2)) else None
+        leg, st = run_leg(256, 0, w, tag=f"w{w}", record_to=rec)
         scaleout[f"workers{w}"] = {
             "achieved_qps": leg["achieved_qps"],
             "p99_ms": leg["p99_ms"]}
+        if "trace" in st:
+            serving_trace[f"workers{w}"] = st["trace"]
         log(f"bench[serving]: workers={w} "
             f"qps={leg['achieved_qps']}")
 
@@ -1804,11 +1872,21 @@ def serving_main() -> None:
         },
         "latency_vs_qps": latency_vs_qps,
         "scaleout": scaleout,
+        "serving_trace": serving_trace,
         "autoscale": autoscale,
         "retry": retry,
         "metrics": _metrics_snapshot(),
         "journal": _journal_digest(),
     }
+    attribution = _regen_serving_attribution(here)
+    if attribution is not None:
+        doc["attribution"] = {
+            "dominant_phase": attribution["attribution"][
+                "dominant_phase"],
+            "dominant_share": attribution["attribution"][
+                "dominant_share"],
+            "source": "benchmarks/SERVING_ATTRIBUTION_r16.json",
+        } if attribution.get("attribution") else {}
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
     with open(out_path, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True)
@@ -1925,6 +2003,25 @@ def trajectory_main() -> None:
                 "ladder", "digest"),
             "source": "benchmarks/BENCH_serving_r15.json",
         },
+        "r16_serving_attribution": {
+            "added_mean_ms_1to2_workers": read(
+                "benchmarks/SERVING_ATTRIBUTION_r16.json",
+                "attribution", "added_mean_ms"),
+            "dominant_phase": read(
+                "benchmarks/SERVING_ATTRIBUTION_r16.json",
+                "attribution", "dominant_phase"),
+            "dominant_share": read(
+                "benchmarks/SERVING_ATTRIBUTION_r16.json",
+                "attribution", "dominant_share"),
+            "top2": read(
+                "benchmarks/SERVING_ATTRIBUTION_r16.json",
+                "attribution", "top2"),
+            "note": "measured per-phase decomposition of the "
+                    "1->2-worker scale-out regression from the "
+                    "committed trace recording "
+                    "(benchmarks/serving_trace_r16/)",
+            "source": "benchmarks/SERVING_ATTRIBUTION_r16.json",
+        },
     }
     with open(out_path, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True)
@@ -1932,7 +2029,7 @@ def trajectory_main() -> None:
     log(f"bench[trajectory]: written to {out_path}")
     print(json.dumps({
         "metric": "trajectory_rounds_recorded",
-        "value": len(headline) + 5, "unit": "rounds",
+        "value": len(headline) + 6, "unit": "rounds",
         "vs_baseline": 1.0}), flush=True)
 
 
@@ -2262,6 +2359,8 @@ if __name__ == "__main__":
                  "would be silently ignored)")
     if "--scaling-report" in sys.argv:
         scaling_report_main()
+    elif "--serving-attribution" in sys.argv:
+        serving_attribution_main()
     elif "--serving" in sys.argv:
         serving_main()
     elif "--compression-ab" in sys.argv:

@@ -93,6 +93,7 @@ _SPAN_ATTRS = frozenset({
     "record", "record_skew", "enqueue", "dispatched",
     "negotiate_start", "negotiate_end", "done", "fuse",
     "error_marker", "clock_sync", "next_seq", "advance_step",
+    "span",
 })
 
 

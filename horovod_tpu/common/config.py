@@ -473,6 +473,25 @@ KNOBS: List[Knob] = [
          "detector: a batch outstanding on a worker longer than this "
          "marks the worker dead and requeues the batch on a "
          "survivor."),
+    Knob("HOROVOD_SERVING_TRACE", _parse_bool, True,
+         "Request-lifecycle tracing in the serving frontend: every "
+         "request carries monotonic-ns phase stamps (batch-cut, "
+         "queue-wait, pad, compute, unpad, complete) feeding the "
+         "hvd_serving_phase_seconds histograms, the flight-recorder "
+         "ring, per-batch `batch_trace` journal events, and "
+         "`doctor serve`'s offline attribution. Off, the submit "
+         "path's trace seam is one attribute load + compare (the "
+         "faults.fire/journal.record discipline)."),
+    Knob("HOROVOD_SERVING_TRACE_BUFFER", int, 4096,
+         "Completed request traces retained in the frontend's "
+         "in-memory buffer (bounded deque) for trace_digest() / "
+         "write_timeline(); oldest entries fall off first."),
+    Knob("HOROVOD_SERVING_DEFAULT_SLO_MS", float, 0.0,
+         "Default per-request SLO deadline in milliseconds for "
+         "submit() calls that pass no slo_ms, driving the "
+         "hvd_serving_goodput_total / hvd_serving_slo_miss_total "
+         "accounting. 0 = use HOROVOD_SERVING_LATENCY_BUDGET_MS "
+         "(the admission budget) as the default deadline."),
     # -- process sets --------------------------------------------------------
     # hvdlint: disable-next=HVD002 (compat: the reference gates
     # post-init add_process_set on this; here registration is
@@ -659,6 +678,9 @@ class Config:
         "serving_scale_down_idle_s": "HOROVOD_SERVING_SCALE_DOWN_IDLE_S",
         "serving_retry_limit": "HOROVOD_SERVING_RETRY_LIMIT",
         "serving_worker_timeout_s": "HOROVOD_SERVING_WORKER_TIMEOUT_S",
+        "serving_trace": "HOROVOD_SERVING_TRACE",
+        "serving_trace_buffer": "HOROVOD_SERVING_TRACE_BUFFER",
+        "serving_default_slo_ms": "HOROVOD_SERVING_DEFAULT_SLO_MS",
         "dynamic_process_sets": "HOROVOD_DYNAMIC_PROCESS_SETS",
         "rank": "HOROVOD_RANK",
         "size": "HOROVOD_SIZE",

@@ -105,6 +105,12 @@ CRITICAL_EVENTS = frozenset({
     # incident-grade edges (batch_admitted stays batched — it is
     # per-batch hot-path volume).
     "batch_retried", "scale_event",
+    # Serving trace (round 16): the frontend's one-shot config record
+    # and the retry-budget-exhausted terminal are rare and what
+    # `doctor serve` keys legs and failure accounting on; the
+    # per-batch `batch_trace` phase record stays batched like
+    # batch_admitted.
+    "serving_meta", "batch_failed",
 })
 
 
@@ -287,6 +293,16 @@ def configure(role: str, rank: int = -1,
                      "journal disabled for this process", path, e)
         _journal = None
     return _journal
+
+
+def disarm() -> None:
+    """Close and detach this process's journal (bench legs that
+    journal into per-leg directories, test hygiene). Safe when
+    already disarmed."""
+    global _journal
+    if _journal is not None:
+        _journal.close()
+        _journal = None
 
 
 def record(type_: str, **fields: Any) -> None:

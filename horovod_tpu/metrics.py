@@ -45,6 +45,15 @@ COUNT_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0)
 # interpolation is stable where serving actually operates.
 SERVING_LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
                            0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+# Serving lifecycle PHASES are one decade finer than the end-to-end
+# request ladder: pad/unpad run in tens of microseconds and the
+# batch-cut wait tops out at the admission budget, so the request
+# ladder's 0.5 ms floor would fold every sub-budget phase into one
+# bucket and the p50/p99 decomposition (serving.py's
+# hvd_serving_phase_seconds) could not attribute anything.
+SERVING_PHASE_BUCKETS = (1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
+                         1e-3, 2.5e-3, 5e-3, 0.01, 0.025, 0.05, 0.1,
+                         0.25, 1.0, 10.0)
 # Recovery phases span a sub-second in-process restore to a
 # multi-minute blacklist-then-respawn on a starved pool (journal.py's
 # hvd_recovery_seconds{phase} SLO histograms).

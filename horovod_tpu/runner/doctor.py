@@ -7,7 +7,11 @@ timelines on calibrated clocks and print the straggler report
 <dir>` — merge driver+worker lifecycle journals (journal.py) into a
 byte-deterministic incident_report.json with per-recovery MTTR
 decomposition, cause attribution, and committed-step-loss
-accounting."""
+accounting — and `python -m horovod_tpu.runner.doctor serve <dir>`
+— fold the serving tier's request-lifecycle journals and timelines
+(serving_trace.py) into a byte-deterministic serving_report.json
+with per-phase latency decomposition, per-worker utilization, retry
+chains, and goodput-vs-SLO accounting."""
 
 from __future__ import annotations
 
@@ -102,9 +106,22 @@ def incident(target: str, out: Optional[str] = None) -> str:
             + f"\n\nreport: {path}")
 
 
+def serve(target: str, out: Optional[str] = None) -> str:
+    """Fold the serving journals (and sibling `*.trace.json`
+    timelines) under `target` into `serving_report.json` —
+    byte-deterministic for identical inputs, the same regeneration
+    contract as `incident` — and return the rendered per-phase /
+    per-worker / goodput summary."""
+    from .. import serving_trace
+    path, report = serving_trace.write_serving_report(target,
+                                                      out=out)
+    return (serving_trace.render_serving_report(report)
+            + f"\n\nreport: {path}")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     """`python -m horovod_tpu.runner.doctor
-    [trace <dir>|incident <dir>|check-build]`."""
+    [trace <dir>|incident <dir>|serve <dir>|check-build]`."""
     import argparse
 
     p = argparse.ArgumentParser(
@@ -141,6 +158,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     pi.add_argument("--out", default=None,
                     help="report output path (default: "
                          "incident_report.json inside the dir)")
+    ps = sub.add_parser(
+        "serve",
+        help="fold the serving tier's request-lifecycle journals "
+             "(HOROVOD_SERVING_TRACE) into serving_report.json "
+             "(per-phase latency decomposition, worker utilization, "
+             "retry chains, goodput vs SLO) and print the summary")
+    ps.add_argument("target",
+                    help="the serving run's HOROVOD_JOURNAL_DIR "
+                         "(holds journal-serving*.jsonl, plus any "
+                         "*.trace.json timelines)")
+    ps.add_argument("--out", default=None,
+                    help="report output path (default: "
+                         "serving_report.json inside the dir)")
     args = p.parse_args(argv)
     if args.cmd == "trace":
         try:
@@ -155,6 +185,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(incident(args.target, out=args.out))
         except (OSError, ValueError) as e:
             print(f"doctor incident: {e}")
+            return 1
+        return 0
+    if args.cmd == "serve":
+        try:
+            print(serve(args.target, out=args.out))
+        except (OSError, ValueError) as e:
+            print(f"doctor serve: {e}")
             return 1
         return 0
     print(check_build(verbose=getattr(args, "verbose", False)))

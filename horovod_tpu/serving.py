@@ -49,12 +49,33 @@ Observability: the `hvd_serving_*` metric family (request-latency
 histogram on the SERVING_LATENCY_BUCKETS ladder, queue depth, pool
 size, retries, suppressed duplicates, compile count) plus typed
 journal records `batch_admitted` / `batch_retried` / `scale_event`.
+
+Request-lifecycle tracing (round 16, HOROVOD_SERVING_TRACE): every
+future carries monotonic-ns phase stamps across its whole life —
+enqueue → batch-cut → queue-wait → worker claim → pad → compute →
+unpad → complete — with each dispatch attempt recorded as a `_Hop`
+(retry hops become linked child spans in `write_timeline()`'s
+Chrome-trace lanes). Phase edges ride the PR 5 flight-recorder ring
+(`tracing.record`) and a registered postmortem provider, so a
+SIGKILLed worker's in-flight request ids and their last completed
+phase land in `postmortem-rank{r}.json`; completed batches emit
+`batch_trace` journal events that `doctor serve` (serving_trace.py)
+folds into the byte-deterministic `serving_report.json`. Aggregates:
+`hvd_serving_phase_seconds{phase}`, per-SLO-class
+`hvd_serving_goodput_total` / `hvd_serving_slo_miss_total`
+(deadline from `submit(x, slo_ms=...)`, defaulting to the latency
+budget), and the dispatch-loop health gauges
+`hvd_serving_batch_loop_occupancy` / `hvd_serving_latch_wait_seconds`
+that say whether the single batcher loop or the completion latch
+serializes scale-out. Disarmed, the submit path's trace seam is one
+attribute load + compare (the faults.fire discipline).
 """
 
 from __future__ import annotations
 
 import threading
 import time
+import weakref
 from collections import deque
 from typing import (Any, Callable, Dict, List, NamedTuple, Optional,
                     Sequence, Tuple)
@@ -63,10 +84,12 @@ import numpy as np
 
 from . import faults as _faults
 from . import journal as _journal
+from . import tracing as _tracing
 from .common import config as _config
 from .common import logging as hlog
 from .metrics import (COUNT_BUCKETS, REGISTRY as _METRICS,
-                      SERVING_LATENCY_BUCKETS)
+                      SERVING_LATENCY_BUCKETS,
+                      SERVING_PHASE_BUCKETS)
 from .parallel.aot import aot_compile
 
 LADDER_SCHEMA = "serving-ladder-v1"
@@ -113,6 +136,36 @@ _m_dupes = _METRICS.counter(
     "hvd_serving_duplicates_suppressed_total",
     "Late completions from revenant workers rejected by the "
     "per-request exactly-once latch.")
+_m_phase = _METRICS.histogram(
+    "hvd_serving_phase_seconds",
+    "Per-request lifecycle decomposition (HOROVOD_SERVING_TRACE): "
+    "batch_cut (enqueue to batch admission), queue_wait (admission "
+    "to worker claim), pad, compute, unpad, complete (unpad to "
+    "latch). The winning dispatch attempt's stamps; retries show up "
+    "as inflated queue_wait.",
+    ("phase",), buckets=SERVING_PHASE_BUCKETS)
+_m_goodput = _METRICS.counter(
+    "hvd_serving_goodput_total",
+    "Requests completed within their SLO deadline, by SLO class "
+    "(the slo_ms= passed to submit(); 'default' = the latency "
+    "budget / HOROVOD_SERVING_DEFAULT_SLO_MS).",
+    ("slo",))
+_m_slo_miss = _METRICS.counter(
+    "hvd_serving_slo_miss_total",
+    "Requests that missed their SLO deadline, by class and reason: "
+    "late = completed past the deadline, failed = never completed "
+    "(retry budget exhausted or frontend closed).",
+    ("slo", "reason"))
+_m_loop_occupancy = _METRICS.gauge(
+    "hvd_serving_batch_loop_occupancy",
+    "Busy fraction of the single dispatch (batcher) loop over the "
+    "window since the previous admission — sustained values near "
+    "1.0 mean the loop itself serializes scale-out.")
+_m_latch_wait = _METRICS.gauge(
+    "hvd_serving_latch_wait_seconds",
+    "Wall seconds the most recent completing worker spent inside "
+    "_complete_batch (per-request latches + the frontend lock) — "
+    "the completion-side serialization cost per batch.")
 
 
 class ServingError(RuntimeError):
@@ -203,6 +256,53 @@ def build_ladder(max_batch: Optional[int] = None,
 # ---------------------------------------------------------------------------
 # Requests and batches
 
+# Lifecycle phases, in request order. Every completed request's
+# latency decomposes exactly into these (stamps from the winning
+# dispatch attempt): batch_cut = enqueue to batch admission,
+# queue_wait = admission to worker claim (inflated by retries — a
+# requeued batch goes back through the dispatch queue), pad = claim
+# to executable entry (padding + host→device transfer), compute =
+# executable run (for remote members: the pull→push round trip,
+# wire included), unpad = output slicing, complete = unpad to the
+# exactly-once latch. serving_trace.py carries the same list.
+PHASES = ("batch_cut", "queue_wait", "pad", "compute", "unpad",
+          "complete")
+
+
+def _pct(sorted_vals: Sequence[int], q: float) -> int:
+    """Nearest-rank percentile over an already-sorted sequence —
+    deterministic (no interpolation), shared with serving_trace.py's
+    offline aggregation so live digests and doctor-serve reports
+    agree bit-for-bit on the same samples."""
+    if not sorted_vals:
+        return 0
+    rank = max(1, int(-(-q * len(sorted_vals) // 1)))  # ceil
+    return sorted_vals[min(len(sorted_vals), rank) - 1]
+
+
+class _Hop:
+    """One dispatch attempt of one batch: which worker claimed it and
+    the monotonic-ns stamps of its execution edges. The winning hop's
+    stamps become the requests' phase decomposition; losing hops keep
+    their outcome (`retried:<cause>`) so retry chains reconstruct as
+    linked child spans in `write_timeline()` and `doctor serve`."""
+
+    __slots__ = ("worker", "attempt", "t_claim_ns", "t_exec0_ns",
+                 "t_exec1_ns", "t_unpad1_ns", "outcome")
+
+    def __init__(self, worker: str, attempt: int):
+        self.worker = worker
+        self.attempt = attempt
+        self.t_claim_ns = time.monotonic_ns()
+        self.t_exec0_ns = 0
+        self.t_exec1_ns = 0
+        self.t_unpad1_ns = 0
+        self.outcome = "pending"
+
+    def summary(self) -> List[Any]:
+        return [self.worker, self.attempt, self.outcome,
+                self.t_claim_ns]
+
 
 class ServingFuture:
     """One request's handle. `result()` blocks until the request
@@ -211,11 +311,20 @@ class ServingFuture:
     guarantee: whichever worker finishes first wins, every later
     completion is suppressed and counted."""
 
-    def __init__(self, req_id: str, payload: np.ndarray):
+    def __init__(self, req_id: str, payload: np.ndarray,
+                 slo_ms: float = 0.0, slo_class: str = "default"):
         self.id = req_id
         self.payload = payload
         self.t_submit = time.monotonic()
+        self.t_submit_ns = time.monotonic_ns()
         self.t_done: Optional[float] = None
+        self.t_done_ns = 0
+        self.slo_ms = slo_ms
+        self.slo_class = slo_class
+        # Deadline on the same clock as t_submit/t_done; 0 slo means
+        # no deadline was derivable (goodput then counts it a hit).
+        self.deadline = (self.t_submit + slo_ms / 1e3 if slo_ms > 0
+                         else float("inf"))
         self._event = threading.Event()
         self._lock = threading.Lock()
         self._value: Any = None
@@ -228,6 +337,7 @@ class ServingFuture:
                 return False
             self._value, self._error = value, error
             self.t_done = time.monotonic()
+            self.t_done_ns = time.monotonic_ns()
             self._event.set()
             return True
 
@@ -245,7 +355,7 @@ class ServingFuture:
 
 class _Batch:
     __slots__ = ("id", "requests", "bucket_b", "bucket_len",
-                 "attempts", "t_admitted")
+                 "attempts", "t_admitted", "t_admit_ns", "hops")
 
     def __init__(self, bid: str, requests: List[ServingFuture],
                  bucket_b: int, bucket_len: int):
@@ -255,6 +365,8 @@ class _Batch:
         self.bucket_len = bucket_len
         self.attempts = 0
         self.t_admitted = time.monotonic()
+        self.t_admit_ns = time.monotonic_ns()
+        self.hops: List[_Hop] = []
 
     @property
     def done(self) -> bool:
@@ -353,16 +465,33 @@ class _LocalWorker:
         import jax
         import jax.numpy as jnp
         fe = self.frontend
+        hop = fe._hop_for(batch, self.wid) if fe._trace else None
         arr = fe._pad(batch)
         x = jnp.asarray(arr)
         if self.device is not None:
             x = jax.device_put(x, self.device)
+        if hop is not None:
+            hop.t_exec0_ns = time.monotonic_ns()
+            _tracing.record("serving_exec", batch.id,
+                            seq=batch.attempts,
+                            arg=float(batch.bucket_b))
         y = np.asarray(self._get_exec(arr.shape)(x))
-        return fe._unpad(batch, y)
+        if hop is not None:
+            hop.t_exec1_ns = time.monotonic_ns()
+        rows = fe._unpad(batch, y)
+        if hop is not None:
+            hop.t_unpad1_ns = time.monotonic_ns()
+        return rows
 
 
 # ---------------------------------------------------------------------------
 # Frontend
+
+# Live frontends, for the postmortem provider below: a SIGKILLed (or
+# watchdog-dumped) process's postmortem-rank{r}.json must name the
+# requests that were in flight and their last completed phase, or a
+# death under load silently loses that attribution.
+_live_frontends: "weakref.WeakSet" = weakref.WeakSet()
 
 
 class ServingFrontend:
@@ -376,7 +505,8 @@ class ServingFrontend:
                  dtype: str = "float32", *,
                  env: Optional[Dict[str, str]] = None,
                  start_pool: bool = True,
-                 autoscale: bool = True):
+                 autoscale: bool = True,
+                 trace_tag: Optional[str] = None):
         import jax
         self._env = env
         self._forward = forward_fn
@@ -394,6 +524,13 @@ class ServingFrontend:
         self._scale_down_idle = ev("HOROVOD_SERVING_SCALE_DOWN_IDLE_S")
         self._retry_limit = ev("HOROVOD_SERVING_RETRY_LIMIT")
         self._worker_timeout = ev("HOROVOD_SERVING_WORKER_TIMEOUT_S")
+        self._trace = bool(ev("HOROVOD_SERVING_TRACE"))
+        default_slo = ev("HOROVOD_SERVING_DEFAULT_SLO_MS")
+        self._default_slo_ms = (default_slo if default_slo > 0
+                                else self._budget_s * 1e3)
+        self._trace_log: deque = deque(
+            maxlen=max(1, ev("HOROVOD_SERVING_TRACE_BUFFER")))
+        self.trace_tag = trace_tag
 
         self._lock = threading.RLock()
         self._queue_cond = threading.Condition(self._lock)
@@ -420,7 +557,16 @@ class ServingFrontend:
         self.dupes = 0
         self.scale_events = 0
 
-        _journal.configure("serving", env=env)
+        _journal.configure(f"serving-{trace_tag}" if trace_tag
+                           else "serving", env=env)
+        _journal.record(
+            "serving_meta", ladder=self.ladder.digest,
+            max_batch=self._max_batch,
+            budget_ms=round(self._budget_s * 1e3, 3),
+            trace=self._trace,
+            default_slo_ms=round(self._default_slo_ms, 3),
+            tag=trace_tag or "")
+        _live_frontends.add(self)
         self._batcher = threading.Thread(
             target=self._batch_loop, name="hvd-serving-batcher",
             daemon=True)
@@ -516,7 +662,12 @@ class ServingFrontend:
 
     # -- admission / batching -----------------------------------------------
 
-    def submit(self, x: Any) -> ServingFuture:
+    def submit(self, x: Any,
+               slo_ms: Optional[float] = None) -> ServingFuture:
+        """Enqueue one request. ``slo_ms`` sets its completion
+        deadline (and goodput class); None means the default class
+        (HOROVOD_SERVING_DEFAULT_SLO_MS, falling back to the latency
+        budget)."""
         arr = np.asarray(x, dtype=self._dtype)
         if self.ladder.len_buckets:
             want = self._feature_shape
@@ -527,16 +678,25 @@ class ServingFrontend:
         elif arr.shape != self._feature_shape:
             raise ValueError(
                 f"request shape {arr.shape} != {self._feature_shape}")
+        if slo_ms is None:
+            eff_slo, slo_class = self._default_slo_ms, "default"
+        else:
+            eff_slo = float(slo_ms)
+            slo_class = f"{eff_slo:g}ms"
         with self._lock:
             if self._closing or self._draining:
                 raise ServingError("frontend is shutting down")
             self._req_seq += 1
-            fut = ServingFuture(f"r{self._req_seq}", arr)
+            fut = ServingFuture(f"r{self._req_seq}", arr,
+                                slo_ms=eff_slo, slo_class=slo_class)
             self._queue.append(fut)
             self.submitted += 1
             self._last_nonempty = time.monotonic()
             _m_queue.set(self._pending_locked())
             self._queue_cond.notify()
+            if self._trace:
+                _tracing.record("serving_submit", fut.id,
+                                seq=self._req_seq)
         return fut
 
     def _pending_locked(self) -> int:
@@ -552,6 +712,13 @@ class ServingFrontend:
         return (time.monotonic() - oldest) >= self._budget_s
 
     def _batch_loop(self) -> None:
+        # Occupancy: the busy fraction of this (single) loop since
+        # the previous admission — everything that is not blocked in
+        # cond.wait(). Sustained ~1.0 under scale-out is the "the
+        # batcher loop is the bottleneck" signal ROADMAP item 2 asks
+        # tracing to confirm or refute.
+        win0_ns = time.monotonic_ns()
+        idle_ns = 0
         while True:
             with self._queue_cond:
                 while not self._cut_ready_locked():
@@ -562,9 +729,20 @@ class ServingFrontend:
                         wait = max(0.001, self._budget_s - (
                             time.monotonic()
                             - self._queue[0].t_submit))
+                    t0_ns = time.monotonic_ns()
                     self._queue_cond.wait(wait)
+                    idle_ns += time.monotonic_ns() - t0_ns
                 batch = self._admit_locked()
                 self._dispatch_cond.notify_all()
+            now_ns = time.monotonic_ns()
+            if now_ns > win0_ns:
+                _m_loop_occupancy.set(
+                    max(0.0, 1.0 - idle_ns / (now_ns - win0_ns)))
+            win0_ns, idle_ns = now_ns, 0
+            if self._trace:
+                _tracing.record("serving_cut", batch.id,
+                                seq=batch.attempts,
+                                arg=float(len(batch.requests)))
             _journal.record(
                 "batch_admitted", batch=batch.id,
                 size=len(batch.requests), bucket=batch.bucket_b,
@@ -613,8 +791,21 @@ class ServingFrontend:
             self._inflight[batch.id] = (
                 batch, wid,
                 time.monotonic() + self._worker_timeout)
+            if self._trace:
+                batch.hops.append(_Hop(wid, batch.attempts))
+                _tracing.record("serving_claim", batch.id,
+                                seq=batch.attempts)
             _m_queue.set(self._pending_locked())
             return batch
+
+    def _hop_for(self, batch: _Batch,
+                 wid: str) -> Optional[_Hop]:
+        """The newest dispatch attempt `wid` owns (a revenant worker
+        matches its own old hop, never the current owner's)."""
+        for hop in reversed(batch.hops):
+            if hop.worker == wid:
+                return hop
+        return None
 
     def _pad(self, batch: _Batch) -> np.ndarray:
         if batch.bucket_len:
@@ -644,14 +835,23 @@ class ServingFrontend:
     def _complete_batch(self, batch: _Batch,
                         rows: Sequence[np.ndarray],
                         wid: str) -> int:
+        t0_ns = time.monotonic_ns()
         now = time.monotonic()
         won = 0
         dup = 0
+        winners: List[ServingFuture] = []
         for req, row in zip(batch.requests, rows):
             if req._finish(value=row):
                 won += 1
+                winners.append(req)
                 _m_requests.labels(outcome="ok").inc()
                 _m_latency.observe(now - req.t_submit)
+                if req.t_done is not None \
+                        and req.t_done <= req.deadline:
+                    _m_goodput.labels(slo=req.slo_class).inc()
+                else:
+                    _m_slo_miss.labels(slo=req.slo_class,
+                                       reason="late").inc()
             else:
                 dup += 1
                 _m_dupes.inc()
@@ -670,24 +870,97 @@ class ServingFrontend:
             _m_queue.set(self._pending_locked())
             if not self._queue and not self._ready:
                 self._last_nonempty = now
+        if self._trace and won:
+            self._finalize_traces(batch, winners, wid)
+            _tracing.record("serving_done", batch.id,
+                            seq=batch.attempts, arg=float(won))
+        _m_latch_wait.set((time.monotonic_ns() - t0_ns) / 1e9)
         return won
+
+    def _finalize_traces(self, batch: _Batch,
+                         winners: Sequence[ServingFuture],
+                         wid: str) -> None:
+        """Fold the winning hop's stamps into per-request trace
+        records (ring buffer + phase histograms) and one `batch_trace`
+        journal event `doctor serve` aggregates offline."""
+        hop = self._hop_for(batch, wid)
+        if hop is None:
+            return
+        hop.outcome = "ok"
+        hops = [h.summary() for h in batch.hops]
+        recs = []
+        for req in winners:
+            phases = {
+                "batch_cut": batch.t_admit_ns - req.t_submit_ns,
+                "queue_wait": hop.t_claim_ns - batch.t_admit_ns,
+                "pad": hop.t_exec0_ns - hop.t_claim_ns,
+                "compute": hop.t_exec1_ns - hop.t_exec0_ns,
+                "unpad": hop.t_unpad1_ns - hop.t_exec1_ns,
+                "complete": req.t_done_ns - hop.t_unpad1_ns,
+            }
+            phases = {p: max(0, int(d)) for p, d in phases.items()}
+            rec = {
+                "id": req.id, "batch": batch.id, "worker": wid,
+                "attempt": batch.attempts,
+                "slo": req.slo_class,
+                "slo_ms": round(req.slo_ms, 3),
+                "outcome": ("ok" if req.t_done is not None
+                            and req.t_done <= req.deadline
+                            else "late"),
+                "t_submit_ns": req.t_submit_ns,
+                "t_done_ns": req.t_done_ns,
+                "phases_ns": phases,
+                "hops": hops,
+            }
+            recs.append(rec)
+            for phase, dns in phases.items():
+                _m_phase.labels(phase=phase).observe(dns / 1e9)
+        with self._lock:
+            self._trace_log.extend(recs)
+        _journal.record(
+            "batch_trace", batch=batch.id, worker=wid,
+            attempt=batch.attempts, bucket=batch.bucket_b,
+            size=len(winners),
+            requests=[r["id"] for r in recs],
+            slo=[r["slo"] for r in recs],
+            deadline_hit=[r["outcome"] == "ok" for r in recs],
+            submit_ns=[r["t_submit_ns"] for r in recs],
+            done_ns=[r["t_done_ns"] for r in recs],
+            admit_ns=batch.t_admit_ns, claim_ns=hop.t_claim_ns,
+            exec0_ns=hop.t_exec0_ns, exec1_ns=hop.t_exec1_ns,
+            unpad_ns=hop.t_unpad1_ns, hops=hops)
 
     def _retry(self, batch: _Batch, cause: str, wid: str) -> None:
         if batch.done:
             return
+        if self._trace:
+            hop = self._hop_for(batch, wid)
+            if hop is not None and hop.outcome == "pending":
+                hop.outcome = f"retried:{cause}"
+            _tracing.record("serving_retry", batch.id,
+                            seq=batch.attempts + 1)
         batch.attempts += 1
         if batch.attempts > self._retry_limit:
             lost = 0
+            lost_slo = []
             for req in batch.requests:
                 if req._finish(error=ServingError(
                         f"request {req.id} failed after "
                         f"{batch.attempts} dispatch attempts "
                         f"(last cause: {cause})")):
                     lost += 1
+                    lost_slo.append(req.slo_class)
                     _m_requests.labels(outcome="failed").inc()
+                    _m_slo_miss.labels(slo=req.slo_class,
+                                       reason="failed").inc()
             with self._lock:
                 self.failed += lost
                 self._batches.pop(batch.id, None)
+            _journal.record(
+                "batch_failed", batch=batch.id,
+                attempts=batch.attempts, cause=cause, worker=wid,
+                lost=lost, slo=lost_slo,
+                hops=[h.summary() for h in batch.hops])
             return
         with self._lock:
             self.retries += 1
@@ -767,6 +1040,13 @@ class ServingFrontend:
         if batch is None:
             return {"batch": None, "stop": self._closing}
         arr = self._pad(batch)
+        if self._trace:
+            # Remote compute is the pull→push round trip, wire
+            # included: pad ends (and compute begins) when the padded
+            # payload leaves this handler.
+            hop = self._hop_for(batch, wid)
+            if hop is not None:
+                hop.t_exec0_ns = time.monotonic_ns()
         return {"batch": {
             "id": batch.id,
             "shape": list(arr.shape),
@@ -786,9 +1066,14 @@ class ServingFrontend:
                 self.dupes += 1
             _m_dupes.inc()
             return {"ok": 0}
+        hop = self._hop_for(batch, wid) if self._trace else None
+        if hop is not None and not hop.t_exec1_ns:
+            hop.t_exec1_ns = time.monotonic_ns()
         y = np.asarray(req.get("outputs"), dtype=self._dtype)
-        return {"ok": self._complete_batch(
-            batch, self._unpad(batch, y), wid)}
+        rows = self._unpad(batch, y)
+        if hop is not None and not hop.t_unpad1_ns:
+            hop.t_unpad1_ns = time.monotonic_ns()
+        return {"ok": self._complete_batch(batch, rows, wid)}
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -821,6 +1106,8 @@ class ServingFrontend:
                             "frontend closed before completion")):
                         lost += 1
                         _m_requests.labels(outcome="failed").inc()
+                        _m_slo_miss.labels(slo=req.slo_class,
+                                           reason="failed").inc()
             with self._lock:
                 self.failed += lost
         with self._lock:
@@ -841,7 +1128,7 @@ class ServingFrontend:
             compiles = sum(getattr(w, "compiles", 0)
                            for w in self._workers.values())
             workers = len(self._workers)
-        return {
+        out = {
             "submitted": self.submitted,
             "completed": self.completed,
             "failed": self.failed,
@@ -858,6 +1145,150 @@ class ServingFrontend:
                 "digest": self.ladder.digest,
             },
         }
+        if self._trace:
+            out["trace"] = self.trace_digest()
+        return out
+
+    # -- trace queries --------------------------------------------------------
+
+    def traces(self) -> List[Dict[str, Any]]:
+        """The retained per-request trace records (newest last,
+        bounded by HOROVOD_SERVING_TRACE_BUFFER)."""
+        with self._lock:
+            return list(self._trace_log)
+
+    def trace_digest(self) -> Dict[str, Any]:
+        """Per-phase p50/p99/mean decomposition over the retained
+        traces, plus goodput-vs-SLO tallies — the live (in-memory)
+        view of what `doctor serve` computes offline from journals."""
+        recs = self.traces()
+        by_phase: Dict[str, List[int]] = {p: [] for p in PHASES}
+        goodput: Dict[str, Dict[str, int]] = {}
+        for rec in recs:
+            cls = goodput.setdefault(
+                rec["slo"], {"hit": 0, "late": 0, "failed": 0})
+            cls[rec["outcome"] if rec["outcome"] != "ok"
+                else "hit"] += 1
+            for p, dns in rec["phases_ns"].items():
+                if p in by_phase:
+                    by_phase[p].append(dns)
+        phases = {}
+        for p in PHASES:
+            vals = sorted(by_phase[p])
+            if not vals:
+                phases[p] = {"n": 0}
+                continue
+            phases[p] = {
+                "n": len(vals),
+                "p50_ms": round(_pct(vals, 0.50) / 1e6, 4),
+                "p99_ms": round(_pct(vals, 0.99) / 1e6, 4),
+                "mean_ms": round(sum(vals) / len(vals) / 1e6, 4),
+            }
+        return {"requests": len(recs), "phases": phases,
+                "goodput": goodput}
+
+    def write_timeline(self, path: str, rank: int = 0) -> str:
+        """Write the retained traces as Chrome-trace lanes
+        (timeline.py): one `req/<id>` lane per request with its
+        phase spans (retry hops as linked RETRY child spans carrying
+        the hop's worker/attempt/outcome args), plus one
+        `worker/<wid>` lane of EXEC spans. Returns the file written
+        (`Timeline.rank_path(path, rank)`)."""
+        from .timeline import Timeline
+        recs = self.traces()
+        dst = Timeline.rank_path(path, rank)
+        tl = Timeline(dst, rank=rank)
+        try:
+            seen_exec = set()
+            for rec in recs:
+                lane = f"req/{rec['id']}"
+                edge = rec["t_submit_ns"]
+                for p in PHASES:
+                    dns = rec["phases_ns"].get(p, 0)
+                    args = None
+                    if p == "batch_cut":
+                        args = {"batch": rec["batch"],
+                                "worker": rec["worker"],
+                                "slo": rec["slo"],
+                                "outcome": rec["outcome"]}
+                    tl.span(lane, p.upper(), edge, edge + dns,
+                            args=args)
+                    edge += dns
+                hops = rec.get("hops", [])
+                for i, (hwid, att, outcome, claim_ns) in \
+                        enumerate(hops[:-1]):
+                    nxt = hops[i + 1][3]
+                    tl.span(lane, "RETRY", claim_ns, nxt,
+                            args={"worker": hwid, "attempt": att,
+                                  "outcome": outcome,
+                                  "batch": rec["batch"]})
+                key = (rec["batch"], rec["attempt"])
+                if key not in seen_exec:
+                    seen_exec.add(key)
+                    exec0 = (rec["t_submit_ns"]
+                             + rec["phases_ns"].get("batch_cut", 0)
+                             + rec["phases_ns"].get("queue_wait", 0)
+                             + rec["phases_ns"].get("pad", 0))
+                    tl.span(f"worker/{rec['worker']}", "EXEC",
+                            exec0,
+                            exec0 + rec["phases_ns"].get(
+                                "compute", 0),
+                            args={"batch": rec["batch"],
+                                  "attempt": rec["attempt"]})
+        finally:
+            tl.close()
+        return dst
+
+    def _inflight_table(self) -> Dict[str, Any]:
+        # Postmortem provider path: deliberately lock-free (the dump
+        # may fire with self._lock held by a dying thread); dict/deque
+        # snapshots are GIL-atomic enough for a best-effort table.
+        batches = []
+        for batch in list(self._batches.values()):
+            hops = list(batch.hops)
+            last = hops[-1] if hops else None
+            if last is None:
+                phase = "queued"
+            elif last.t_unpad1_ns:
+                phase = "complete"
+            elif last.t_exec1_ns:
+                phase = "unpad"
+            elif last.t_exec0_ns:
+                phase = "compute"
+            else:
+                phase = "pad"
+            batches.append({
+                "batch": batch.id,
+                "attempts": batch.attempts,
+                "worker": last.worker if last else None,
+                "last_phase": phase,
+                "requests": [r.id for r in batch.requests],
+                "pending": sum(1 for r in batch.requests
+                               if not r.done),
+            })
+        return {
+            "tag": self.trace_tag or "",
+            "queued": [r.id for r in list(self._queue)],
+            "batches": sorted(batches, key=lambda b: b["batch"]),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Postmortem provider
+
+# Rides tracing.write_postmortem's provider hook: every postmortem
+# dump (watchdog stall, fatal signal) gets a "serving" section with
+# each live frontend's queued request ids and in-flight batches with
+# their last completed phase — the SIGKILL story the in-memory trace
+# log alone cannot tell, because it dies with the process while the
+# postmortem file survives it.
+
+
+def _postmortem_inflight() -> List[Dict[str, Any]]:
+    return [fe._inflight_table() for fe in list(_live_frontends)]
+
+
+_tracing.register_postmortem_provider("serving", _postmortem_inflight)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,406 @@
+"""Offline serving-trace analyzer: `doctor serve <dir>`.
+
+Folds the serving tier's lifecycle journals (`serving_meta` /
+`batch_admitted` / `batch_trace` / `batch_retried` / `batch_failed` /
+`scale_event`, written by serving.py when HOROVOD_SERVING_TRACE is
+on) — plus any `*.trace.json` Chrome-trace timelines sitting next to
+them — into one `serving_report.json`:
+
+- per-leg (one leg per journal role, i.e. per `trace_tag`) request
+  counts and a per-phase p50/p99/mean decomposition with each phase's
+  share of total request latency;
+- a per-worker utilization table (busy = claim→unpad per executed
+  batch) with idle-gap accounting;
+- retry chains (every re-dispatched batch's hop list and terminal
+  outcome);
+- goodput vs SLO per class (hit / late / failed);
+- when both a one-worker and a two-worker leg are present, an
+  `attribution` block decomposing the added per-request latency of
+  the 2-worker leg by phase and naming the dominant phase — the
+  measured answer to ROADMAP item 2's scale-out regression.
+
+Byte-deterministic by the incident-report protocol (journal.py):
+identical input bytes produce identical report bytes — sorted keys,
+fixed rounding, durations and journal-relative times only, no wall
+clocks, no absolute paths. The same directory can therefore hold a
+committed report that tests regenerate and byte-compare
+(benchmarks/SERVING_ATTRIBUTION_r16.json rides this).
+
+Deliberately standalone (stdlib + journal.py only): `doctor serve`
+must run on a machine that never imports jax or the serving runtime.
+"""
+
+from __future__ import annotations
+
+import glob as _glob
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import journal as _journal
+
+REPORT_SCHEMA = "serving-report-v1"
+
+# Mirrors serving.PHASES (kept in lockstep by
+# tests/test_serving_trace.py); duplicated so this module stays
+# importable without the serving runtime's jax dependency chain.
+PHASES = ("batch_cut", "queue_wait", "pad", "compute", "unpad",
+          "complete")
+
+
+def _pct(sorted_vals: Sequence[int], q: float) -> int:
+    """Nearest-rank percentile (no interpolation) — the same rule as
+    serving.ServingFrontend.trace_digest, so live and offline views
+    agree on identical samples."""
+    if not sorted_vals:
+        return 0
+    rank = max(1, int(-(-q * len(sorted_vals) // 1)))  # ceil
+    return sorted_vals[min(len(sorted_vals), rank) - 1]
+
+
+def _ms(ns: float) -> float:
+    return round(ns / 1e6, 4)
+
+
+def _phase_edges(ev: dict, i: int) -> Dict[str, int]:
+    """One request's phase durations (ns, clamped >= 0) from a
+    batch_trace event's batch-level stamps and its per-request
+    submit/done arrays."""
+    sub = int(ev["submit_ns"][i])
+    done = int(ev["done_ns"][i])
+    admit, claim = int(ev["admit_ns"]), int(ev["claim_ns"])
+    e0, e1, up = (int(ev["exec0_ns"]), int(ev["exec1_ns"]),
+                  int(ev["unpad_ns"]))
+    raw = {
+        "batch_cut": admit - sub,
+        "queue_wait": claim - admit,
+        "pad": e0 - claim,
+        "compute": e1 - e0,
+        "unpad": up - e1,
+        "complete": done - up,
+    }
+    return {p: max(0, d) for p, d in raw.items()}
+
+
+def _phase_table(per_req: List[Dict[str, int]]) -> Dict[str, Any]:
+    """p50/p99/mean/total per phase plus each phase's share of the
+    summed request latency."""
+    total_all = 0
+    sums: Dict[str, int] = {p: 0 for p in PHASES}
+    vals: Dict[str, List[int]] = {p: [] for p in PHASES}
+    for phases in per_req:
+        for p in PHASES:
+            d = phases.get(p, 0)
+            sums[p] += d
+            vals[p].append(d)
+            total_all += d
+    out: Dict[str, Any] = {}
+    for p in PHASES:
+        vs = sorted(vals[p])
+        if not vs:
+            out[p] = {"n": 0}
+            continue
+        out[p] = {
+            "n": len(vs),
+            "p50_ms": _ms(_pct(vs, 0.50)),
+            "p99_ms": _ms(_pct(vs, 0.99)),
+            "mean_ms": _ms(sums[p] / len(vs)),
+            "total_ms": _ms(sums[p]),
+            "share": (round(sums[p] / total_all, 4)
+                      if total_all else 0.0),
+        }
+    return out
+
+
+def _worker_table(traces: List[dict]) -> List[Dict[str, Any]]:
+    """Per-worker utilization over the leg: busy is the claim→unpad
+    interval of each batch the worker actually executed; idle gaps
+    are the holes between consecutive executed batches."""
+    spans: Dict[str, List[Tuple[int, int]]] = {}
+    for ev in traces:
+        wid = str(ev["worker"])
+        spans.setdefault(wid, []).append(
+            (int(ev["claim_ns"]),
+             max(int(ev["claim_ns"]), int(ev["unpad_ns"]))))
+    if not spans:
+        return []
+    t0 = min(s for sp in spans.values() for s, _ in sp)
+    t1 = max(e for sp in spans.values() for _, e in sp)
+    window = max(1, t1 - t0)
+    rows = []
+    for wid in sorted(spans):
+        iv = sorted(spans[wid])
+        busy = sum(e - s for s, e in iv)
+        gaps = [iv[k + 1][0] - iv[k][1] for k in range(len(iv) - 1)]
+        gaps = [g for g in gaps if g > 0]
+        rows.append({
+            "worker": wid,
+            "batches": len(iv),
+            "busy_ms": _ms(busy),
+            "utilization": round(busy / window, 4),
+            "idle_ms": _ms(sum(gaps)),
+            "max_idle_gap_ms": _ms(max(gaps) if gaps else 0),
+        })
+    return rows
+
+
+def _retry_chains(events: List[dict],
+                  executed: Dict[str, dict]) -> List[Dict[str, Any]]:
+    """Every batch that was re-dispatched: its hop sequence and how
+    the story ended (completed on a survivor, or failed visibly)."""
+    retried: Dict[str, List[dict]] = {}
+    failed: Dict[str, dict] = {}
+    for ev in events:
+        if ev["type"] == "batch_retried":
+            retried.setdefault(str(ev["batch"]), []).append(ev)
+        elif ev["type"] == "batch_failed":
+            failed[str(ev["batch"])] = ev
+    chains = []
+    for bid in sorted(retried, key=lambda b: (len(b), b)):
+        hops = [{"attempt": int(e.get("attempt", 0)),
+                 "cause": str(e.get("cause", "?")),
+                 "worker": str(e.get("worker", "?"))}
+                for e in sorted(retried[bid],
+                                key=lambda e: int(e.get("attempt", 0)))]
+        if bid in failed:
+            outcome = {"outcome": "failed",
+                       "lost": int(failed[bid].get("lost", 0))}
+        elif bid in executed:
+            outcome = {"outcome": "completed",
+                       "worker": str(executed[bid]["worker"]),
+                       "attempt": int(executed[bid]["attempt"])}
+        else:
+            outcome = {"outcome": "unresolved"}
+        chains.append({"batch": bid, "retries": hops, **outcome})
+    return chains
+
+
+def _goodput(traces: List[dict],
+             events: List[dict]) -> Dict[str, Dict[str, int]]:
+    classes: Dict[str, Dict[str, int]] = {}
+
+    def cls(name: str) -> Dict[str, int]:
+        return classes.setdefault(str(name),
+                                  {"hit": 0, "late": 0, "failed": 0})
+
+    for ev in traces:
+        for slo, hit in zip(ev.get("slo", []),
+                            ev.get("deadline_hit", [])):
+            cls(slo)["hit" if hit else "late"] += 1
+    for ev in events:
+        if ev["type"] == "batch_failed":
+            for slo in ev.get("slo", []):
+                cls(slo)["failed"] += 1
+    return classes
+
+
+def _timeline_sources(dir_: str) -> List[Dict[str, Any]]:
+    """`*.trace.json` Chrome-trace files next to the journals —
+    parsed torn-tolerantly (a SIGKILLed writer leaves no closing
+    bracket; every complete line before the tear still counts)."""
+    rows = []
+    for path in sorted(_glob.glob(os.path.join(dir_,
+                                               "*.trace.json"))):
+        spans = 0
+        torn = False
+        try:
+            with open(path, "r", encoding="utf-8",
+                      errors="replace") as f:
+                text = f.read()
+        except OSError:
+            continue
+        try:
+            evs = json.loads(text)
+        except ValueError:
+            torn = True
+            evs = []
+            for line in text.splitlines():
+                line = line.strip().rstrip(",").lstrip(",").strip()
+                if not line or line in "[]":
+                    continue
+                try:
+                    ev = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(ev, dict):
+                    evs.append(ev)
+        spans = sum(1 for e in evs
+                    if isinstance(e, dict) and e.get("ph") == "B")
+        rows.append({"file": os.path.basename(path),
+                     "spans": spans, "torn": torn})
+    return rows
+
+
+def _leg_report(role: str, events: List[dict]) -> Dict[str, Any]:
+    traces = [e for e in events if e["type"] == "batch_trace"]
+    executed = {str(e["batch"]): e for e in traces}
+    meta = next((e for e in events if e["type"] == "serving_meta"),
+                {})
+    per_req: List[Dict[str, int]] = []
+    totals: List[int] = []
+    for ev in traces:
+        for i in range(len(ev.get("requests", []))):
+            per_req.append(_phase_edges(ev, i))
+            totals.append(max(0, int(ev["done_ns"][i])
+                              - int(ev["submit_ns"][i])))
+    totals.sort()
+    workers = sorted({str(e["worker"]) for e in traces})
+    return {
+        "role": role,
+        "tag": str(meta.get("tag", "")),
+        "ladder": str(meta.get("ladder", "")),
+        "budget_ms": meta.get("budget_ms"),
+        "max_batch": meta.get("max_batch"),
+        "workers": workers,
+        "batches": len(traces),
+        "requests": len(per_req),
+        "latency": ({
+            "p50_ms": _ms(_pct(totals, 0.50)),
+            "p99_ms": _ms(_pct(totals, 0.99)),
+            "mean_ms": _ms(sum(totals) / len(totals)),
+        } if totals else {}),
+        "phases": _phase_table(per_req),
+        "worker_table": _worker_table(traces),
+        "retry_chains": _retry_chains(events, executed),
+        "goodput": _goodput(traces, events),
+    }
+
+
+def _attribution(legs: List[Dict[str, Any]]
+                 ) -> Optional[Dict[str, Any]]:
+    """Decompose the per-request cost of going from a one-worker to
+    a two-worker leg by phase. `added_mean_ms` is the end-to-end mean
+    delta (it can be negative when extra drain capacity hides the
+    regression); shares are of `regression_ms`, the sum of the
+    per-phase mean deltas that GREW — the phases that pay for
+    scale-out — so they stay well-defined and sum to 1 even when the
+    end-to-end mean improved. Phases that got cheaper carry their
+    negative delta_ms and a 0 share."""
+    def pick(n: int) -> Optional[Dict[str, Any]]:
+        for leg in legs:
+            if len(leg["workers"]) == n and leg["requests"]:
+                return leg
+        return None
+
+    base, scaled = pick(1), pick(2)
+    if base is None or scaled is None:
+        return None
+    added = (scaled["latency"]["mean_ms"]
+             - base["latency"]["mean_ms"])
+    deltas = {}
+    for p in PHASES:
+        b = base["phases"].get(p, {}).get("mean_ms", 0.0) or 0.0
+        s = scaled["phases"].get(p, {}).get("mean_ms", 0.0) or 0.0
+        deltas[p] = (b, s, round(s - b, 4))
+    regression = sum(d for _, _, d in deltas.values() if d > 0)
+    by_phase = {}
+    for p, (b, s, delta) in deltas.items():
+        by_phase[p] = {
+            "base_mean_ms": b, "scaled_mean_ms": s,
+            "delta_ms": delta,
+            "share": (round(delta / regression, 4)
+                      if regression > 0 and delta > 0 else 0.0),
+        }
+    ranked = sorted(by_phase,
+                    key=lambda p: (-by_phase[p]["delta_ms"], p))
+    return {
+        "base_leg": base["role"], "scaled_leg": scaled["role"],
+        "base_mean_ms": base["latency"]["mean_ms"],
+        "scaled_mean_ms": scaled["latency"]["mean_ms"],
+        "added_mean_ms": round(added, 4),
+        "regression_ms": round(regression, 4),
+        "by_phase": by_phase,
+        "dominant_phase": ranked[0],
+        "dominant_share": by_phase[ranked[0]]["share"],
+        "top2": [{"phase": p, "share": by_phase[p]["share"]}
+                 for p in ranked[:2]],
+    }
+
+
+def serving_report(dir_: str) -> Dict[str, Any]:
+    """The byte-deterministic analyzer result (see module doc)."""
+    events, sources = _journal.load_journals(dir_)
+    by_role: Dict[str, List[dict]] = {}
+    for e in events:
+        role = str(e.get("role", "?"))
+        if role.startswith("serving"):
+            by_role.setdefault(role, []).append(e)
+    if not any(e["type"] == "batch_trace"
+               for evs in by_role.values() for e in evs):
+        raise ValueError(
+            f"no serving batch_trace events under {dir_!r} — was the "
+            "run recorded with HOROVOD_SERVING_TRACE=1 and "
+            "HOROVOD_JOURNAL_DIR set?")
+    legs = [_leg_report(role, by_role[role])
+            for role in sorted(by_role)]
+    report = {
+        "schema": REPORT_SCHEMA,
+        "legs": legs,
+        "sources": sources,
+        "timelines": _timeline_sources(dir_),
+    }
+    attribution = _attribution(legs)
+    if attribution is not None:
+        report["attribution"] = attribution
+    return report
+
+
+def write_serving_report(dir_: str, out: Optional[str] = None
+                         ) -> Tuple[str, Dict[str, Any]]:
+    report = serving_report(dir_)
+    path = out or os.path.join(dir_, "serving_report.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path, report
+
+
+def render_serving_report(report: Dict[str, Any]) -> str:
+    """Human-readable summary for the doctor CLI."""
+    lines = []
+    for leg in report["legs"]:
+        lat = leg["latency"]
+        lines.append(
+            f"leg {leg['role']}: {leg['requests']} requests / "
+            f"{leg['batches']} batches on "
+            f"{len(leg['workers'])} worker(s)"
+            + (f"  p50 {lat['p50_ms']} ms  p99 {lat['p99_ms']} ms"
+               if lat else ""))
+        for p in PHASES:
+            row = leg["phases"].get(p, {})
+            if not row.get("n"):
+                continue
+            lines.append(
+                f"    {p:<10} p50 {row['p50_ms']:>9} ms  "
+                f"p99 {row['p99_ms']:>9} ms  "
+                f"share {100 * row['share']:5.1f}%")
+        for w in leg["worker_table"]:
+            lines.append(
+                f"    worker {w['worker']}: {w['batches']} batches, "
+                f"util {100 * w['utilization']:.1f}%, "
+                f"max idle gap {w['max_idle_gap_ms']} ms")
+        for ch in leg["retry_chains"]:
+            hops = " -> ".join(
+                f"{h['worker']}#{h['attempt']}({h['cause']})"
+                for h in ch["retries"])
+            lines.append(f"    retry {ch['batch']}: {hops} -> "
+                         f"{ch['outcome']}")
+        for cls in sorted(leg["goodput"]):
+            g = leg["goodput"][cls]
+            lines.append(
+                f"    slo {cls}: hit {g['hit']}  late {g['late']}  "
+                f"failed {g['failed']}")
+    attr = report.get("attribution")
+    if attr:
+        lines.append(
+            f"attribution ({attr['base_leg']} -> "
+            f"{attr['scaled_leg']}): {attr['added_mean_ms']:+g} ms "
+            f"per request end-to-end, "
+            f"{attr['regression_ms']:+g} ms of phase-level "
+            f"regression; dominant phase {attr['dominant_phase']} "
+            f"({100 * attr['dominant_share']:.1f}% of the "
+            "regression)")
+        lines.append("  top2: " + ", ".join(
+            f"{t['phase']} {100 * t['share']:.1f}%"
+            for t in attr["top2"]))
+    return "\n".join(lines)

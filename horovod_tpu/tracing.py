@@ -622,6 +622,22 @@ def _runtime_tables() -> Dict[str, Any]:
     return out
 
 
+# Subsystems with their own in-flight state (the serving frontend's
+# request table) register a named section here; every dump calls each
+# provider best-effort so a SIGKILLed worker's batch still leaves a
+# per-request trace in postmortem-rank{r}.json. Registration is
+# idempotent by name (module re-imports replace, never duplicate).
+_pm_providers: Dict[str, Callable[[], Any]] = {}
+
+
+def register_postmortem_provider(name: str,
+                                 fn: Callable[[], Any]) -> None:
+    """Add a `name` section to every postmortem dump, produced by
+    `fn()` at dump time. Providers must not take runtime locks — a
+    dump may fire while they are held."""
+    _pm_providers[name] = fn
+
+
 def write_postmortem(reason: str, trigger: str = "manual",
                      path: Optional[str] = None) -> Optional[str]:
     """Dump the flight recorder + runtime introspection to
@@ -649,6 +665,11 @@ def write_postmortem(reason: str, trigger: str = "manual",
             "ring": [[ts, kind, name, seq, arg] for
                      (ts, kind, name, seq, arg) in ring_events()],
         }
+        for pname, provider in sorted(_pm_providers.items()):
+            try:
+                doc[pname] = provider()
+            except Exception as e:  # noqa: BLE001 — dump never fails
+                doc[pname] = {"error": str(e)}
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump(doc, f, indent=1, sort_keys=True, default=str)

@@ -1,0 +1,69 @@
+"""HVD004 fixture: serving request-lifecycle tracing inside the
+traced forward (round 16).
+
+The tracing plane's contract is that phase stamps, ring records,
+timeline spans, and phase-histogram observations all happen in the
+UNTRACED dispatch/completion path around the AOT-compiled forward.
+These positives are the tempting wrong version — stamping phases
+from inside the forward itself — which would brand one trace-time
+stamp into the executable per (re)trace; the negatives are the
+completion-path shape serving.py actually uses.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu import tracing
+from horovod_tpu.metrics import REGISTRY
+from horovod_tpu.timeline import Timeline
+
+_m_fix_phase = REGISTRY.histogram(
+    "hvdfix_serving_phase_seconds",
+    "Seeded serving trace-impurity target.")
+
+
+@jax.jit
+def forward_observes_phase(x):
+    _m_fix_phase.observe(0.001)  # EXPECT: HVD004
+    return jnp.tanh(x)
+
+
+@jax.jit
+def forward_stamps_clock(x):
+    t0 = time.monotonic_ns()  # EXPECT: HVD004
+    return x * (t0 % 2)
+
+
+@jax.jit
+def forward_records_ring(x):
+    tracing.record("serving_exec", "b1")  # EXPECT: HVD004
+    return x * 2
+
+
+def forward_spans_timeline(tl: Timeline):
+    @jax.jit
+    def fwd(x):
+        tl.span("req/r1", "COMPUTE", 0, 1)  # EXPECT: HVD004
+        return x + 1
+    return fwd
+
+
+# -- negatives: the completion-path shape serving.py actually uses ---------
+
+@jax.jit
+def pure_forward(x):
+    return jnp.tanh(x)
+
+
+def complete_batch_effects_outside_trace(x, tl: Timeline):
+    # stamps, ring record, phase observation and timeline span wrap
+    # the compiled forward from plain python — the intended split
+    t0 = time.monotonic_ns()
+    tracing.record("serving_exec", "b2")
+    y = pure_forward(x)
+    t1 = time.monotonic_ns()
+    _m_fix_phase.observe((t1 - t0) / 1e9)
+    tl.span("req/r2", "COMPUTE", t0, t1)
+    return y

@@ -161,6 +161,9 @@ def test_all_serving_knobs_declared():
         "HOROVOD_SERVING_SCALE_DOWN_IDLE_S": 5.0,
         "HOROVOD_SERVING_RETRY_LIMIT": 3,
         "HOROVOD_SERVING_WORKER_TIMEOUT_S": 30.0,
+        "HOROVOD_SERVING_TRACE": True,
+        "HOROVOD_SERVING_TRACE_BUFFER": 4096,
+        "HOROVOD_SERVING_DEFAULT_SLO_MS": 0.0,
     }
     for name, default in expected.items():
         assert name in declared, name

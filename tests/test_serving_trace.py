@@ -1,0 +1,523 @@
+"""Serving request-lifecycle tracing tests: phase-stamp monotonicity
+on the happy path, retry-hop linkage under an injected mid-batch
+worker death, SLO goodput counting (hit / late / failed), the
+disarmed fast path (HOROVOD_SERVING_TRACE=0 leaves no trace state and
+the submit seam stays one load+compare), the postmortem in-flight
+provider, `doctor serve` byte-determinism + torn-file tolerance + the
+CLI exit contract, and the committed r16 attribution artifact's pins
+(byte-identical regeneration from the committed trace recording via
+both the library and `bench.py --serving-attribution`)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu import faults, journal, serving_trace, tracing
+from horovod_tpu.runner import doctor
+from horovod_tpu.serving import ServingError, ServingFrontend
+from horovod_tpu.serving import PHASES as LIVE_PHASES
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RECORD_DIR = os.path.join(REPO, "benchmarks", "serving_trace_r16")
+ATTRIBUTION = os.path.join(REPO, "benchmarks",
+                           "SERVING_ATTRIBUTION_r16.json")
+BENCH_SERVING = os.path.join(REPO, "benchmarks",
+                             "BENCH_serving_r16.json")
+TRAJECTORY = os.path.join(REPO, "benchmarks", "BENCH_trajectory.json")
+
+D = 8  # feature width used by every frontend in this file
+
+# The stamp order every winning hop must respect; phase p is the
+# interval ending at EDGE[i+1] (see serving.PHASES).
+EDGES = ("admit_ns", "claim_ns", "exec0_ns", "exec1_ns", "unpad_ns")
+
+
+def _forward(x):
+    import jax.numpy as jnp
+    return jnp.tanh(x) * 2.0
+
+
+def _expect(x):
+    return np.tanh(np.asarray(x, dtype=np.float32)) * 2.0
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_and_journal_state():
+    """Frontends (re)configure the module journal and tests arm the
+    fault plan; restore both so state never leaks across tests."""
+    yield
+    faults.configure("", seed=0)
+    if journal._journal is not None:
+        journal._journal.close()
+    journal._journal = None
+
+
+def _base_env(tmp_path=None, **over):
+    env = {
+        "HOROVOD_SERVING_MAX_BATCH": "4",
+        "HOROVOD_SERVING_LATENCY_BUDGET_MS": "5",
+        "HOROVOD_SERVING_MIN_WORKERS": "1",
+        "HOROVOD_SERVING_MAX_WORKERS": "4",
+        "HOROVOD_SERVING_SCALE_INTERVAL_S": "0.05",
+        "HOROVOD_SERVING_WORKER_TIMEOUT_S": "30",
+    }
+    if tmp_path is not None:
+        jdir = os.path.join(str(tmp_path), "journal")
+        os.makedirs(jdir, exist_ok=True)
+        env["HOROVOD_JOURNAL_DIR"] = jdir
+    env.update({k: str(v) for k, v in over.items()})
+    return env
+
+
+def _journal_events(tmp_path, role="serving"):
+    path = os.path.join(str(tmp_path), "journal",
+                        f"journal-{role}.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def _wait_journal_traces(tmp_path, n, role="serving"):
+    """Poll until the journal's batch_trace events cover n requests.
+    `result()` unblocks the submitter BEFORE the worker thread folds
+    the batch's stamps into the trace log + journal, so readers must
+    wait for the records, not the futures."""
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        evs = _journal_events(tmp_path, role)
+        if sum(e["size"] for e in evs
+               if e["type"] == "batch_trace") >= n:
+            return evs
+        time.sleep(0.01)
+    pytest.fail(f"journal never reached {n} traced requests")
+
+
+def _run_leg(tmp_path, n=8, workers=1, tag=None, slo_ms=None):
+    """One traced serving leg: n requests through `workers` local
+    workers, every result checked; returns the frontend's retained
+    trace records and final stats."""
+    env = _base_env(tmp_path)
+    fe = ServingFrontend(_forward, (D,), env=env, start_pool=False,
+                         autoscale=False, trace_tag=tag)
+    try:
+        fe.start_pool(workers)
+        rng = np.random.RandomState(16)
+        xs = [rng.randn(D).astype(np.float32) for _ in range(n)]
+        futs = [fe.submit(x, slo_ms=slo_ms) for x in xs]
+        for x, f in zip(xs, futs):
+            np.testing.assert_allclose(f.result(timeout=60),
+                                       _expect(x),
+                                       rtol=1e-5, atol=1e-5)
+        _wait_journal_traces(tmp_path, n,
+                             role=f"serving-{tag}" if tag
+                             else "serving")
+        recs = fe.traces()
+        stats = fe.stats()
+    finally:
+        fe.close()
+    return recs, stats
+
+
+# -- phase stamps ----------------------------------------------------------
+
+
+class TestPhaseStamps:
+    def test_phase_names_lockstep_with_offline_analyzer(self):
+        """serving_trace.py duplicates PHASES to stay importable
+        without jax; the two tuples must never drift."""
+        assert LIVE_PHASES == serving_trace.PHASES
+
+    def test_stamps_monotonic_and_phases_telescope(self, tmp_path):
+        recs, stats = _run_leg(tmp_path, n=8)
+        assert len(recs) == 8 and stats["dropped"] == 0
+        for rec in recs:
+            phases = rec["phases_ns"]
+            assert set(phases) == set(LIVE_PHASES)
+            assert all(d >= 0 for d in phases.values())
+            # no retry: the stamps are taken in program order, so the
+            # phases telescope exactly to the end-to-end latency
+            assert sum(phases.values()) == \
+                rec["t_done_ns"] - rec["t_submit_ns"], rec
+            assert rec["hops"] and rec["hops"][-1][2] == "ok"
+        evs = _journal_events(tmp_path)
+        traces = [e for e in evs if e["type"] == "batch_trace"]
+        assert traces and sum(e["size"] for e in traces) == 8
+        for ev in traces:
+            stamps = [int(ev[k]) for k in EDGES]
+            assert stamps == sorted(stamps), ev
+            for sub, done in zip(ev["submit_ns"], ev["done_ns"]):
+                assert sub <= int(ev["admit_ns"])
+                assert int(ev["unpad_ns"]) <= done
+
+    def test_stats_carries_live_digest(self, tmp_path):
+        recs, stats = _run_leg(tmp_path, n=6)
+        dig = stats["trace"]
+        assert dig["requests"] == 6
+        for p in LIVE_PHASES:
+            row = dig["phases"][p]
+            assert row["n"] == 6
+            assert 0 <= row["p50_ms"] <= row["p99_ms"]
+
+
+# -- retry-hop linkage -----------------------------------------------------
+
+
+class TestRetryHopLinkage:
+    def test_mid_batch_kill_links_hops(self, tmp_path):
+        """An injected worker death mid-batch must show up in the
+        winning trace record as a CHAIN of hops — the killed attempt
+        marked retried:<cause>, the survivor's marked ok — with the
+        journal's batch_retried event naming the same batch."""
+        env = _base_env(tmp_path)
+        fe = ServingFrontend(_forward, (D,), env=env,
+                             start_pool=False, autoscale=False)
+        try:
+            fe.start_pool(2)
+            faults.configure("serving.batch:error:at=2", seed=0)
+            rng = np.random.RandomState(3)
+            xs = [rng.randn(D).astype(np.float32) for _ in range(12)]
+            futs = [fe.submit(x) for x in xs]
+            for x, f in zip(xs, futs):
+                np.testing.assert_allclose(f.result(timeout=60),
+                                           _expect(x),
+                                           rtol=1e-5, atol=1e-5)
+            faults.configure("", seed=0)
+            _wait_journal_traces(tmp_path, 12)
+            recs = fe.traces()
+            stats = fe.stats()
+        finally:
+            fe.close()
+        assert stats["retries"] >= 1 and stats["dropped"] == 0
+        retried = [r for r in recs if len(r["hops"]) >= 2]
+        assert retried, "no trace record carries the retry chain"
+        for rec in retried:
+            assert rec["attempt"] >= 1
+            outcomes = [h[2] for h in rec["hops"]]
+            assert outcomes[-1] == "ok"
+            assert any(o.startswith("retried:fault_error")
+                       for o in outcomes[:-1]), outcomes
+            # hop stamps: each hop is claimed after its predecessor
+            claims = [h[3] for h in rec["hops"]]
+            assert claims == sorted(claims)
+        evs = _journal_events(tmp_path)
+        jr = [e for e in evs if e["type"] == "batch_retried"]
+        assert jr and jr[0]["batch"] in {r["batch"] for r in retried}
+        # the journaled batch_trace for the retried batch carries the
+        # full hop list too (doctor serve rebuilds chains from it)
+        jt = [e for e in evs if e["type"] == "batch_trace"
+              and e["batch"] == jr[0]["batch"]]
+        assert jt and len(jt[0]["hops"]) >= 2
+
+
+# -- SLO goodput -----------------------------------------------------------
+
+
+class TestSloGoodput:
+    def test_generous_slo_counts_hit(self, tmp_path):
+        recs, stats = _run_leg(tmp_path, n=4, slo_ms=60000)
+        assert all(r["slo"] == "60000ms" and r["outcome"] == "ok"
+                   for r in recs)
+        assert stats["trace"]["goodput"]["60000ms"] == \
+            {"hit": 4, "late": 0, "failed": 0}
+
+    def test_impossible_slo_counts_late(self, tmp_path):
+        recs, stats = _run_leg(tmp_path, n=4, slo_ms=0.001)
+        assert all(r["slo"] == "0.001ms" and r["outcome"] == "late"
+                   for r in recs)
+        assert stats["trace"]["goodput"]["0.001ms"]["late"] == 4
+
+    def test_retry_exhaustion_counts_failed(self, tmp_path):
+        """A visibly-failed request lands in the journal's
+        batch_failed event with its SLO class, and doctor serve folds
+        it into the goodput table's `failed` column."""
+        env = _base_env(tmp_path, HOROVOD_SERVING_RETRY_LIMIT="1",
+                        HOROVOD_SERVING_SCALE_INTERVAL_S="0.02")
+        # autoscale on: each injected death empties the pool, and the
+        # floor-restore is what re-dispatches the doomed batch
+        fe = ServingFrontend(_forward, (D,), env=env,
+                             start_pool=False, autoscale=True)
+        try:
+            fe.start_pool(1)
+            ok = fe.submit(np.ones(D, np.float32), slo_ms=60000)
+            ok.result(timeout=60)
+            _wait_journal_traces(tmp_path, 1)
+            faults.configure("serving.batch:error", seed=0)
+            doomed = fe.submit(np.ones(D, np.float32), slo_ms=60000)
+            with pytest.raises(ServingError):
+                doomed.result(timeout=60)
+            faults.configure("", seed=0)
+        finally:
+            faults.configure("", seed=0)
+            fe.close()
+        evs = _journal_events(tmp_path)
+        failed = [e for e in evs if e["type"] == "batch_failed"]
+        assert failed and failed[0]["slo"] == ["60000ms"]
+        assert failed[0]["lost"] == 1 and len(failed[0]["hops"]) >= 2
+        report = serving_trace.serving_report(
+            os.path.join(str(tmp_path), "journal"))
+        good = report["legs"][0]["goodput"]["60000ms"]
+        assert good["hit"] == 1 and good["failed"] == 1
+
+
+# -- disarmed fast path ----------------------------------------------------
+
+
+class TestDisarmedFastPath:
+    def test_trace_off_leaves_no_state(self, tmp_path):
+        ring_before = sum(1 for e in tracing.ring_events()
+                          if str(e[1]).startswith("serving_"))
+        env = _base_env(tmp_path, HOROVOD_SERVING_TRACE="0")
+        fe = ServingFrontend(_forward, (D,), env=env,
+                             start_pool=False, autoscale=False)
+        try:
+            fe.start_pool(1)
+            futs = [fe.submit(np.ones(D, np.float32))
+                    for _ in range(8)]
+            for f in futs:
+                f.result(timeout=60)
+            assert fe.traces() == []
+            stats = fe.stats()
+        finally:
+            fe.close()
+        assert "trace" not in stats
+        assert not [e for e in _journal_events(tmp_path)
+                    if e["type"] == "batch_trace"]
+        ring_after = sum(1 for e in tracing.ring_events()
+                         if str(e[1]).startswith("serving_"))
+        assert ring_after == ring_before
+
+    def test_disarmed_seam_overhead(self, tmp_path):
+        """Same shape as the faults/metrics fast-path guards: with
+        tracing off, every seam on the submit/dispatch/completion
+        path is one instance-attribute load + compare
+        (`if self._trace:`). Generous bound for a loaded CI host."""
+        env = _base_env(tmp_path, HOROVOD_SERVING_TRACE="0")
+        fe = ServingFrontend(_forward, (D,), env=env,
+                             start_pool=False, autoscale=False)
+        try:
+            assert fe._trace is False
+            n = 50000
+            t0 = time.perf_counter()
+            for _ in range(n):
+                if fe._trace:
+                    pytest.fail("trace armed")
+            per_call = (time.perf_counter() - t0) / n
+        finally:
+            fe.close()
+        assert per_call < 20e-6, f"{per_call * 1e6:.2f} us/call"
+
+
+# -- postmortem in-flight provider -----------------------------------------
+
+
+class TestPostmortemProvider:
+    def test_dump_carries_inflight_requests(self, tmp_path):
+        """A postmortem dump (the SIGKILL story) must list each live
+        frontend's queued request ids and in-flight batches with the
+        last completed phase — state the in-memory trace log cannot
+        tell because it dies with the process."""
+        env = _base_env(tmp_path)
+        fe = ServingFrontend(_forward, (D,), env=env,
+                             start_pool=False, autoscale=False,
+                             trace_tag="pm-test")
+        try:
+            ids = [fe.submit(np.ones(D, np.float32)).id
+                   for _ in range(3)]
+            deadline = time.monotonic() + 5
+            while fe.admitted == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)  # let the batcher cut (5 ms budget)
+            path = tracing.write_postmortem(
+                "unit test", trigger="manual",
+                path=os.path.join(str(tmp_path), "pm.json"))
+            assert path is not None
+            with open(path) as f:
+                doc = json.load(f)
+            tables = [t for t in doc["serving"]
+                      if t["tag"] == "pm-test"]
+            assert tables, doc.get("serving")
+            tab = tables[0]
+            listed = set(tab["queued"])
+            for b in tab["batches"]:
+                # never claimed (no workers): stuck before dispatch
+                assert b["last_phase"] == "queued"
+                assert b["pending"] == len(b["requests"])
+                listed.update(b["requests"])
+            assert listed == set(ids)
+        finally:
+            fe.close(timeout=0.2)  # no workers: fail the stragglers
+
+
+# -- doctor serve ----------------------------------------------------------
+
+
+def _recorded_run(tmp_path, tag="det"):
+    """A traced leg recorded the way bench.py records: journals under
+    <tmp>/journal plus the frontend's Chrome-trace timeline sitting
+    next to them. Returns the journal dir."""
+    env = _base_env(tmp_path)
+    jdir = env["HOROVOD_JOURNAL_DIR"]
+    fe = ServingFrontend(_forward, (D,), env=env, start_pool=False,
+                         autoscale=False, trace_tag=tag)
+    try:
+        fe.start_pool(1)
+        futs = [fe.submit(np.ones(D, np.float32)) for _ in range(6)]
+        for f in futs:
+            f.result(timeout=60)
+        _wait_journal_traces(tmp_path, 6, role=f"serving-{tag}")
+        fe.write_timeline(os.path.join(jdir,
+                                       f"serving-{tag}.trace.json"))
+    finally:
+        fe.close()
+    journal._journal.close()
+    journal._journal = None
+    return jdir
+
+
+class TestDoctorServe:
+    def test_report_byte_determinism(self, tmp_path):
+        d = _recorded_run(tmp_path)
+        p1, _ = serving_trace.write_serving_report(
+            d, out=os.path.join(str(tmp_path), "r1.json"))
+        p2, _ = serving_trace.write_serving_report(
+            d, out=os.path.join(str(tmp_path), "r2.json"))
+        b1 = open(p1, "rb").read()
+        assert b1 == open(p2, "rb").read()
+        raw = b1.decode()
+        # incident-report protocol: no environment-dependent content
+        assert str(tmp_path) not in raw
+        assert "unix_time" not in raw
+        report = json.loads(raw)
+        (leg,) = report["legs"]
+        assert leg["tag"] == "det" and leg["requests"] == 6
+        assert leg["workers"] == ["w0"]
+        assert report["timelines"][0]["file"] == \
+            "serving-det.trace.json"
+        assert report["timelines"][0]["spans"] >= 6
+        assert report["timelines"][0]["torn"] is False
+
+    def test_torn_files_tolerated(self, tmp_path):
+        """A SIGKILL mid-write leaves a torn journal tail and an
+        unclosed trace.json; the analyzer must fold every complete
+        line and say what it repaired."""
+        d = _recorded_run(tmp_path, tag="torn")
+        (jpath,) = [os.path.join(d, f) for f in os.listdir(d)
+                    if f.startswith("journal-")]
+        with open(jpath, "a") as f:
+            f.write('{"type": "batch_tr')  # torn mid-record
+        tpath = os.path.join(d, "serving-torn.trace.json")
+        data = open(tpath, "rb").read()
+        with open(tpath, "wb") as f:
+            f.write(data[:len(data) * 2 // 3])  # no closing bracket
+        report = serving_trace.serving_report(d)
+        (src,) = report["sources"]
+        assert src["repaired_tail_lines"] >= 1
+        (tl,) = report["timelines"]
+        assert tl["torn"] is True and tl["spans"] >= 1
+        assert report["legs"][0]["requests"] == 6
+
+    def test_cli_exit_contract(self, tmp_path, capsys):
+        d = _recorded_run(tmp_path)
+        assert doctor.main(["serve", d]) == 0
+        out = capsys.readouterr().out
+        assert "report:" in out and "leg serving-det" in out
+        assert os.path.exists(os.path.join(d, "serving_report.json"))
+        # a dir with no journals is a clean failure, not a traceback
+        empty = os.path.join(str(tmp_path), "empty")
+        os.makedirs(empty)
+        assert doctor.main(["serve", empty]) == 1
+        assert "doctor serve:" in capsys.readouterr().out
+        assert doctor.main(
+            ["serve", os.path.join(str(tmp_path), "nope")]) == 1
+        assert "doctor serve:" in capsys.readouterr().out
+
+
+# -- committed r16 artifacts -----------------------------------------------
+
+
+class TestCommittedAttribution:
+    """The acceptance pin: SERVING_ATTRIBUTION_r16.json regenerates
+    byte-identically from the committed trace recording
+    (benchmarks/serving_trace_r16/) via BOTH the analyzer library and
+    `bench.py --serving-attribution`, and names the dominant phase of
+    the 1->2-worker scale-out regression with its measured share."""
+
+    def test_regenerates_byte_identically(self, tmp_path):
+        out = os.path.join(str(tmp_path), "regen.json")
+        serving_trace.write_serving_report(RECORD_DIR, out=out)
+        want = open(ATTRIBUTION, "rb").read()
+        assert open(out, "rb").read() == want
+        # the recording's in-dir report is the same bytes too
+        assert open(os.path.join(RECORD_DIR, "serving_report.json"),
+                    "rb").read() == want
+
+    @pytest.mark.integration
+    def test_bench_cli_regenerates_byte_identically(self, tmp_path):
+        out = os.path.join(str(tmp_path), "attr.json")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = REPO
+        env["BENCH_SERVING_ATTRIBUTION_OUT"] = out
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--serving-attribution"],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=300)
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert open(out, "rb").read() == \
+            open(ATTRIBUTION, "rb").read()
+        last = json.loads(r.stdout.strip().splitlines()[-1])
+        assert last["metric"] == "serving_attribution_dominant_share"
+        assert last["value"] >= 0.5
+
+    def test_attribution_acceptance(self):
+        report = json.load(open(ATTRIBUTION))
+        assert report["schema"] == serving_trace.REPORT_SCHEMA
+        attr = report["attribution"]
+        assert attr["base_leg"] == "serving-w1"
+        assert attr["scaled_leg"] == "serving-w2"
+        # the measured answer to ROADMAP item 2: the single-threaded
+        # admission loop, not compute, pays for the second worker
+        assert attr["dominant_phase"] == "batch_cut"
+        assert attr["dominant_share"] >= 0.5
+        assert len(attr["top2"]) == 2
+        shares = [p["share"] for p in attr["by_phase"].values()
+                  if p["share"] > 0]
+        assert sum(shares) == pytest.approx(1.0, abs=0.01)
+        # shares are of the phase-level regression, which stays
+        # well-defined even when extra drain capacity hides the
+        # end-to-end delta
+        assert attr["regression_ms"] > 0
+        legs = {leg["role"]: leg for leg in report["legs"]}
+        assert set(legs) == {"serving-w1", "serving-w2"}
+        assert len(legs["serving-w2"]["workers"]) == 2
+        for leg in legs.values():
+            assert leg["requests"] == 256
+
+    def test_bench_serving_doc_pins(self):
+        doc = json.load(open(BENCH_SERVING))
+        attr = json.load(open(ATTRIBUTION))["attribution"]
+        assert doc["attribution"]["dominant_phase"] == \
+            attr["dominant_phase"]
+        assert doc["attribution"]["dominant_share"] == \
+            attr["dominant_share"]
+        assert doc["retry"]["dropped"] == 0
+        for leg in ("workers1", "workers2"):
+            trace = doc["serving_trace"][leg]
+            assert trace["requests"] == 256
+            assert set(trace["phases"]) == set(LIVE_PHASES)
+
+    def test_trajectory_row(self):
+        traj = json.load(open(TRAJECTORY))
+        row = traj["r16_serving_attribution"]
+        attr = json.load(open(ATTRIBUTION))["attribution"]
+        assert row["dominant_phase"] == attr["dominant_phase"]
+        assert row["dominant_share"] == attr["dominant_share"]
+        assert row["added_mean_ms_1to2_workers"] == \
+            attr["added_mean_ms"]
+        assert row["source"] == "benchmarks/SERVING_ATTRIBUTION_r16.json"
