@@ -1898,6 +1898,289 @@ def serving_main() -> None:
         "unit": "ms", "vs_baseline": 1.0}), flush=True)
 
 
+def weight_swap_main() -> None:
+    """`--weight-swap`: measure the train-to-serve live weight
+    pipeline (horovod_tpu/weights.py + serving.py adoption) on this
+    host and write benchmarks/BENCH_weightswap_r17.json — a rolling
+    update under live traffic (per-worker swap latency, request p99
+    DURING the swap window vs the SLO budget, the staleness curve,
+    and the epoch-fence check over the journaled batch traces: no
+    served batch mixes weight versions), a chaos leg (a worker death
+    mid-swap via the weights.adopt seam AND a corrupt publication
+    that every worker must reject while still serving the previous
+    digest, then a clean republish that converges the pool), and a
+    verified rollback — zero dropped requests across all of it is
+    the acceptance bar."""
+    import shutil
+    import tempfile
+
+    from horovod_tpu import faults as hfaults
+    from horovod_tpu import journal as hjournal
+    from horovod_tpu import serving as hserving
+    from horovod_tpu import weights as hweights
+    from horovod_tpu.metrics import REGISTRY as _REG
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.environ.get("BENCH_WEIGHTSWAP_OUT") or os.path.join(
+        here, "benchmarks", "BENCH_weightswap_r17.json")
+    slo_budget_ms = float(os.environ.get(
+        "BENCH_WEIGHTSWAP_SLO_MS", "250"))
+    d_model = int(os.environ.get("BENCH_WEIGHTSWAP_DMODEL", "128"))
+    scratch = tempfile.mkdtemp(prefix="bench-weightswap-")
+
+    def make_params(seed):
+        rng = np.random.RandomState(seed)
+        return {
+            "w1": jnp.asarray(rng.randn(d_model, 2 * d_model) * 0.05,
+                              jnp.float32),
+            "w2": jnp.asarray(rng.randn(2 * d_model, d_model) * 0.05,
+                              jnp.float32),
+        }
+
+    def forward(params, x):
+        return jnp.tanh(x @ params["w1"]) @ params["w2"]
+
+    senv = dict(os.environ)
+    senv.update({
+        "HOROVOD_SERVING_MAX_BATCH": "8",
+        "HOROVOD_SERVING_LATENCY_BUDGET_MS": "5",
+        "HOROVOD_SERVING_MIN_WORKERS": "2",
+        "HOROVOD_SERVING_MAX_WORKERS": "4",
+        "HOROVOD_SERVING_SCALE_INTERVAL_S": "0.05",
+        "HOROVOD_SERVING_WORKER_TIMEOUT_S": "5",
+        "HOROVOD_WEIGHTS_POLL_MS": "25",
+    })
+    rng = np.random.RandomState(0)
+
+    def wait_for(pred, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.02)
+        return pred()
+
+    def pool_on(fe, digest):
+        w = fe.stats()["weights"]["workers"]
+        return bool(w) and all(i["digest"] == digest
+                               for i in w.values())
+
+    # -- leg 1: rolling update under live traffic -------------------
+    wdir = os.path.join(scratch, "rolling")
+    jdir = os.path.join(scratch, "rolling-journal")
+    os.makedirs(jdir)
+    boot = make_params(1)
+    pub = hweights.WeightPublisher(wdir, env=senv)
+    v1 = pub.publish(boot, step=100)
+    env = dict(senv)
+    env["HOROVOD_JOURNAL_DIR"] = jdir
+    env["HOROVOD_SERVING_TRACE"] = "1"
+    fe = hserving.ServingFrontend(
+        forward, (d_model,), env=env, autoscale=False,
+        trace_tag="weightswap", params=boot, weights=wdir)
+    # The bootstrap tree IS v1's content (same digest), so gate on
+    # actual adoptions — both workers through their first fence pass
+    # — and push a warm burst through so AOT warmup never pollutes
+    # the measured window.
+    wait_for(lambda: fe.stats()["weights"]["swaps"] >= 2)
+    for f in [fe.submit(rng.randn(d_model)) for _ in range(16)]:
+        f.result(timeout=60)
+    v2 = make_params(2)
+    futs = []
+    t_pub = None
+    t_conv = None
+    staleness_curve = []
+    n_requests = 400
+    for i in range(n_requests):
+        futs.append((time.monotonic(),
+                     fe.submit(rng.randn(d_model))))
+        if i == n_requests // 4:
+            t_pub = time.monotonic()
+            v2 = pub.publish(v2, step=200)
+        if t_pub is not None and t_conv is None and i % 10 == 0:
+            w = fe.stats()["weights"]["workers"]
+            staleness_curve.append({
+                "t_ms": round(1e3 * (time.monotonic() - t_pub), 1),
+                "staleness_steps": max(
+                    [i_["staleness_steps"] for i_ in w.values()]
+                    or [0]),
+            })
+            if pool_on(fe, v2.digest):
+                t_conv = time.monotonic()
+        time.sleep(0.002)
+    for _, f in futs:
+        f.result(timeout=60)
+    if t_conv is None:
+        wait_for(lambda: pool_on(fe, v2.digest))
+        t_conv = time.monotonic()
+    staleness_curve.append({
+        "t_ms": round(1e3 * (t_conv - t_pub), 1),
+        "staleness_steps": 0})
+    # p99 over the requests submitted inside the swap window
+    swap_lats = sorted(
+        1e3 * (f.t_done - f.t_submit) for t, f in futs
+        if t_pub <= t <= t_conv)
+    all_lats = sorted(1e3 * (f.t_done - f.t_submit)
+                      for _, f in futs)
+    st = fe.stats()
+    fe.close()
+    hjournal.disarm()
+    events = []
+    jpath = os.path.join(jdir, "journal-serving-weightswap.jsonl")
+    with open(jpath) as f:
+        events = [json.loads(ln) for ln in f if ln.strip()]
+    # The epoch fence, witnessed offline: every journaled batch
+    # executed under exactly one digest from the published set.
+    batch_digests = [e.get("weights", "") for e in events
+                     if e["type"] == "batch_trace"]
+    known = {v1.digest, v2.digest}
+    mixed = sum(1 for d in batch_digests if d not in known)
+    swap_ms = [e["ms"] for e in events
+               if e["type"] == "weights_adopted"]
+    rolling_update = {
+        "requests": n_requests,
+        "dropped": st["dropped"],
+        "failed": st["failed"],
+        "swaps": st["weights"]["swaps"],
+        "p99_ms": round(np.percentile(all_lats, 99), 3),
+        "p99_during_swap_ms": round(
+            np.percentile(swap_lats, 99), 3) if swap_lats else None,
+        "swap_window_ms": round(1e3 * (t_conv - t_pub), 1),
+        "swap_ms": {
+            "mean": round(float(np.mean(swap_ms)), 3),
+            "max": round(float(np.max(swap_ms)), 3),
+        },
+        "fence": {
+            "batches_traced": len(batch_digests),
+            "digests_seen": len(set(batch_digests)),
+            "mixed_version_batches": mixed,
+        },
+    }
+    log(f"bench[weight-swap]: rolling update "
+        f"p99_during_swap={rolling_update['p99_during_swap_ms']}ms "
+        f"swap_mean={rolling_update['swap_ms']['mean']}ms "
+        f"mixed={mixed}")
+
+    # -- leg 2: chaos (worker death mid-swap + corrupt publish) -----
+    wdir = os.path.join(scratch, "chaos")
+    boot = make_params(1)
+    pub = hweights.WeightPublisher(wdir, env=senv)
+    pub.publish(boot, step=10)
+    fe = hserving.ServingFrontend(
+        forward, (d_model,), env=dict(senv), autoscale=True,
+        params=boot, weights=wdir)
+    wait_for(lambda: fe.stats()["weights"]["swaps"] >= 2)
+    fired0 = _REG.snapshot().get("hvd_faults_fired_total", {}).get(
+        ("weights.adopt", "error"), 0)
+    hfaults.configure("weights.adopt:error:at=1", seed=17)
+    c2 = pub.publish(make_params(2), step=20)
+    futs = [fe.submit(rng.randn(d_model)) for _ in range(64)]
+    for f in futs:
+        f.result(timeout=60)
+    wait_for(lambda: pool_on(fe, c2.digest))
+    hfaults.configure("weights.publish:corrupt:at=1", seed=17)
+    pub.publish(make_params(3), step=30)
+    hfaults.configure("", seed=0)
+    wait_for(lambda: fe.stats()["weights"]["rejections"] >= 1)
+    still_on_c2 = pool_on(fe, c2.digest)
+    c3 = pub.publish(make_params(3), step=31)   # the retry
+    wait_for(lambda: pool_on(fe, c3.digest))
+    futs = [fe.submit(rng.randn(d_model)) for _ in range(32)]
+    for f in futs:
+        f.result(timeout=60)
+    st = fe.stats()
+    deaths = _REG.snapshot().get("hvd_faults_fired_total", {}).get(
+        ("weights.adopt", "error"), 0) - fired0
+    chaos = {
+        "fault_specs": ["weights.adopt:error:at=1",
+                        "weights.publish:corrupt:at=1"],
+        "dropped": st["dropped"],
+        "failed": st["failed"],
+        "worker_deaths": int(deaths),
+        "corrupt_rejections": st["weights"]["rejections"],
+        "kept_previous_digest_while_rejecting": bool(still_on_c2),
+        "converged_digest": next(iter(
+            st["weights"]["workers"].values()))["digest"],
+        "final_digest": c3.digest,
+        "final_workers": st["workers"],
+    }
+    fe.close()
+    hjournal.disarm()
+    log(f"bench[weight-swap]: chaos deaths={deaths} "
+        f"rejections={chaos['corrupt_rejections']} "
+        f"dropped={chaos['dropped']}")
+
+    # -- leg 3: verified rollback -----------------------------------
+    wdir = os.path.join(scratch, "rollback")
+    boot = make_params(1)
+    pub = hweights.WeightPublisher(wdir, env=senv)
+    r1 = pub.publish(boot, step=1)
+    r2 = pub.publish(make_params(2), step=2)
+    fe = hserving.ServingFrontend(
+        forward, (d_model,), env=dict(senv), autoscale=False,
+        params=boot, weights=wdir)
+    wait_for(lambda: pool_on(fe, r2.digest))
+    rb = pub.rollback()
+    wait_for(lambda: pool_on(fe, rb.digest))
+    futs = [fe.submit(rng.randn(d_model)) for _ in range(32)]
+    for f in futs:
+        f.result(timeout=60)
+    st = fe.stats()
+    rollback = {
+        "previous_digest": r1.digest,
+        "live_digest_before": r2.digest,
+        "restored_digest": next(iter(
+            st["weights"]["workers"].values()))["digest"],
+        "rollback_seq": rb.seq,
+        "dropped": st["dropped"],
+        "failed": st["failed"],
+    }
+    fe.close()
+    hjournal.disarm()
+    log(f"bench[weight-swap]: rollback restored="
+        f"{rollback['restored_digest'] == rollback['previous_digest']}")
+
+    doc = {
+        "what": "Train-to-serve live weight pipeline measured on "
+                "this host (horovod_tpu/weights.py + serving.py): "
+                "a rolling update under live traffic with per-"
+                "worker hot-swap latency, request p99 during the "
+                "swap window vs the SLO budget, the staleness "
+                "curve, and the epoch-fence check (no served batch "
+                "mixes weight versions); a chaos leg with a worker "
+                "death mid-swap and a corrupt publication rejected "
+                "by every worker while still serving the previous "
+                "digest; and a verified rollback - zero dropped "
+                "requests across all of it is the acceptance bar.",
+        "generated_by": "python bench.py --weight-swap",
+        "model": {"kind": "mlp", "d_model": d_model,
+                  "dtype": "float32"},
+        "config": {
+            "slo_budget_ms": slo_budget_ms,
+            "poll_ms": float(senv["HOROVOD_WEIGHTS_POLL_MS"]),
+            "max_batch": int(senv["HOROVOD_SERVING_MAX_BATCH"]),
+            "latency_budget_ms": float(
+                senv["HOROVOD_SERVING_LATENCY_BUDGET_MS"]),
+        },
+        "rolling_update": rolling_update,
+        "staleness_curve": staleness_curve,
+        "chaos": chaos,
+        "rollback": rollback,
+        "metrics": _metrics_snapshot(),
+        "journal": _journal_digest(),
+    }
+    shutil.rmtree(scratch, ignore_errors=True)
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    log(f"bench[weight-swap]: written to {out_path}")
+    print(json.dumps({
+        "metric": "weightswap_p99_during_swap_ms",
+        "value": rolling_update["p99_during_swap_ms"],
+        "unit": "ms", "vs_baseline": 1.0}), flush=True)
+
+
 def trajectory_main() -> None:
     """`--trajectory`: consolidate the committed per-round artifacts
     into one byte-deterministic BENCH_trajectory.json — the headline
@@ -2022,6 +2305,29 @@ def trajectory_main() -> None:
                     "(benchmarks/serving_trace_r16/)",
             "source": "benchmarks/SERVING_ATTRIBUTION_r16.json",
         },
+        "r17_weightswap": {
+            "p99_during_swap_ms": read(
+                "benchmarks/BENCH_weightswap_r17.json",
+                "rolling_update", "p99_during_swap_ms"),
+            "swap_mean_ms": read(
+                "benchmarks/BENCH_weightswap_r17.json",
+                "rolling_update", "swap_ms", "mean"),
+            "mixed_version_batches": read(
+                "benchmarks/BENCH_weightswap_r17.json",
+                "rolling_update", "fence", "mixed_version_batches"),
+            "chaos_dropped_requests": read(
+                "benchmarks/BENCH_weightswap_r17.json",
+                "chaos", "dropped"),
+            "chaos_worker_deaths": read(
+                "benchmarks/BENCH_weightswap_r17.json",
+                "chaos", "worker_deaths"),
+            "note": "zero-downtime rolling weight update: request "
+                    "p99 during the swap window, per-worker hot-"
+                    "swap latency, and the epoch-fence check (no "
+                    "served batch mixes weight versions) under "
+                    "injected mid-swap chaos",
+            "source": "benchmarks/BENCH_weightswap_r17.json",
+        },
     }
     with open(out_path, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True)
@@ -2029,7 +2335,7 @@ def trajectory_main() -> None:
     log(f"bench[trajectory]: written to {out_path}")
     print(json.dumps({
         "metric": "trajectory_rounds_recorded",
-        "value": len(headline) + 6, "unit": "rounds",
+        "value": len(headline) + 7, "unit": "rounds",
         "vs_baseline": 1.0}), flush=True)
 
 
@@ -2361,6 +2667,8 @@ if __name__ == "__main__":
         scaling_report_main()
     elif "--serving-attribution" in sys.argv:
         serving_attribution_main()
+    elif "--weight-swap" in sys.argv:
+        weight_swap_main()
     elif "--serving" in sys.argv:
         serving_main()
     elif "--compression-ab" in sys.argv:

@@ -423,9 +423,10 @@ KNOBS: List[Knob] = [
          "'wire.send:drop:p=0.05;elastic.step:crash:at=40'. Points: "
          "wire.send, wire.recv, rendezvous.http, discovery.poll, "
          "elastic.step, dispatch.entry, numerics.grad, "
-         "numerics.param, host.preempt, serving.batch. Actions: "
-         "drop, delay, corrupt, error, crash, hang, nan, inf, flip, "
-         "preempt. Empty = every injection point compiles to a "
+         "numerics.param, host.preempt, serving.batch, "
+         "weights.publish, weights.adopt. Actions: "
+         "drop, delay, corrupt, torn, error, crash, hang, nan, inf, "
+         "flip, preempt. Empty = every injection point compiles to a "
          "no-op."),
     Knob("HOROVOD_FAULTS_SEED", int, 0,
          "Seed for the fault-injection schedule; each rule draws from "
@@ -492,6 +493,37 @@ KNOBS: List[Knob] = [
          "hvd_serving_goodput_total / hvd_serving_slo_miss_total "
          "accounting. 0 = use HOROVOD_SERVING_LATENCY_BUDGET_MS "
          "(the admission budget) as the default deadline."),
+    # -- live weight pipeline (train-to-serve) -------------------------------
+    Knob("HOROVOD_WEIGHTS_DIR", str, "",
+         "Directory of the live weight pipeline (weights.py): the "
+         "trainer publishes digest-versioned sharded snapshots here "
+         "at elastic commit boundaries and serving workers adopt "
+         "them between batches (shared filesystem between trainer "
+         "and pool). Empty = the pipeline is disarmed and the "
+         "commit-path hook is two registry reads."),
+    Knob("HOROVOD_WEIGHTS_PUBLISH_EVERY", int, 0,
+         "Publish a weight version every N elastic commits (rank 0; "
+         "the first commit always publishes so a fresh serving pool "
+         "has a version to adopt). 0 = never publish from the "
+         "commit path; WeightPublisher.publish() is still available "
+         "for manual publication."),
+    Knob("HOROVOD_WEIGHTS_SHARD_MB", int, 64,
+         "Target shard size in MiB for published weight versions: "
+         "leaves are greedily packed into shards of roughly this "
+         "many bytes, each carrying its own digest so a torn or "
+         "corrupted shard is rejected at adoption without reading "
+         "the rest."),
+    Knob("HOROVOD_WEIGHTS_POLL_MS", float, 200.0,
+         "Serving-side poll cadence in milliseconds for the CURRENT "
+         "weight-version pointer; the watcher publishes a new "
+         "adoption target and each worker swaps at its next "
+         "between-batches fence point."),
+    Knob("HOROVOD_WEIGHTS_KEEP", int, 2,
+         "Published weight versions retained on disk (min 2: the "
+         "live version plus its predecessor, so rollback — "
+         "republishing the previous digest — always has a source). "
+         "Older version directories are garbage-collected at "
+         "publish time."),
     # -- process sets --------------------------------------------------------
     # hvdlint: disable-next=HVD002 (compat: the reference gates
     # post-init add_process_set on this; here registration is
@@ -681,6 +713,11 @@ class Config:
         "serving_trace": "HOROVOD_SERVING_TRACE",
         "serving_trace_buffer": "HOROVOD_SERVING_TRACE_BUFFER",
         "serving_default_slo_ms": "HOROVOD_SERVING_DEFAULT_SLO_MS",
+        "weights_dir": "HOROVOD_WEIGHTS_DIR",
+        "weights_publish_every": "HOROVOD_WEIGHTS_PUBLISH_EVERY",
+        "weights_shard_mb": "HOROVOD_WEIGHTS_SHARD_MB",
+        "weights_poll_ms": "HOROVOD_WEIGHTS_POLL_MS",
+        "weights_keep": "HOROVOD_WEIGHTS_KEEP",
         "dynamic_process_sets": "HOROVOD_DYNAMIC_PROCESS_SETS",
         "rank": "HOROVOD_RANK",
         "size": "HOROVOD_SIZE",

@@ -174,6 +174,14 @@ def run(func: Callable) -> Callable:
             # gang measures its loss instead of assuming the snapshot
             # was current (hvd_committed_step_loss_total).
             _journal.note_sync(getattr(state, "step", None))
+            # A trainer that died mid-publish can leave the live
+            # weight pipeline's CURRENT pointer at a torn version;
+            # re-point it at the newest intact one before training
+            # resumes so the serving pool converges instead of
+            # rejecting forever (weights.py; disarmed = one registry
+            # read).
+            from .. import weights as _weights
+            _weights.maybe_repair()
             if recovering is not None:
                 _journal.observe_phase(
                     "restore", time.monotonic() - recovering)

@@ -129,6 +129,14 @@ class State:
         _journal.note_commit(getattr(self, "step", None),
                              durable=getattr(
                                  self, "_last_save_durable", False))
+        # Live weight pipeline AFTER the journaled commit: rank 0
+        # publishes the just-committed params for the serving pool
+        # (weights.py rides the host copies save() made, so this is
+        # a disk write, not a second device fetch). Disarmed it is
+        # two registry reads; a publish failure is logged and
+        # training continues — serving keeps its previous version.
+        from .. import weights as _weights
+        _weights.maybe_publish(self)
         self.check_host_updates()
 
     def check_host_updates(self) -> None:

@@ -118,6 +118,20 @@ POINTS: Dict[str, frozenset] = {
     # must requeue it — the exactly-once path a late completion from
     # the revenant worker then exercises.
     "serving.batch": frozenset({"delay", "error", "crash", "hang"}),
+    # weights.py WeightPublisher.publish (trainer side, fired once
+    # per publish attempt): "corrupt" flips a byte in one shard
+    # AFTER its digest is recorded and "torn" truncates the last
+    # shard — both must be rejected at adoption with the worker
+    # still serving its previous version.
+    "weights.publish": frozenset({"delay", "error", "crash",
+                                  "corrupt", "torn"}),
+    # serving.py / weights.py per-worker adoption (between batches,
+    # under the epoch fence), fired once per adoption attempt with
+    # tag=<worker id>: "error" kills the worker mid-swap (the pool
+    # floor is restored by the autoscaler and the batch queue drains
+    # on survivors), "crash" in a remote member is a real mid-swap
+    # process death.
+    "weights.adopt": frozenset({"delay", "error", "crash"}),
 }
 
 ACTIONS = frozenset().union(*POINTS.values())

@@ -111,6 +111,11 @@ CRITICAL_EVENTS = frozenset({
     # per-batch `batch_trace` phase record stays batched like
     # batch_admitted.
     "serving_meta", "batch_failed",
+    # Live weight pipeline (round 17): publish / adopt / reject are
+    # the rare, incident-grade edges of a rolling model update — the
+    # rejected digest and the rolled-back-to digest are what the
+    # post-mortem of a bad push keys on.
+    "weights_published", "weights_adopted", "weights_rejected",
 })
 
 
@@ -778,6 +783,7 @@ def _timeline_entries(events: List[dict], t0: float) -> List[list]:
         "init_done", "job_done", "hosts_updated", "assignment",
         "postmortem_written", "task_exit",
         "slice_lost", "slice_admitted", "host_preempt",
+        "weights_published", "weights_adopted", "weights_rejected",
     }
     out = []
     for e in events:

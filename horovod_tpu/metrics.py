@@ -59,6 +59,15 @@ SERVING_PHASE_BUCKETS = (1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
 # hvd_recovery_seconds{phase} SLO histograms).
 RECOVERY_BUCKETS = (0.1, 0.5, 1.0, 2.0, 5.0, 15.0, 60.0, 300.0,
                     1800.0)
+# Live weight pipeline (weights.py): publish (host trees -> digested
+# shards on disk) and per-worker hot-swap (shard read + verify +
+# device_put) both move MB-to-GB states through file IO — slower
+# than the serving phase ladder, far faster than a recovery — and
+# the swap side bounds how long a worker sits out of the pool, so
+# the ladder needs resolution from a millisecond toy state out to a
+# multi-second flagship publish.
+WEIGHT_SWAP_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                       0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0)
 
 
 def _fmt(v: float) -> str:

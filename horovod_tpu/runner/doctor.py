@@ -98,7 +98,11 @@ def incident(target: str, out: Optional[str] = None) -> str:
     (HOROVOD_JOURNAL_DIR of a run) into `incident_report.json` —
     byte-deterministic for identical journals, so committed artifacts
     can be regenerated and diffed — and return the rendered
-    per-recovery MTTR decomposition. Also invoked by
+    per-recovery MTTR decomposition. The merged timeline carries the
+    live weight pipeline's `weights_published` / `weights_adopted` /
+    `weights_rejected` events (weights.py), so a bad model push, a
+    rejected torn snapshot, or a rollback lands in the same
+    attribution stream as the fault that caused it. Also invoked by
     `hvdrun --incident-report`."""
     from .. import journal
     path, report = journal.write_incident_report(target, out=out)

@@ -85,6 +85,7 @@ import numpy as np
 from . import faults as _faults
 from . import journal as _journal
 from . import tracing as _tracing
+from . import weights as _weights_mod
 from .common import config as _config
 from .common import logging as hlog
 from .metrics import (COUNT_BUCKETS, REGISTRY as _METRICS,
@@ -400,6 +401,14 @@ class _LocalWorker:
         self.device = device
         self.compiles = 0
         self._compiled: Dict[Tuple[int, ...], Callable] = {}
+        # Live weight pipeline: the params this worker serves, the
+        # version they came from, and the last version it rejected
+        # (a rejected seq is never re-attempted — the publisher's
+        # retry bumps the seq, which is how the pool converges).
+        self._params = None
+        self._w_version: Optional[_weights_mod.WeightVersion] = None
+        self._w_digest = frontend._params0_digest
+        self._w_rejected_seq = -1
         self._thread = threading.Thread(
             target=self._run, name=f"hvd-serving-{wid}", daemon=True)
         self._thread.start()
@@ -412,15 +421,75 @@ class _LocalWorker:
             ex = jnp.zeros(shape, self.frontend._dtype.name)
             if self.device is not None:
                 ex = jax.device_put(ex, self.device)
-            fn, _ = aot_compile(self.frontend._jitted, ex)
+            if self._params is not None:
+                # Two-arg (params, x) forward: the executable is
+                # specialized on the params' shapes/dtypes only, so
+                # it survives hot-swaps (adoption enforces an
+                # identical tree) without recompiling.
+                fn, _ = aot_compile(self.frontend._jitted,
+                                    self._params, ex)
+            else:
+                fn, _ = aot_compile(self.frontend._jitted, ex)
             self._compiled[shape] = fn
             self.compiles += 1
             _m_compiles.inc()
         return fn
 
+    def _maybe_adopt(self) -> None:
+        """Hot-swap to the frontend's adoption target, strictly
+        BETWEEN batches — this call site is the epoch fence: a batch
+        executes entirely on the params installed here, so no served
+        batch ever mixes weight versions. Any failure (digest
+        mismatch, torn shard, structure drift) leaves the previous
+        version serving; `weights.adopt` faults propagate to the
+        caller as a worker death mid-swap."""
+        import jax
+        fe = self.frontend
+        if fe._weights_sub is None:
+            return
+        with fe._lock:
+            tgt = fe._weights_target
+        if (tgt is None or tgt.seq == self._w_rejected_seq
+                or (self._w_version is not None
+                    and tgt.seq <= self._w_version.seq)):
+            return
+        _faults.fire("weights.adopt", exc=_WorkerDied, tag=self.wid)
+        t0 = time.monotonic()
+        try:
+            tree = fe._load_weights(tgt)
+            params = jax.device_put(tree, self.device)
+            jax.block_until_ready(params)
+        except Exception as e:  # noqa: BLE001 — degrade, keep serving
+            self._w_rejected_seq = tgt.seq
+            reason = _weights_mod.rejection_reason(e)
+            hlog.warning("serving: worker %s rejected weights "
+                         "seq=%d digest=%s (%s): %s", self.wid,
+                         tgt.seq, tgt.digest, reason, e)
+            _weights_mod.note_rejected(self.wid, tgt, reason,
+                                       str(e), self._w_digest)
+            with fe._lock:
+                fe.weight_rejections += 1
+            return
+        self._params = params
+        self._w_version = tgt
+        self._w_digest = tgt.digest
+        with fe._lock:
+            fe.weight_swaps += 1
+            latest = fe._weights_target
+        _weights_mod.note_adopted(
+            self.wid, tgt, time.monotonic() - t0,
+            (latest.step - tgt.step) if latest is not None else 0)
+
     def _run(self) -> None:
+        import jax
         fe = self.frontend
         try:
+            if fe._params0 is not None:
+                # Bootstrap params on this worker's device; the
+                # first fence pass below swaps to the published
+                # CURRENT version if one exists.
+                self._params = jax.device_put(fe._params0,
+                                              self.device)
             for shape in fe.ladder.shapes(fe._feature_shape):
                 self._get_exec(shape)
         except Exception as e:  # noqa: BLE001 — warmup must not hang pool
@@ -430,6 +499,14 @@ class _LocalWorker:
             return
         while True:
             if fe._retired(self.wid):
+                return
+            try:
+                self._maybe_adopt()
+            except _WorkerDied:
+                # Injected death mid-swap: this member is gone; the
+                # pool floor is restored by the autoscaler and its
+                # inflight batch (if any) is requeued on survivors.
+                fe._worker_failed(self.wid, "weights_fault")
                 return
             batch = fe._next_batch(self.wid, timeout=0.05)
             if batch is None:
@@ -459,7 +536,8 @@ class _LocalWorker:
                            self.wid, batch.id, e)
                 fe._worker_failed(self.wid, "execute_error")
                 return
-            fe._complete_batch(batch, rows, self.wid)
+            fe._complete_batch(batch, rows, self.wid,
+                               weights=self._w_digest)
 
     def _execute(self, batch: _Batch) -> List[np.ndarray]:
         import jax
@@ -475,7 +553,9 @@ class _LocalWorker:
             _tracing.record("serving_exec", batch.id,
                             seq=batch.attempts,
                             arg=float(batch.bucket_b))
-        y = np.asarray(self._get_exec(arr.shape)(x))
+        ex = self._get_exec(arr.shape)
+        y = np.asarray(ex(self._params, x)
+                       if self._params is not None else ex(x))
         if hop is not None:
             hop.t_exec1_ns = time.monotonic_ns()
         rows = fe._unpad(batch, y)
@@ -506,13 +586,45 @@ class ServingFrontend:
                  env: Optional[Dict[str, str]] = None,
                  start_pool: bool = True,
                  autoscale: bool = True,
-                 trace_tag: Optional[str] = None):
+                 trace_tag: Optional[str] = None,
+                 params: Optional[Any] = None,
+                 weights: Optional[Any] = None):
         import jax
         self._env = env
         self._forward = forward_fn
         self._jitted = jax.jit(forward_fn)
         self._feature_shape = tuple(int(d) for d in feature_shape)
         self._dtype = np.dtype(dtype)
+        # Live weight pipeline (weights.py): with ``params`` the
+        # forward is two-arg (params, x) and every worker serves a
+        # per-device copy; with ``weights`` (a pipeline directory or
+        # a WeightSubscriber) the pool additionally tracks the
+        # publisher's CURRENT version and hot-swaps between batches.
+        self._params0 = params
+        self._params0_digest = ""
+        self._weights_names = self._weights_treedef = None
+        self._weights_sub = None
+        self._weights_target: Optional[
+            _weights_mod.WeightVersion] = None
+        self.weight_swaps = 0
+        self.weight_rejections = 0
+        if params is not None:
+            self._weights_names, self._weights_treedef = \
+                _weights_mod.tree_spec(params)
+            self._weights_leaf_spec = _weights_mod.leaf_spec(params)
+            self._params0_digest = _weights_mod.content_digest(
+                _weights_mod.named_leaves(params))
+        if weights is not None:
+            if params is None:
+                raise ValueError(
+                    "ServingFrontend(weights=...) needs params=: "
+                    "the bootstrap tree defines the structure "
+                    "published versions must match (and what the "
+                    "pool serves until the first adoption)")
+            self._weights_sub = (
+                weights if hasattr(weights, "poll")
+                else _weights_mod.WeightSubscriber(str(weights),
+                                                   env=env))
         self.ladder = build_ladder(env=env)
         ev = lambda name: _config.env_value(name, env=env)  # noqa: E731
         self._max_batch = ev("HOROVOD_SERVING_MAX_BATCH")
@@ -525,6 +637,8 @@ class ServingFrontend:
         self._retry_limit = ev("HOROVOD_SERVING_RETRY_LIMIT")
         self._worker_timeout = ev("HOROVOD_SERVING_WORKER_TIMEOUT_S")
         self._trace = bool(ev("HOROVOD_SERVING_TRACE"))
+        self._weights_poll_s = max(
+            0.005, ev("HOROVOD_WEIGHTS_POLL_MS") / 1e3)
         default_slo = ev("HOROVOD_SERVING_DEFAULT_SLO_MS")
         self._default_slo_ms = (default_slo if default_slo > 0
                                 else self._budget_s * 1e3)
@@ -565,8 +679,15 @@ class ServingFrontend:
             budget_ms=round(self._budget_s * 1e3, 3),
             trace=self._trace,
             default_slo_ms=round(self._default_slo_ms, 3),
-            tag=trace_tag or "")
+            tag=trace_tag or "",
+            weights=(self._weights_sub.dir
+                     if self._weights_sub is not None else ""))
         _live_frontends.add(self)
+        if self._weights_sub is not None:
+            self._weights_watcher = threading.Thread(
+                target=self._weights_loop,
+                name="hvd-serving-weights", daemon=True)
+            self._weights_watcher.start()
         self._batcher = threading.Thread(
             target=self._batch_loop, name="hvd-serving-batcher",
             daemon=True)
@@ -834,7 +955,7 @@ class ServingFrontend:
 
     def _complete_batch(self, batch: _Batch,
                         rows: Sequence[np.ndarray],
-                        wid: str) -> int:
+                        wid: str, weights: str = "") -> int:
         t0_ns = time.monotonic_ns()
         now = time.monotonic()
         won = 0
@@ -871,7 +992,7 @@ class ServingFrontend:
             if not self._queue and not self._ready:
                 self._last_nonempty = now
         if self._trace and won:
-            self._finalize_traces(batch, winners, wid)
+            self._finalize_traces(batch, winners, wid, weights)
             _tracing.record("serving_done", batch.id,
                             seq=batch.attempts, arg=float(won))
         _m_latch_wait.set((time.monotonic_ns() - t0_ns) / 1e9)
@@ -879,7 +1000,7 @@ class ServingFrontend:
 
     def _finalize_traces(self, batch: _Batch,
                          winners: Sequence[ServingFuture],
-                         wid: str) -> None:
+                         wid: str, weights: str = "") -> None:
         """Fold the winning hop's stamps into per-request trace
         records (ring buffer + phase histograms) and one `batch_trace`
         journal event `doctor serve` aggregates offline."""
@@ -911,6 +1032,9 @@ class ServingFrontend:
                 "t_done_ns": req.t_done_ns,
                 "phases_ns": phases,
                 "hops": hops,
+                # Epoch-fence witness: the single weight-version
+                # digest this request's winning batch executed on.
+                "weights": weights,
             }
             recs.append(rec)
             for phase, dns in phases.items():
@@ -928,7 +1052,7 @@ class ServingFrontend:
             done_ns=[r["t_done_ns"] for r in recs],
             admit_ns=batch.t_admit_ns, claim_ns=hop.t_claim_ns,
             exec0_ns=hop.t_exec0_ns, exec1_ns=hop.t_exec1_ns,
-            unpad_ns=hop.t_unpad1_ns, hops=hops)
+            unpad_ns=hop.t_unpad1_ns, hops=hops, weights=weights)
 
     def _retry(self, batch: _Batch, cause: str, wid: str) -> None:
         if batch.done:
@@ -1009,6 +1133,41 @@ class ServingFrontend:
                     and idle_for > self._scale_down_idle):
                 self._resize(n - 1, "idle")
 
+    # -- live weight pipeline -----------------------------------------------
+
+    def _weights_loop(self) -> None:
+        """Poll the publisher's CURRENT pointer and expose the
+        newest version as the pool's adoption target; workers swap
+        at their own between-batches fence. File IO stays outside
+        the frontend lock — only the target pointer flips under it."""
+        while not self._closing:
+            try:
+                tgt = self._weights_sub.poll()
+            except Exception as e:  # noqa: BLE001 — keep watching
+                hlog.warning("serving: weights poll failed: %s", e)
+                tgt = None
+            if tgt is not None:
+                with self._lock:
+                    self._weights_target = tgt
+                    workers = list(self._workers.values())
+                for w in workers:
+                    v = getattr(w, "_w_version", None)
+                    _weights_mod.set_staleness(
+                        w.wid, (tgt.step - v.step) if v is not None
+                        else 0)
+            t_end = time.monotonic() + self._weights_poll_s
+            while time.monotonic() < t_end and not self._closing:
+                time.sleep(min(0.02, self._weights_poll_s))
+
+    def _load_weights(self, version) -> Any:
+        """Read + verify ``version`` (every shard digested) and
+        rebuild it against this frontend's bootstrap tree spec; any
+        WeightError here means the caller keeps its old params."""
+        named = self._weights_sub.load_named(version)
+        return _weights_mod.rebuild(named, self._weights_names,
+                                    self._weights_treedef,
+                                    self._weights_leaf_spec)
+
     # -- remote transport ---------------------------------------------------
 
     def serve_endpoint(self, port: int = 0,
@@ -1073,7 +1232,9 @@ class ServingFrontend:
         rows = self._unpad(batch, y)
         if hop is not None and not hop.t_unpad1_ns:
             hop.t_unpad1_ns = time.monotonic_ns()
-        return {"ok": self._complete_batch(batch, rows, wid)}
+        return {"ok": self._complete_batch(
+            batch, rows, wid,
+            weights=str(req.get("weights") or ""))}
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -1145,6 +1306,31 @@ class ServingFrontend:
                 "digest": self.ladder.digest,
             },
         }
+        if self._weights_sub is not None:
+            with self._lock:
+                tgt = self._weights_target
+                wstates = {
+                    wid: getattr(w, "_w_version", None)
+                    for wid, w in self._workers.items()}
+            out["weights"] = {
+                "target_seq": tgt.seq if tgt is not None else 0,
+                "target_digest": (tgt.digest if tgt is not None
+                                  else ""),
+                "target_step": (tgt.step if tgt is not None
+                                else -1),
+                "swaps": self.weight_swaps,
+                "rejections": self.weight_rejections,
+                "workers": {
+                    wid: {
+                        "digest": (v.digest if v is not None
+                                   else self._params0_digest),
+                        "seq": v.seq if v is not None else 0,
+                        "staleness_steps": (
+                            max(0, tgt.step - v.step)
+                            if tgt is not None and v is not None
+                            else 0),
+                    } for wid, v in wstates.items()},
+            }
         if self._trace:
             out["trace"] = self.trace_digest()
         return out
@@ -1302,14 +1488,23 @@ def remote_worker_loop(addr: str, port: int,
                        wid: Optional[str] = None,
                        secret: Optional[str] = None,
                        env: Optional[Dict[str, str]] = None,
-                       max_batches: int = 0) -> int:
+                       max_batches: int = 0,
+                       params: Optional[Any] = None,
+                       weights_dir: Optional[str] = None) -> int:
     """Pool-member loop for a separate process: pull padded batches
     from a `ServingFrontend.serve_endpoint()`, execute the
     AOT-compiled forward, push results. Returns the number of batches
     executed; exits when the frontend says stop (or after
     ``max_batches`` > 0, for tests). The `serving.batch` seam fires
     once per pulled batch — `crash` here is a real mid-batch process
-    death."""
+    death.
+
+    With ``params`` the forward is two-arg (params, x); with
+    ``weights_dir`` this member runs its own `WeightSubscriber` and
+    hot-swaps between pulls (the remote epoch fence), stamping every
+    push with the digest it executed on. The `weights.adopt` seam
+    fires once per adoption attempt — `crash` here is a real process
+    death mid-swap."""
     import os
 
     import jax
@@ -1330,13 +1525,60 @@ def remote_worker_loop(addr: str, port: int,
     cli = BasicClient(addr, port, secret, timeout=10.0)
     ladder = build_ladder(env=env)
     jitted = jax.jit(forward_fn)
+    w_names = w_treedef = None
+    w_digest = ""
+    w_sub = None
+    w_rejected_seq = -1
+    if params is not None:
+        w_names, w_treedef = _weights_mod.tree_spec(params)
+        w_spec = _weights_mod.leaf_spec(params)
+        w_digest = _weights_mod.content_digest(
+            _weights_mod.named_leaves(params))
+        params = jax.device_put(params)
+    if weights_dir:
+        if params is None:
+            raise ValueError("remote_worker_loop(weights_dir=...) "
+                             "needs params= (the bootstrap tree)")
+        w_sub = _weights_mod.WeightSubscriber(weights_dir, env=env)
     compiled: Dict[Tuple[int, ...], Callable] = {}
     for shape in ladder.shapes(feature_shape):
-        fn, _ = aot_compile(jitted, jnp.zeros(shape, dtype))
+        if params is not None:
+            fn, _ = aot_compile(jitted, params,
+                                jnp.zeros(shape, dtype))
+        else:
+            fn, _ = aot_compile(jitted, jnp.zeros(shape, dtype))
         compiled[shape] = fn
         _m_compiles.inc()
     done = 0
     while True:
+        if w_sub is not None:
+            # Adopt between pulls — the remote member's epoch fence.
+            cur = w_sub.poll()
+            if cur is not None and cur.seq != w_rejected_seq:
+                # Uncaught `error` (and real `crash`) here is a
+                # worker death mid-swap; the frontend requeues this
+                # member's inflight work on survivors.
+                _faults.fire("weights.adopt", exc=_WorkerDied,
+                             tag=wid)
+                t0 = time.monotonic()
+                try:
+                    tree = _weights_mod.rebuild(
+                        w_sub.load_named(cur), w_names, w_treedef,
+                        w_spec)
+                    params = jax.device_put(tree)
+                    jax.block_until_ready(params)
+                except Exception as e:  # noqa: BLE001 — keep serving
+                    w_rejected_seq = cur.seq
+                    reason = _weights_mod.rejection_reason(e)
+                    hlog.warning("serving: remote %s rejected "
+                                 "weights seq=%d (%s): %s", wid,
+                                 cur.seq, reason, e)
+                    _weights_mod.note_rejected(wid, cur, reason,
+                                               str(e), w_digest)
+                else:
+                    w_digest = cur.digest
+                    _weights_mod.note_adopted(
+                        wid, cur, time.monotonic() - t0, 0)
         reply = cli.try_request({"type": "pull", "worker": wid,
                                  "wait": 0.2}, retries=2)
         if reply is None:
@@ -1351,10 +1593,16 @@ def remote_worker_loop(addr: str, port: int,
         shape = tuple(b["shape"])
         x = np.asarray(b["payload"], dtype=b["dtype"]).reshape(shape)
         fn = compiled.get(shape)
-        y = np.asarray(fn(jnp.asarray(x)) if fn is not None
-                       else jitted(jnp.asarray(x)))
+        if params is not None:
+            y = np.asarray(fn(params, jnp.asarray(x))
+                           if fn is not None
+                           else jitted(params, jnp.asarray(x)))
+        else:
+            y = np.asarray(fn(jnp.asarray(x)) if fn is not None
+                           else jitted(jnp.asarray(x)))
         cli.try_request({"type": "push", "worker": wid,
-                         "batch": b["id"], "outputs": y.tolist()},
+                         "batch": b["id"], "outputs": y.tolist(),
+                         "weights": w_digest},
                         retries=2)
         done += 1
         if max_batches and done >= max_batches:

@@ -1,0 +1,712 @@
+"""Train-to-serve live weight pipeline: digest-versioned publication
+at the elastic commit boundary, epoch-fenced adoption in the serving
+pool, verified rollback.
+
+The repo has a training loop with durable journaled commits
+(elastic/state.py) and an elastic serving pool with exactly-once
+retry (serving.py); this module is the bridge — the production loop
+of continuous training feeding continuous serving with zero-downtime
+model updates.
+
+Publication (trainer side, `WeightPublisher`)
+    At a commit boundary rank 0 packs the snapshot's host trees (the
+    same `_tree_saved` numpy copies `JaxState.save()` already makes)
+    into digest-versioned shards under HOROVOD_WEIGHTS_DIR:
+
+        v00000007-1a2b3c4d5e6f7a8b/
+            shard-0000.bin      pickled [(leaf name, ndarray), ...]
+            manifest.json       leaves, shapes, per-shard digests
+        CURRENT                 {"seq", "digest", "step", "dir"}
+
+    Every file lands via tmp + os.replace (the snapshot machinery's
+    atomic-rename idiom), so a reader never sees a half-written
+    version: either CURRENT points at a complete version directory or
+    at the previous one. The version identity is a blake2b digest of
+    the leaf contents; the publish sequence number ("weights epoch")
+    is what subscribers key adoption on, so REPUBLISHING the same
+    digest under a new seq is meaningful — it is the retry that
+    converges a pool whose workers rejected a torn copy, and it is
+    how rollback works (`rollback()` re-points CURRENT at the
+    previous digest under a fresh seq).
+
+Adoption (serving side, `WeightSubscriber` + serving.py)
+    The frontend polls CURRENT (HOROVOD_WEIGHTS_POLL_MS) and exposes
+    the newest version as the pool's adoption target; each worker
+    swaps at its next between-batches fence point: read shards,
+    verify every shard's digest, rebuild the pytree, device_put, then
+    atomically replace its per-device buffers. A batch therefore
+    never mixes weight versions (the epoch fence): the worker either
+    executes entirely on the old version or entirely on the new one,
+    and the trace records which digest served every batch. A failed
+    adoption — digest mismatch, truncated shard, structure drift,
+    worker death mid-swap — degrades gracefully: the worker keeps
+    serving its previous version, journals `weights_rejected`, and
+    retries only when the publisher publishes a fresh seq.
+
+Journal: `weights_published` / `weights_adopted` / `weights_rejected`
+(all CRITICAL_EVENTS — a bad model push is incident-grade), feeding
+`doctor incident` timelines. Metrics: publish/swap latency
+histograms, adoption outcomes, and per-worker staleness as
+train-step lag. Chaos seams: `weights.publish` (corrupt / torn /
+error / crash / delay) and `weights.adopt` (error / crash / delay),
+fired armed-or-not like `numerics.grad`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import shutil
+import time
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from . import faults as _faults
+from . import journal as _journal
+from .common import config as _config
+from .common import logging as hlog
+from .metrics import REGISTRY as _METRICS
+from .metrics import WEIGHT_SWAP_BUCKETS
+
+_m_published = _METRICS.counter(
+    "hvd_weights_published_total",
+    "Weight versions published (CURRENT pointer flips) by kind: "
+    "'publish' from the commit path or an explicit publish(), "
+    "'rollback' re-pointing at the previous digest, 'repair' "
+    "re-pointing off a torn version after a trainer death "
+    "mid-publish, 'error' for publish attempts that failed.",
+    ("kind",))
+_m_publish_s = _METRICS.histogram(
+    "hvd_weights_publish_seconds",
+    "Wall time of one weight publication: host trees to digested "
+    "shards to the atomic CURRENT flip.",
+    buckets=WEIGHT_SWAP_BUCKETS)
+_m_adoptions = _METRICS.counter(
+    "hvd_weights_adoptions_total",
+    "Per-worker adoption attempts by outcome: 'ok', or the "
+    "rejection reason — 'digest' (shard bytes fail their recorded "
+    "digest), 'torn' (short or missing shard/manifest), 'structure' "
+    "(leaf names/shapes drifted from the serving forward's tree), "
+    "'error' (anything else). The worker keeps serving its previous "
+    "version on every non-ok outcome.",
+    ("outcome",))
+_m_swap_s = _METRICS.histogram(
+    "hvd_weights_swap_seconds",
+    "Per-worker hot-swap latency: shard read + digest verify + "
+    "device_put + buffer flip, all between batches (the epoch "
+    "fence) — this bounds how long a worker sits out of the pool "
+    "during a rolling update.",
+    buckets=WEIGHT_SWAP_BUCKETS)
+_m_staleness = _METRICS.gauge(
+    "hvd_weights_staleness_steps",
+    "Per-serving-worker staleness as train-step lag: the latest "
+    "published train step minus the train step of the version the "
+    "worker is actually serving.",
+    ("worker",))
+_m_epoch = _METRICS.gauge(
+    "hvd_weights_epoch",
+    "Latest published weight epoch (publish sequence number) "
+    "visible to this process.")
+
+MANIFEST_NAME = "manifest.json"
+CURRENT_NAME = "CURRENT"
+SCHEMA = "hvd-weights-v1"
+_DIGEST_SIZE = 8  # 16 hex chars, same weight class as the ladder pin
+
+
+class WeightError(RuntimeError):
+    """Publication failed (injected fault, IO error)."""
+
+
+class WeightIntegrityError(WeightError):
+    """A version on disk is torn or corrupt: missing/short shard,
+    shard bytes failing their recorded digest, unreadable manifest,
+    or a manifest disagreeing with the CURRENT pointer."""
+
+
+class WeightStructureError(WeightError):
+    """A verified version's leaves do not match the adopter's tree
+    (names/dtypes/shapes drifted) — adoptable only by a redeployed
+    serving forward, so the worker keeps its current version."""
+
+
+class WeightVersion(NamedTuple):
+    """One CURRENT pointer state: the adoption target."""
+    seq: int
+    digest: str
+    step: int
+    dir: str  # version directory name, relative to the pipeline dir
+
+
+# ---------------------------------------------------------------------------
+# Tree <-> named leaves
+
+def tree_spec(tree: Any) -> Tuple[List[str], Any]:
+    """Deterministic leaf names + treedef for ``tree``. The names are
+    the published interchange identity: adoption rejects (structure)
+    unless they match the adopter's own spec exactly."""
+    import jax
+    keyed, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    names = [jax.tree_util.keystr(kp) for kp, _ in keyed]
+    return names, treedef
+
+
+def named_leaves(tree: Any) -> List[Tuple[str, np.ndarray]]:
+    """Flatten ``tree`` to [(name, host ndarray)] in traversal
+    order."""
+    import jax
+    keyed, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(kp), np.asarray(leaf))
+            for kp, leaf in keyed]
+
+
+def leaf_spec(tree: Any) -> Dict[str, Tuple[str, Tuple[int, ...]]]:
+    """name -> (dtype, shape) for every leaf: the adopter-side
+    structure contract a published version must match exactly."""
+    return {name: (str(arr.dtype), tuple(arr.shape))
+            for name, arr in named_leaves(tree)}
+
+
+def rebuild(named: List[Tuple[str, np.ndarray]], names: List[str],
+            treedef: Any, spec: Optional[Dict[str, Any]] = None
+            ) -> Any:
+    """Inverse of named_leaves against the adopter's own spec; raises
+    WeightStructureError on any drift (leaf names always; dtypes and
+    shapes too when a ``spec`` from leaf_spec() is given — a trainer
+    that changed precision or architecture must not be adopted by a
+    pool compiled for the old one)."""
+    import jax
+    got = dict(named)
+    if len(got) != len(named) or sorted(got) != sorted(names):
+        raise WeightStructureError(
+            f"published leaves {sorted(got)[:4]}... do not match the "
+            f"serving forward's tree ({len(named)} published vs "
+            f"{len(names)} expected)")
+    if spec:
+        for name, arr in named:
+            want = spec.get(name)
+            have = (str(arr.dtype), tuple(arr.shape))
+            if want is not None and tuple(want) != have:
+                raise WeightStructureError(
+                    f"published leaf {name!r} is {have}, the serving "
+                    f"forward expects {tuple(want)}")
+    return jax.tree_util.tree_unflatten(
+        treedef, [got[n] for n in names])
+
+
+def content_digest(named: List[Tuple[str, np.ndarray]]) -> str:
+    """The version identity: blake2b over (name, dtype, shape,
+    bytes) of every leaf in traversal order."""
+    h = hashlib.blake2b(digest_size=_DIGEST_SIZE)
+    for name, arr in named:
+        h.update(name.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(repr(tuple(arr.shape)).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()
+
+
+def _blob_digest(blob: bytes) -> str:
+    return hashlib.blake2b(blob, digest_size=_DIGEST_SIZE).hexdigest()
+
+
+def _pack_shards(named: List[Tuple[str, np.ndarray]],
+                 shard_bytes: int) -> List[List[Tuple[str, np.ndarray]]]:
+    """Greedy packing into ~shard_bytes shards, ≥1 leaf each, never
+    splitting a leaf (a leaf larger than the target gets its own
+    shard)."""
+    shards: List[List[Tuple[str, np.ndarray]]] = []
+    cur: List[Tuple[str, np.ndarray]] = []
+    cur_bytes = 0
+    for name, arr in named:
+        nb = int(arr.nbytes)
+        if cur and cur_bytes + nb > shard_bytes:
+            shards.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append((name, arr))
+        cur_bytes += nb
+    if cur or not shards:
+        shards.append(cur)
+    return shards
+
+
+# ---------------------------------------------------------------------------
+# On-disk pointer
+
+def _read_current(dir_: str) -> Optional[WeightVersion]:
+    """The CURRENT pointer, or None when nothing was ever published
+    (or the pointer itself is unreadable — the subscriber waits and
+    `repair()` re-points)."""
+    try:
+        with open(os.path.join(dir_, CURRENT_NAME)) as f:
+            cur = json.load(f)
+        return WeightVersion(int(cur["seq"]), str(cur["digest"]),
+                             int(cur["step"]), str(cur["dir"]))
+    except (OSError, ValueError, KeyError):
+        return None
+
+
+def _write_json(path: str, doc: Dict[str, Any]) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, sort_keys=True, indent=1)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def load_manifest(dir_: str, version: WeightVersion) -> Dict[str, Any]:
+    """Read + sanity-check a version's manifest against the pointer
+    that named it."""
+    path = os.path.join(dir_, version.dir, MANIFEST_NAME)
+    try:
+        with open(path) as f:
+            man = json.load(f)
+    except (OSError, ValueError) as e:
+        raise WeightIntegrityError(
+            f"unreadable manifest for {version.dir}: {e}") from e
+    if (man.get("schema") != SCHEMA
+            or man.get("digest") != version.digest):
+        raise WeightIntegrityError(
+            f"manifest for {version.dir} names digest "
+            f"{man.get('digest')!r}, CURRENT says "
+            f"{version.digest!r}")
+    return man
+
+
+def load_named(dir_: str, version: WeightVersion
+               ) -> List[Tuple[str, np.ndarray]]:
+    """Read and VERIFY one version: every shard's bytes must match
+    its recorded length and digest (a truncated or bit-flipped shard
+    raises WeightIntegrityError before anything is returned), and the
+    assembled leaves must match the manifest's leaf table."""
+    man = load_manifest(dir_, version)
+    named: List[Tuple[str, np.ndarray]] = []
+    for sh in man["shards"]:
+        path = os.path.join(dir_, version.dir, sh["file"])
+        try:
+            with open(path, "rb") as f:
+                blob = f.read()
+        except OSError as e:
+            raise WeightIntegrityError(
+                f"missing shard {sh['file']} of {version.dir}: "
+                f"{e}") from e
+        if len(blob) != int(sh["bytes"]):
+            raise WeightIntegrityError(
+                f"torn shard {sh['file']} of {version.dir}: "
+                f"{len(blob)} bytes on disk, manifest says "
+                f"{sh['bytes']}")
+        if _blob_digest(blob) != sh["digest"]:
+            raise WeightIntegrityError(
+                f"shard {sh['file']} of {version.dir} fails its "
+                f"digest ({sh['digest']})")
+        named.extend(pickle.loads(blob))
+    table = {name: (dtype, tuple(shape))
+             for name, dtype, shape in man["leaves"]}
+    if len(named) != len(table):
+        raise WeightIntegrityError(
+            f"{version.dir}: {len(named)} leaves in shards, manifest "
+            f"lists {len(table)}")
+    for name, arr in named:
+        want = table.get(name)
+        if want is None or want != (str(arr.dtype), tuple(arr.shape)):
+            raise WeightIntegrityError(
+                f"{version.dir}: leaf {name!r} is "
+                f"{(str(arr.dtype), tuple(arr.shape))}, manifest "
+                f"says {want}")
+    return named
+
+
+def verify_version(dir_: str, version: WeightVersion) -> None:
+    """Full integrity check (shards read + digested); raises
+    WeightIntegrityError. Used by `repair()` on the recovery path."""
+    load_named(dir_, version)
+
+
+# ---------------------------------------------------------------------------
+# Publisher (trainer side)
+
+
+class WeightPublisher:
+    """Writes digest-versioned sharded weight snapshots and flips the
+    CURRENT pointer atomically. One instance per publishing process
+    (rank 0); seq numbering resumes from the on-disk CURRENT, so a
+    restarted trainer keeps the epoch monotonic."""
+
+    def __init__(self, dir_: str, *,
+                 env: Optional[Dict[str, str]] = None):
+        self.dir = dir_
+        ev = lambda name: _config.env_value(name, env=env)  # noqa: E731
+        self._shard_bytes = max(1, int(
+            ev("HOROVOD_WEIGHTS_SHARD_MB"))) << 20
+        self._keep = max(2, int(ev("HOROVOD_WEIGHTS_KEEP")))
+        os.makedirs(dir_, exist_ok=True)
+        cur = _read_current(dir_)
+        self._seq = cur.seq if cur is not None else 0
+
+    def current(self) -> Optional[WeightVersion]:
+        return _read_current(self.dir)
+
+    def _versions(self) -> List[Tuple[int, str, str]]:
+        """(seq, digest, dirname) of every complete-looking version
+        directory, oldest first."""
+        out = []
+        try:
+            entries = sorted(os.listdir(self.dir))
+        except OSError:
+            return []
+        for name in entries:
+            if not name.startswith("v") or name.endswith(".tmp"):
+                continue
+            parts = name[1:].split("-", 1)
+            if len(parts) != 2 or not parts[0].isdigit():
+                continue
+            if not os.path.isfile(os.path.join(self.dir, name,
+                                               MANIFEST_NAME)):
+                continue
+            out.append((int(parts[0]), parts[1], name))
+        out.sort()
+        return out
+
+    def publish(self, params: Any, step: int,
+                kind: str = "publish") -> WeightVersion:
+        """Shard + digest ``params`` (a pytree or an already-named
+        leaf list), write the version directory, flip CURRENT.
+        Raises WeightError on failure — the commit-path caller
+        (`maybe_publish`) downgrades that to a journal line, because
+        publication must never kill training."""
+        t0 = time.monotonic()
+        act = _faults.fire("weights.publish", exc=WeightError)
+        try:
+            version = self._write_version(params, step, act, kind)
+        except WeightError:
+            _m_published.labels(kind="error").inc()
+            raise
+        except OSError as e:
+            _m_published.labels(kind="error").inc()
+            raise WeightError(f"weights publish failed: {e}") from e
+        dt = time.monotonic() - t0
+        _m_publish_s.observe(dt)
+        _m_published.labels(kind=kind).inc()
+        _m_epoch.set(float(version.seq))
+        _journal.record(
+            "weights_published", digest=version.digest,
+            seq=version.seq, step=version.step, kind=kind,
+            ms=round(dt * 1e3, 3))
+        return version
+
+    def _write_version(self, params: Any, step: int,
+                       act: Optional[str], kind: str) -> WeightVersion:
+        named = (params if isinstance(params, list)
+                 else named_leaves(params))
+        digest = content_digest(named)
+        seq = self._seq + 1
+        vname = f"v{seq:08d}-{digest}"
+        vdir = os.path.join(self.dir, vname)
+        tmp = vdir + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        man_shards = []
+        total = 0
+        for i, pairs in enumerate(_pack_shards(named,
+                                               self._shard_bytes)):
+            blob = pickle.dumps(pairs, protocol=4)
+            fname = f"shard-{i:04d}.bin"
+            with open(os.path.join(tmp, fname), "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            man_shards.append({"file": fname, "bytes": len(blob),
+                               "digest": _blob_digest(blob),
+                               "leaves": len(pairs)})
+            total += len(blob)
+        _write_json(os.path.join(tmp, MANIFEST_NAME), {
+            "schema": SCHEMA, "digest": digest, "seq": seq,
+            "step": int(step), "bytes": total,
+            "leaves": [[name, str(arr.dtype), list(arr.shape)]
+                       for name, arr in named],
+            "shards": man_shards,
+        })
+        # Injected damage lands AFTER the digests are recorded, so
+        # the publisher believes it succeeded while adoption must
+        # reject — the corrupt/torn-snapshot scenario.
+        if act in ("corrupt", "torn"):
+            self._damage(os.path.join(tmp, man_shards[-1]["file"]),
+                         act)
+        os.replace(tmp, vdir)
+        self._point_current(seq, digest, int(step), vname)
+        self._seq = seq
+        self._gc()
+        return WeightVersion(seq, digest, int(step), vname)
+
+    @staticmethod
+    def _damage(path: str, act: str) -> None:
+        size = os.path.getsize(path)
+        if act == "torn":
+            with open(path, "r+b") as f:
+                f.truncate(max(1, size // 2))
+            hlog.warning("faults: truncated shard %s to half",
+                         os.path.basename(path))
+            return
+        with open(path, "r+b") as f:
+            f.seek(size // 2)
+            b = f.read(1)
+            f.seek(size // 2)
+            f.write(bytes([b[0] ^ 0xFF]))
+        hlog.warning("faults: flipped a byte in shard %s",
+                     os.path.basename(path))
+
+    def _point_current(self, seq: int, digest: str, step: int,
+                       vname: str) -> None:
+        _write_json(os.path.join(self.dir, CURRENT_NAME),
+                    {"seq": seq, "digest": digest, "step": step,
+                     "dir": vname})
+
+    def _gc(self) -> None:
+        versions = self._versions()
+        cur = _read_current(self.dir)
+        for _, _, vname in versions[:-self._keep]:
+            if cur is not None and vname == cur.dir:
+                continue  # never collect the live version
+            shutil.rmtree(os.path.join(self.dir, vname),
+                          ignore_errors=True)
+
+    def rollback(self) -> WeightVersion:
+        """Republish the previous digest: re-point CURRENT at the
+        newest retained version whose digest differs from the live
+        one, under a FRESH seq (subscribers adopt on seq, so the old
+        digest really re-deploys). Verified before the flip — a torn
+        predecessor is skipped."""
+        cur = _read_current(self.dir)
+        for seq, digest, vname in reversed(self._versions()):
+            if cur is not None and (digest == cur.digest
+                                    or seq >= cur.seq):
+                continue
+            cand = self._reread_step(vname, seq, digest)
+            if cand is None:
+                continue
+            try:
+                verify_version(self.dir, cand)
+            except WeightIntegrityError as e:
+                hlog.warning("weights: rollback skipping torn %s: %s",
+                             vname, e)
+                continue
+            new_seq = self._seq + 1
+            self._point_current(new_seq, cand.digest, cand.step,
+                                vname)
+            self._seq = new_seq
+            out = WeightVersion(new_seq, cand.digest, cand.step,
+                                vname)
+            _m_published.labels(kind="rollback").inc()
+            _m_epoch.set(float(new_seq))
+            _journal.record(
+                "weights_published", digest=out.digest, seq=out.seq,
+                step=out.step, kind="rollback", ms=0.0)
+            return out
+        raise WeightError(
+            "rollback: no intact previous version retained "
+            f"(HOROVOD_WEIGHTS_KEEP too low?) under {self.dir}")
+
+    def _reread_step(self, vname: str, seq: int,
+                     digest: str) -> Optional[WeightVersion]:
+        try:
+            with open(os.path.join(self.dir, vname,
+                                   MANIFEST_NAME)) as f:
+                man = json.load(f)
+            return WeightVersion(seq, digest, int(man["step"]), vname)
+        except (OSError, ValueError, KeyError):
+            return None
+
+    def repair(self) -> Optional[WeightVersion]:
+        """Recovery-path check: if CURRENT points at a torn or
+        corrupt version (a trainer died mid-publish, or the publish
+        seam damaged it), re-point at the newest INTACT version so
+        the pool converges instead of rejecting forever. Returns the
+        repaired-to version, or None when CURRENT is healthy (or
+        nothing intact remains)."""
+        cur = _read_current(self.dir)
+        if cur is not None:
+            try:
+                verify_version(self.dir, cur)
+                return None  # healthy
+            except WeightIntegrityError as e:
+                hlog.warning("weights: CURRENT (%s) is damaged: %s",
+                             cur.dir, e)
+        for seq, digest, vname in reversed(self._versions()):
+            if cur is not None and seq >= cur.seq:
+                continue
+            cand = self._reread_step(vname, seq, digest)
+            if cand is None:
+                continue
+            try:
+                verify_version(self.dir, cand)
+            except WeightIntegrityError:
+                continue
+            new_seq = self._seq + 1
+            self._point_current(new_seq, cand.digest, cand.step,
+                                vname)
+            self._seq = new_seq
+            out = WeightVersion(new_seq, cand.digest, cand.step,
+                                vname)
+            _m_published.labels(kind="repair").inc()
+            _m_epoch.set(float(new_seq))
+            _journal.record(
+                "weights_published", digest=out.digest, seq=out.seq,
+                step=out.step, kind="repair", ms=0.0)
+            return out
+        if cur is not None:
+            hlog.error("weights: CURRENT is damaged and no intact "
+                       "predecessor remains under %s", self.dir)
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Subscriber (serving side)
+
+
+class WeightSubscriber:
+    """Poll-based reader of the publisher's directory. `poll()`
+    surfaces each CURRENT seq exactly once (republishing the same
+    digest under a new seq surfaces again — that is the publisher's
+    retry); `load_named()` reads + verifies a version."""
+
+    def __init__(self, dir_: str, *,
+                 env: Optional[Dict[str, str]] = None):
+        self.dir = dir_
+        self._last_seq = 0
+
+    def poll(self) -> Optional[WeightVersion]:
+        cur = _read_current(self.dir)
+        if cur is None or cur.seq <= self._last_seq:
+            return None
+        self._last_seq = cur.seq
+        _m_epoch.set(float(cur.seq))
+        return cur
+
+    def current(self) -> Optional[WeightVersion]:
+        return _read_current(self.dir)
+
+    def load_named(self, version: WeightVersion
+                   ) -> List[Tuple[str, np.ndarray]]:
+        return load_named(self.dir, version)
+
+
+# ---------------------------------------------------------------------------
+# Adoption bookkeeping (called by the serving worker loop so the
+# journal/metric source sites stay here, single-registration)
+
+
+def note_adopted(worker: str, version: WeightVersion, swap_s: float,
+                 staleness_steps: int) -> None:
+    _m_adoptions.labels(outcome="ok").inc()
+    _m_swap_s.observe(swap_s)
+    _m_staleness.labels(worker=worker).set(float(
+        max(0, staleness_steps)))
+    _journal.record(
+        "weights_adopted", worker=worker, digest=version.digest,
+        seq=version.seq, step=version.step,
+        ms=round(swap_s * 1e3, 3),
+        staleness_steps=max(0, staleness_steps))
+
+
+def note_rejected(worker: str, version: WeightVersion, reason: str,
+                  detail: str, serving_digest: str) -> None:
+    _m_adoptions.labels(outcome=reason).inc()
+    _journal.record(
+        "weights_rejected", worker=worker, digest=version.digest,
+        seq=version.seq, reason=reason, detail=detail[:200],
+        serving=serving_digest)
+
+
+def set_staleness(worker: str, staleness_steps: int) -> None:
+    _m_staleness.labels(worker=worker).set(float(
+        max(0, staleness_steps)))
+
+
+def rejection_reason(exc: BaseException) -> str:
+    if isinstance(exc, WeightStructureError):
+        return "structure"
+    if isinstance(exc, WeightIntegrityError):
+        return ("torn" if ("torn" in str(exc)
+                           or "missing" in str(exc)
+                           or "unreadable" in str(exc))
+                else "digest")
+    return "error"
+
+
+# ---------------------------------------------------------------------------
+# Trainer commit-path hook (elastic/state.py) + recovery repair
+# (elastic/run.py)
+
+
+def _rank0() -> bool:
+    import horovod_tpu as hvd
+    return not (hvd.is_initialized() and hvd.rank() != 0)
+
+
+def _host_params(state: Any) -> Any:
+    """The params tree to publish: prefer the host copies
+    `JaxState.save()` just made (riding the snapshot machinery — no
+    second device fetch), fall back to the live attribute for plain
+    State subclasses."""
+    saved = getattr(state, "_tree_saved", None)
+    if isinstance(saved, dict) and saved.get("params") is not None:
+        return saved["params"]
+    return getattr(state, "params", None)
+
+
+def maybe_publish(state: Any,
+                  env: Optional[Dict[str, str]] = None) -> None:
+    """Commit-boundary seam: when HOROVOD_WEIGHTS_DIR and
+    HOROVOD_WEIGHTS_PUBLISH_EVERY are set, rank 0 publishes the
+    just-committed params every N commits (the FIRST commit always
+    publishes, so a fresh pool has a version to adopt). Disarmed this
+    is two registry reads; a publish failure is journaled via the
+    fault/metric paths and training continues."""
+    dir_ = _config.env_value("HOROVOD_WEIGHTS_DIR", env=env)
+    if not dir_:
+        return
+    every = _config.env_value("HOROVOD_WEIGHTS_PUBLISH_EVERY",
+                              env=env)
+    if every <= 0:
+        return
+    count = getattr(state, "_weights_commits", 0) + 1
+    state._weights_commits = count
+    if (count - 1) % every != 0 or not _rank0():
+        return
+    params = _host_params(state)
+    if params is None:
+        return
+    pub = getattr(state, "_weights_publisher", None)
+    if pub is None or pub.dir != dir_:
+        pub = WeightPublisher(dir_, env=env)
+        state._weights_publisher = pub
+    step = getattr(state, "step", None)
+    try:
+        step = int(step) if step is not None else -1
+    except (TypeError, ValueError):
+        step = -1
+    try:
+        pub.publish(params, step)
+    except WeightError as e:
+        hlog.error("weights: publish at commit failed (serving pool "
+                   "keeps its previous version): %s", e)
+
+
+def maybe_repair(env: Optional[Dict[str, str]] = None) -> None:
+    """Elastic-recovery seam (elastic/run.py): a trainer that died
+    mid-publish can leave CURRENT pointing at a damaged version;
+    rank 0 re-points it at the newest intact one before training
+    resumes. Disarmed (no HOROVOD_WEIGHTS_DIR) this is one registry
+    read."""
+    dir_ = _config.env_value("HOROVOD_WEIGHTS_DIR", env=env)
+    if not dir_ or not _rank0() or not os.path.isdir(dir_):
+        return
+    try:
+        WeightPublisher(dir_, env=env).repair()
+    except OSError as e:  # pragma: no cover - fs-dependent
+        hlog.error("weights: repair failed: %s", e)

@@ -1,0 +1,73 @@
+"""HVD004 fixture: live weight pipeline journal/metric effects
+inside the jitted swap path (round 17).
+
+The weight pipeline's contract is that adoption bookkeeping —
+`weights_adopted` / `weights_rejected` journal events, the swap
+histogram, the staleness gauge — happens in the UNTRACED worker
+fence around the device_put + buffer flip, never inside the jitted
+forward or a jitted swap helper. These positives are the tempting
+wrong version — journaling the adoption or observing swap latency
+from inside a jitted function — which would brand one trace-time
+record into the executable per (re)trace; the negatives are the
+fence shape serving.py's `_maybe_adopt` actually uses.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu import journal
+from horovod_tpu.metrics import REGISTRY
+
+_m_fix_swap = REGISTRY.histogram(
+    "hvdfix_weights_swap_seconds",
+    "Seeded weight-swap trace-impurity target.")
+_m_fix_stale = REGISTRY.gauge(
+    "hvdfix_weights_staleness_steps",
+    "Seeded weight-staleness trace-impurity target.")
+
+
+@jax.jit
+def swap_journals_adoption(params, x):
+    journal.record("weights_adopted", digest="d1")  # EXPECT: HVD004
+    return x @ params
+
+
+@jax.jit
+def swap_observes_latency(params, x):
+    _m_fix_swap.observe(0.002)  # EXPECT: HVD004
+    return x @ params
+
+
+@jax.jit
+def swap_stamps_clock(params, x):
+    t0 = time.monotonic_ns()  # EXPECT: HVD004
+    return x @ params * (t0 % 2)
+
+
+@jax.jit
+def forward_sets_staleness(params, x):
+    _m_fix_stale.set(3.0)  # EXPECT: HVD004
+    return jnp.tanh(x @ params)
+
+
+# -- negatives: the between-batches fence shape serving.py uses ------------
+
+@jax.jit
+def pure_two_arg_forward(params, x):
+    return jnp.tanh(x @ params)
+
+
+def adopt_effects_outside_trace(params, x):
+    # verify + device_put + buffer flip happen in plain python at
+    # the fence; the jitted forward only ever sees the swapped-in
+    # params as an argument — the intended split
+    t0 = time.monotonic_ns()
+    live = jax.device_put(params)
+    y = pure_two_arg_forward(live, x)
+    t1 = time.monotonic_ns()
+    _m_fix_swap.observe((t1 - t0) / 1e9)
+    _m_fix_stale.set(0.0)
+    journal.record("weights_adopted", digest="d2")
+    return y

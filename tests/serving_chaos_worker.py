@@ -19,6 +19,11 @@ Env contract (set by the test): SERVING_TEST_ADDR / SERVING_TEST_PORT
 — distinct from the runner's own HOROVOD_SECRET), SERVING_TEST_DMODEL.
 The seeded fault (HOROVOD_FAULTS=serving.batch:crash:...) arms from
 env inside hvd.init() and fires mid-batch inside remote_worker_loop.
+With SERVING_TEST_WEIGHTS_DIR set the member serves the two-arg
+live-weight forward (bootstrap params deterministic from DMODEL, so
+the launching frontend derives the identical tree) and hot-swaps from
+that pipeline directory between pulls — a seeded
+weights.adopt:crash is then a REAL process death mid-swap.
 """
 
 import os
@@ -36,10 +41,21 @@ import horovod_tpu as hvd  # noqa: E402
 from horovod_tpu import serving  # noqa: E402
 
 D = int(os.environ.get("SERVING_TEST_DMODEL", "8"))
+WEIGHTS_DIR = os.environ.get("SERVING_TEST_WEIGHTS_DIR", "")
 
 
 def forward(x):
     return jnp.tanh(x) * 2.0
+
+
+def forward_weighted(params, x):
+    return jnp.tanh(x @ params["w"]) + params["b"]
+
+
+def bootstrap_params():
+    # Deterministic in D: the launching test builds the same tree so
+    # the structure digests agree across the wire.
+    return {"w": jnp.eye(D), "b": jnp.zeros((D,))}
 
 
 def main():
@@ -54,11 +70,19 @@ def main():
     else:
         hvd.init()
         wid = f"rank{hvd.rank()}-pid{os.getpid()}"
-    n = serving.remote_worker_loop(
-        os.environ["SERVING_TEST_ADDR"],
-        int(os.environ["SERVING_TEST_PORT"]),
-        forward, (D,), wid=wid,
-        secret=os.environ.get("SERVING_TEST_SECRET", ""))
+    if WEIGHTS_DIR:
+        n = serving.remote_worker_loop(
+            os.environ["SERVING_TEST_ADDR"],
+            int(os.environ["SERVING_TEST_PORT"]),
+            forward_weighted, (D,), wid=wid,
+            secret=os.environ.get("SERVING_TEST_SECRET", ""),
+            params=bootstrap_params(), weights_dir=WEIGHTS_DIR)
+    else:
+        n = serving.remote_worker_loop(
+            os.environ["SERVING_TEST_ADDR"],
+            int(os.environ["SERVING_TEST_PORT"]),
+            forward, (D,), wid=wid,
+            secret=os.environ.get("SERVING_TEST_SECRET", ""))
     print(f"serving worker {wid}: served {n} batches", flush=True)
     if not standalone:
         hvd.shutdown()
