@@ -1898,6 +1898,328 @@ def serving_main() -> None:
         "unit": "ms", "vs_baseline": 1.0}), flush=True)
 
 
+def _regen_decode_attribution(here):
+    """Regenerate benchmarks/SERVING_ATTRIBUTION_r18.json from the
+    COMMITTED decode trace recording (benchmarks/serving_decode_r18/)
+    — the same pure-function-of-committed-bytes contract as the r16
+    artifact: `doctor serve` on that directory and every rerun of
+    this function produce identical bytes. Returns the report, or
+    None when no recording is committed."""
+    from horovod_tpu import journal as hjournal
+    from horovod_tpu import serving_trace as hserving_trace
+
+    record_dir = os.environ.get("BENCH_DECODE_RECORD_DIR") \
+        or os.path.join(here, "benchmarks", "serving_decode_r18")
+    out = os.environ.get("BENCH_DECODE_ATTRIBUTION_OUT") \
+        or os.path.join(here, "benchmarks",
+                        "SERVING_ATTRIBUTION_r18.json")
+    if not (os.path.isdir(record_dir)
+            and hjournal.find_journal_files(record_dir)):
+        log(f"bench[decode]: no recorded traces under {record_dir}; "
+            "skipping decode attribution regeneration")
+        return None
+    path, report = hserving_trace.write_serving_report(record_dir)
+    with open(path, "rb") as f:
+        data = f.read()
+    with open(out, "wb") as f:
+        f.write(data)
+    log(f"bench[decode]: attribution written to {out} (and {path})")
+    return report
+
+
+def serving_decode_main() -> None:
+    """`--serving-decode`: measure the continuous-batching decode
+    plane (horovod_tpu/decoding.py) on this host and write
+    benchmarks/BENCH_serving_decode_r18.json — a tokens/s scale-out
+    curve over worker counts (the sharded admission plane must keep
+    it monotone 1->2->4), goodput vs offered QPS per SLO class
+    through the interactive/batch lanes, and the chaos leg: a REAL
+    worker process crash (exit 43) mid-sequence, after which every
+    in-flight sequence resumes from its KV watermark on a survivor
+    process — zero dropped sequences and streams bitwise identical
+    to an uninterrupted baseline (the exactly-once token latch means
+    no delivered token is ever re-emitted). With
+    BENCH_SERVING_RECORD=1 the 1-/2-worker scale-out legs and the
+    chaos leg journal per-sequence traces into
+    benchmarks/serving_decode_r18/ (the committed recording behind
+    SERVING_ATTRIBUTION_r18.json); every run then regenerates that
+    attribution artifact from the committed bytes — its
+    decode_attribution block is the evidence that the r16 batch_cut
+    bottleneck (95.1% of the request-plane scale-out regression)
+    does not reappear as admission serialization on the decode
+    plane."""
+    import subprocess
+
+    from horovod_tpu import decoding as hdecoding
+    from horovod_tpu import journal as hjournal
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.environ.get("BENCH_SERVING_DECODE_OUT") \
+        or os.path.join(here, "benchmarks",
+                        "BENCH_serving_decode_r18.json")
+    record = bool(os.environ.get("BENCH_SERVING_RECORD"))
+    record_dir = os.environ.get("BENCH_DECODE_RECORD_DIR") \
+        or os.path.join(here, "benchmarks", "serving_decode_r18")
+
+    d_model = int(os.environ.get("BENCH_DECODE_DMODEL", "256"))
+    vocab = int(os.environ.get("BENCH_DECODE_VOCAB", "1024"))
+    params = hdecoding.make_toy_params(vocab=vocab, d_model=d_model,
+                                       seed=18)
+
+    denv = dict(os.environ)
+    denv.update({
+        "HOROVOD_KV_PAGE_TOKENS": denv.get(
+            "HOROVOD_KV_PAGE_TOKENS", "16"),
+        "HOROVOD_KV_MAX_CONTEXT": denv.get(
+            "HOROVOD_KV_MAX_CONTEXT", "128"),
+        "HOROVOD_SERVING_DECODE_SLOTS": denv.get(
+            "HOROVOD_SERVING_DECODE_SLOTS", "8"),
+        "HOROVOD_SERVING_DECODE_WATERMARK_STRIDE": "8",
+        "HOROVOD_SERVING_DECODE_RETRY_BACKOFF_MS": "10",
+        "HOROVOD_SERVING_DECODE_LEASE_TIMEOUT_S": "5",
+    })
+    rng = np.random.RandomState(18)
+
+    def make_prompts(n, hi):
+        return [rng.randint(1, hi,
+                            size=int(rng.randint(4, 12))).astype(
+                                np.int32)
+                for _ in range(n)]
+
+    def wait_warm(fe, timeout=120.0):
+        # AOT rung warmup runs on the worker threads; wait for every
+        # LOCAL engine to pin its rung set so the timed window
+        # measures steady-state decode, not compilation.
+        nrungs = len(fe.ladder.rungs)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            engines = [t.engine for t in list(fe._threads.values())]
+            if not engines or all(e.compiles >= nrungs
+                                  for e in engines):
+                return
+            time.sleep(0.02)
+
+    def run_decode_leg(prompts, workers, max_new=48, qps=0.0,
+                       slo_of=None, tag=None, record_to=None):
+        env = dict(denv)
+        if record_to:
+            os.makedirs(record_to, exist_ok=True)
+            env["HOROVOD_JOURNAL_DIR"] = record_to
+        fe = hdecoding.DecodeFrontend(
+            workers=workers, params=params, env=env, trace_tag=tag)
+        fe.start_watchdog()
+        wait_warm(fe)
+        gap = (1.0 / qps) if qps else 0.0
+        futs = []
+        t0 = time.perf_counter()
+        for i, p in enumerate(prompts):
+            futs.append(fe.submit(
+                p, max_new_tokens=max_new,
+                slo_ms=(slo_of(i) if slo_of else None), seed=i))
+            if gap:
+                time.sleep(gap)
+        outs = [f.result(timeout=300) for f in futs]
+        wall = time.perf_counter() - t0
+        stats = fe.stats()
+        fe.close()
+        if record_to:
+            hjournal.disarm()
+        delivered = sum(len(o) for o in outs)
+        ttfts = sorted((f.t_first_ns - f.t_submit_ns) / 1e6
+                       for f in futs if f.t_first_ns)
+        leg = {
+            "sequences": len(futs),
+            "delivered_tokens": delivered,
+            "tokens_per_s": round(delivered / wall, 1),
+            "wall_s": round(wall, 3),
+            "ttft_p50_ms": round(np.percentile(ttfts, 50), 3),
+            "ttft_p99_ms": round(np.percentile(ttfts, 99), 3),
+        }
+        return leg, stats, futs
+
+    # -- scale-out: fixed token workload over 1/2/4 local workers ------
+    n_scale = int(os.environ.get("BENCH_DECODE_SEQS", "24"))
+    scaleout = {}
+    ladder_digest = None
+    for w in (1, 2, 4):
+        rec = record_dir if (record and w in (1, 2)) else None
+        leg, st, _ = run_decode_leg(
+            make_prompts(n_scale, vocab), w, max_new=48,
+            tag=f"d{w}", record_to=rec)
+        ladder_digest = st["ladder"]
+        scaleout[f"workers{w}"] = {
+            "tokens_per_s": leg["tokens_per_s"],
+            "ttft_p99_ms": leg["ttft_p99_ms"],
+            "steals": st["steals"],
+        }
+        log(f"bench[decode]: workers={w} "
+            f"tokens/s={leg['tokens_per_s']}")
+    t1 = scaleout["workers1"]["tokens_per_s"]
+    t2 = scaleout["workers2"]["tokens_per_s"]
+    t4 = scaleout["workers4"]["tokens_per_s"]
+    if not (t1 <= t2 <= t4):
+        log("bench[decode]: WARNING scale-out not monotone "
+            f"({t1} -> {t2} -> {t4} tokens/s)")
+
+    # -- goodput vs offered QPS, per SLO class over the two lanes ------
+    def slo_of(i):
+        return 250.0 if i % 2 == 0 else None
+
+    goodput_vs_qps = {}
+    for qps in (20, 40):
+        leg, st, futs = run_decode_leg(
+            make_prompts(24, vocab), 2, max_new=32, qps=qps,
+            slo_of=slo_of)
+        by_lane = {}
+        for f in futs:
+            if f.t_first_ns:
+                by_lane.setdefault(f.lane, []).append(
+                    (f.t_first_ns - f.t_submit_ns) / 1e6)
+        goodput_vs_qps[f"qps{qps}"] = {
+            "tokens_per_s": leg["tokens_per_s"],
+            "goodput": st["goodput"],
+            "ttft_p99_ms_by_lane": {
+                lane: round(np.percentile(sorted(v), 99), 3)
+                for lane, v in sorted(by_lane.items())},
+        }
+        log(f"bench[decode]: qps={qps} goodput={st['goodput']}")
+
+    # -- chaos: REAL process crash mid-sequence, survivor resumes ------
+    # The remote workers build their engines from env knobs and the
+    # module's DEFAULT toy LM, so the uninterrupted baseline below
+    # must use the defaults too (bitwise comparability).
+    cenv = dict(denv)
+    cenv.update({
+        "HOROVOD_KV_PAGE_TOKENS": "8",
+        "HOROVOD_KV_MAX_CONTEXT": "64",
+        "HOROVOD_SERVING_DECODE_SLOTS": "4",
+        "HOROVOD_SERVING_DECODE_WATERMARK_STRIDE": "4",
+        "HOROVOD_SERVING_DECODE_LEASE_TIMEOUT_S": "2.0",
+    })
+    cenv.pop("HOROVOD_JOURNAL_DIR", None)
+    n_chaos = 6
+    cprompts = make_prompts(n_chaos, 32)  # default toy vocab
+
+    fe = hdecoding.DecodeFrontend(workers=1, env=cenv,
+                                  trace_tag="dkillbase")
+    try:
+        futs = [fe.submit(p, max_new_tokens=24, seed=i)
+                for i, p in enumerate(cprompts)]
+        base = [list(f.result(timeout=300)) for f in futs]
+    finally:
+        fe.close()
+
+    chaos_env = dict(cenv)
+    if record:
+        os.makedirs(record_dir, exist_ok=True)
+        chaos_env["HOROVOD_JOURNAL_DIR"] = record_dir
+    fe2 = hdecoding.DecodeFrontend(workers=0, env=chaos_env,
+                                   trace_tag="dkill")
+    fe2.start_watchdog()
+    port, secret = fe2.decode_endpoint()
+    fault_spec = os.environ.get("BENCH_DECODE_CHAOS_FAULTS",
+                                "decode.step:crash:at=15")
+
+    def spawn(wid, fault=None):
+        env = {k: str(v) for k, v in cenv.items()}
+        env.update({
+            "DECODE_TEST_ADDR": "127.0.0.1",
+            "DECODE_TEST_PORT": str(port),
+            "DECODE_TEST_SECRET": secret,
+            "DECODE_TEST_WID": wid,
+            "JAX_PLATFORMS": "cpu",
+        })
+        if fault:
+            env["HOROVOD_FAULTS"] = fault
+            env["HOROVOD_FAULTS_SEED"] = "18"
+        return subprocess.Popen(
+            [sys.executable,
+             os.path.join(here, "tests", "decode_chaos_worker.py")],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+
+    victim = spawn("victim", fault=fault_spec)
+    chaos = {"fault_spec": fault_spec, "sequences": n_chaos}
+    try:
+        futs = [fe2.submit(p, max_new_tokens=24, seed=i)
+                for i, p in enumerate(cprompts)]
+        rc = victim.wait(timeout=300)
+        survivor = spawn("survivor")
+        try:
+            outs = [list(f.result(timeout=300)) for f in futs]
+            st = fe2.stats()
+            chaos.update({
+                "worker_exit_code": rc,
+                "completed": st["completed"],
+                "dropped": sum(
+                    1 for f in futs
+                    if f.outcome not in ("ok", "truncated")),
+                "failed": st["failed"],
+                "resumed": st["resumed"],
+                "duplicate_tokens_suppressed": st["dupes"],
+                "streams_match_uninterrupted_baseline":
+                    bool(outs == base),
+            })
+        finally:
+            fe2.close()
+            survivor.wait(timeout=60)
+    finally:
+        if victim.poll() is None:
+            victim.kill()
+        if record:
+            hjournal.disarm()
+    if (chaos.get("dropped") or chaos.get("failed")
+            or not chaos.get("streams_match_uninterrupted_baseline")):
+        log(f"bench[decode]: WARNING chaos leg did not behave "
+            f"({chaos})")
+
+    doc = {
+        "what": "Continuous-batching decode plane measured on this "
+                "host (horovod_tpu/decoding.py): tokens/s scale-out "
+                "over worker counts through the sharded admission "
+                "plane, goodput vs offered QPS per SLO class "
+                "through the interactive/batch lanes, and the chaos "
+                "accounting for a REAL worker process crash "
+                "mid-sequence - zero dropped sequences and streams "
+                "bitwise identical to the uninterrupted baseline is "
+                "the acceptance bar.",
+        "generated_by": "python bench.py --serving-decode",
+        "model": {"kind": "toy-lm", "d_model": d_model,
+                  "vocab": vocab, "dtype": "float32"},
+        "kv_ladder": ladder_digest,
+        "config": {
+            "slots": int(denv["HOROVOD_SERVING_DECODE_SLOTS"]),
+            "page_tokens": int(denv["HOROVOD_KV_PAGE_TOKENS"]),
+            "max_context": int(denv["HOROVOD_KV_MAX_CONTEXT"]),
+            "watermark_stride": int(
+                denv["HOROVOD_SERVING_DECODE_WATERMARK_STRIDE"]),
+        },
+        "scaleout": scaleout,
+        "goodput_vs_qps": goodput_vs_qps,
+        "chaos": chaos,
+        "metrics": _metrics_snapshot(),
+        "journal": _journal_digest(),
+    }
+    attribution = _regen_decode_attribution(here)
+    if attribution is not None:
+        dec = attribution.get("decode_attribution")
+        doc["decode_attribution"] = {
+            "admission_share_base": dec["admission_share_base"],
+            "admission_share_scaled": dec["admission_share_scaled"],
+            "dominant_phase": dec["dominant_phase"],
+            "source": "benchmarks/SERVING_ATTRIBUTION_r18.json",
+        } if dec else {}
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    log(f"bench[decode]: written to {out_path}")
+    print(json.dumps({
+        "metric": "serving_decode_scaleout4_tokens_per_s",
+        "value": scaleout["workers4"]["tokens_per_s"],
+        "unit": "tokens/s", "vs_baseline": 1.0}), flush=True)
+
+
 def weight_swap_main() -> None:
     """`--weight-swap`: measure the train-to-serve live weight
     pipeline (horovod_tpu/weights.py + serving.py adoption) on this
@@ -2328,6 +2650,45 @@ def trajectory_main() -> None:
                     "injected mid-swap chaos",
             "source": "benchmarks/BENCH_weightswap_r17.json",
         },
+        "r18_decode": {
+            "scaleout_1worker_tokens_per_s": read(
+                "benchmarks/BENCH_serving_decode_r18.json",
+                "scaleout", "workers1", "tokens_per_s"),
+            "scaleout_2worker_tokens_per_s": read(
+                "benchmarks/BENCH_serving_decode_r18.json",
+                "scaleout", "workers2", "tokens_per_s"),
+            "scaleout_4worker_tokens_per_s": read(
+                "benchmarks/BENCH_serving_decode_r18.json",
+                "scaleout", "workers4", "tokens_per_s"),
+            "chaos_dropped_sequences": read(
+                "benchmarks/BENCH_serving_decode_r18.json",
+                "chaos", "dropped"),
+            "chaos_resumed_sequences": read(
+                "benchmarks/BENCH_serving_decode_r18.json",
+                "chaos", "resumed"),
+            "chaos_streams_match_baseline": read(
+                "benchmarks/BENCH_serving_decode_r18.json",
+                "chaos", "streams_match_uninterrupted_baseline"),
+            "admission_share_base": read(
+                "benchmarks/SERVING_ATTRIBUTION_r18.json",
+                "decode_attribution", "admission_share_base"),
+            "admission_share_scaled": read(
+                "benchmarks/SERVING_ATTRIBUTION_r18.json",
+                "decode_attribution", "admission_share_scaled"),
+            "r16_request_plane_dominant_share": read(
+                "benchmarks/SERVING_ATTRIBUTION_r16.json",
+                "attribution", "dominant_share"),
+            "note": "continuous-batching decode with per-sequence "
+                    "exactly-once recovery: monotone tokens/s "
+                    "scale-out through the sharded admission plane "
+                    "(the r16 batch_cut analog, admission, no "
+                    "longer dominates the 1->2-worker delta), and "
+                    "a real mid-sequence worker crash resumed from "
+                    "the KV watermark with zero dropped sequences "
+                    "and zero re-emitted tokens",
+            "source": "benchmarks/BENCH_serving_decode_r18.json + "
+                      "benchmarks/SERVING_ATTRIBUTION_r18.json",
+        },
     }
     with open(out_path, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True)
@@ -2335,7 +2696,7 @@ def trajectory_main() -> None:
     log(f"bench[trajectory]: written to {out_path}")
     print(json.dumps({
         "metric": "trajectory_rounds_recorded",
-        "value": len(headline) + 7, "unit": "rounds",
+        "value": len(headline) + 8, "unit": "rounds",
         "vs_baseline": 1.0}), flush=True)
 
 
@@ -2667,6 +3028,8 @@ if __name__ == "__main__":
         scaling_report_main()
     elif "--serving-attribution" in sys.argv:
         serving_attribution_main()
+    elif "--serving-decode" in sys.argv:
+        serving_decode_main()
     elif "--weight-swap" in sys.argv:
         weight_swap_main()
     elif "--serving" in sys.argv:

@@ -424,7 +424,8 @@ KNOBS: List[Knob] = [
          "wire.send, wire.recv, rendezvous.http, discovery.poll, "
          "elastic.step, dispatch.entry, numerics.grad, "
          "numerics.param, host.preempt, serving.batch, "
-         "weights.publish, weights.adopt. Actions: "
+         "weights.publish, weights.adopt, decode.step, kv.page. "
+         "Actions: "
          "drop, delay, corrupt, torn, error, crash, hang, nan, inf, "
          "flip, preempt. Empty = every injection point compiles to a "
          "no-op."),
@@ -493,6 +494,68 @@ KNOBS: List[Knob] = [
          "hvd_serving_goodput_total / hvd_serving_slo_miss_total "
          "accounting. 0 = use HOROVOD_SERVING_LATENCY_BUDGET_MS "
          "(the admission budget) as the default deadline."),
+    # -- continuous-batching decode (serving v2) -----------------------------
+    Knob("HOROVOD_SERVING_DECODE_SLOTS", int, 4,
+         "Running-batch width of each decode worker (decoding.py): "
+         "the number of sequences a worker advances per token step. "
+         "Sequences join and leave the running batch at step "
+         "boundaries (continuous batching), so a free slot is the "
+         "admission unit, not a batch lifetime."),
+    Knob("HOROVOD_SERVING_DECODE_MAX_NEW_TOKENS", int, 64,
+         "Default generation cap for submit() calls that pass no "
+         "max_new_tokens: a sequence finishes when it has emitted "
+         "this many tokens (or its prompt+output reaches "
+         "HOROVOD_KV_MAX_CONTEXT, whichever is first)."),
+    Knob("HOROVOD_SERVING_DECODE_WATERMARK_STRIDE", int, 8,
+         "Journal a seq_watermark record (last durably-emitted token "
+         "index) every N emitted tokens per sequence. Recovery "
+         "re-prefills from the in-memory latch, so the stride bounds "
+         "journal volume, not recovery work; doctor serve's "
+         "watermark-resume spans read these records."),
+    Knob("HOROVOD_SERVING_DECODE_INTERACTIVE_SLO_MS", float, 250.0,
+         "Lane classifier: a sequence submitted with slo_ms at or "
+         "below this is 'interactive', above it (or with no slo_ms) "
+         "'batch'. Interactive sequences are admitted first and keep "
+         "their deadline when the pool shrinks; batch sequences shed "
+         "first."),
+    Knob("HOROVOD_SERVING_DECODE_LANE_BUDGET", float, 0.5,
+         "Fraction of the pool's running-batch slots reserved for "
+         "the interactive lane while interactive sequences are "
+         "waiting: batch-lane sequences are not admitted into (and "
+         "under pool shrinkage are shed from) the reserved slots. "
+         "0 disables the reservation."),
+    Knob("HOROVOD_SERVING_DECODE_RETRY_LIMIT", int, 3,
+         "Re-admissions per sequence after worker deaths before the "
+         "frontend fails it visibly (a failed sequence surfaces a "
+         "DecodeError through its future; it is never silently "
+         "dropped)."),
+    Knob("HOROVOD_SERVING_DECODE_RETRY_BACKOFF_MS", float, 25.0,
+         "Base backoff in milliseconds before a dead worker's "
+         "sequence becomes admission-eligible again, doubling per "
+         "re-admission of the same sequence (25, 50, 100, ...) so a "
+         "crash-looping pool does not thrash re-prefills."),
+    Knob("HOROVOD_SERVING_DECODE_LEASE_TIMEOUT_S", float, 10.0,
+         "Per-worker liveness deadline for leased sequences: a "
+         "decode worker that neither emits nor finishes anything for "
+         "this long is declared dead and its in-flight sequences are "
+         "re-admitted on survivors from their watermarks."),
+    Knob("HOROVOD_SERVING_DECODE_EMIT_STRIDE", int, 1,
+         "Remote decode members flush emitted tokens to the frontend "
+         "every N token steps (1 = per step). Tokens are 'delivered' "
+         "only when the frontend latches them, so a larger stride "
+         "trades wire round-trips for up to N-1 tokens of re-decode "
+         "after a worker death — never duplicate delivery."),
+    Knob("HOROVOD_KV_PAGE_TOKENS", int, 16,
+         "Tokens per KV-cache page: the base rung of the pow2 "
+         "KV-page ladder (decoding.py). A worker's cache is padded "
+         "to whole rungs, so context growth moves between a small "
+         "closed set of shapes the warmup pass already compiled — "
+         "cache growth never recompiles."),
+    Knob("HOROVOD_KV_MAX_CONTEXT", int, 256,
+         "Longest context (prompt + generated tokens) the KV-page "
+         "ladder covers; the rung set is HOROVOD_KV_PAGE_TOKENS "
+         "doublings up to this value, and a sequence that would "
+         "outgrow it finishes with outcome 'truncated'."),
     # -- live weight pipeline (train-to-serve) -------------------------------
     Knob("HOROVOD_WEIGHTS_DIR", str, "",
          "Directory of the live weight pipeline (weights.py): the "
@@ -713,6 +776,25 @@ class Config:
         "serving_trace": "HOROVOD_SERVING_TRACE",
         "serving_trace_buffer": "HOROVOD_SERVING_TRACE_BUFFER",
         "serving_default_slo_ms": "HOROVOD_SERVING_DEFAULT_SLO_MS",
+        "serving_decode_slots": "HOROVOD_SERVING_DECODE_SLOTS",
+        "serving_decode_max_new_tokens":
+            "HOROVOD_SERVING_DECODE_MAX_NEW_TOKENS",
+        "serving_decode_watermark_stride":
+            "HOROVOD_SERVING_DECODE_WATERMARK_STRIDE",
+        "serving_decode_interactive_slo_ms":
+            "HOROVOD_SERVING_DECODE_INTERACTIVE_SLO_MS",
+        "serving_decode_lane_budget":
+            "HOROVOD_SERVING_DECODE_LANE_BUDGET",
+        "serving_decode_retry_limit":
+            "HOROVOD_SERVING_DECODE_RETRY_LIMIT",
+        "serving_decode_retry_backoff_ms":
+            "HOROVOD_SERVING_DECODE_RETRY_BACKOFF_MS",
+        "serving_decode_lease_timeout_s":
+            "HOROVOD_SERVING_DECODE_LEASE_TIMEOUT_S",
+        "serving_decode_emit_stride":
+            "HOROVOD_SERVING_DECODE_EMIT_STRIDE",
+        "kv_page_tokens": "HOROVOD_KV_PAGE_TOKENS",
+        "kv_max_context": "HOROVOD_KV_MAX_CONTEXT",
         "weights_dir": "HOROVOD_WEIGHTS_DIR",
         "weights_publish_every": "HOROVOD_WEIGHTS_PUBLISH_EVERY",
         "weights_shard_mb": "HOROVOD_WEIGHTS_SHARD_MB",

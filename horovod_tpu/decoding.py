@@ -1,0 +1,1484 @@
+"""Continuous-batching autoregressive decode with per-sequence recovery.
+
+This module is the decode plane of the serving tier (``serving.py`` is
+the request/response plane for one-shot inference).  It reproduces the
+iteration-level scheduling of Orca and the paged KV cache of vLLM on
+top of this repo's primitives:
+
+* **Continuous batching** — sequences join and leave the running batch
+  per decode *step*, not per batch lifetime.  Each worker owns a fixed
+  number of slots; a finished sequence frees its slot immediately and
+  the next queued sequence is admitted on the very next step.
+
+* **Bucketed KV pages** — the KV cache for a worker is a single dense
+  array whose length rides a pow2 page ladder (``KVLadder``, the KV
+  analog of serving's ``BucketLadder``).  Growth moves to the next
+  rung; every rung is AOT-compiled at warmup so cache growth never
+  recompiles (compile count pinned flat past warmup).
+
+* **Per-sequence exactly-once recovery** — each sequence journals a KV
+  watermark (last durably-emitted token index) at a configurable
+  stride.  When a worker dies mid-sequence its leased sequences are
+  re-admitted on survivors from the watermark: the survivor re-prefills
+  the prompt plus every already-delivered token and emits nothing for
+  the replay region, so a delivered token is never re-emitted.  The
+  emission latch is per-(sequence, epoch): every re-admission or shed
+  bumps the sequence epoch, so a revenant worker (one that hung and
+  woke up after its lease was revoked) cannot emit — its tokens are
+  rejected and counted as duplicates.  This generalizes the per-batch
+  result latch of serving.py to per-token granularity.
+
+* **Sharded admission** — the r16 attribution pinned 95.1% of the
+  1→2-worker scale-out regression on the single-threaded admission
+  loop (batch_cut).  Here there is no central batcher: each worker has
+  its own admission queue, ``submit`` routes to the least-loaded
+  queue, and an idle worker steals from the longest queue.  Admission
+  is a per-worker fence, not a global serialization point.
+
+* **SLO lanes** — sequences with ``slo_ms`` at or below the
+  interactive threshold ride the interactive lane; the rest ride the
+  batch lane.  A lane budget reserves slots for interactive work.
+  When the pool shrinks below the budget the batch lane sheds first
+  (least-progressed batch sequence is parked and re-queued), so the
+  interactive lane keeps its first-token deadline.
+
+Fault seams: ``decode.step`` fires once per running-batch step per
+worker (tag = worker id) and supports delay/error/crash/hang;
+``kv.page`` fires once per rung move and supports delay/error/crash.
+A crash in a remote worker is a real ``os._exit`` mid-sequence — the
+chaos bench leg and the integration test kill a real process and prove
+zero dropped sequences and zero re-emitted tokens.
+
+Remote workers speak a lease/emit protocol over the BasicService HMAC
+wire: ``lease`` hands out sequence specs (respecting the same lane
+fence as local admission), ``emit`` delivers token batches and returns
+the set of revoked sequence ids so a shed or re-admitted sequence
+stops occupying a remote slot.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from . import faults as _faults
+from . import journal as _journal
+from . import tracing as _tracing
+from .common import config as _config
+from .common import logging as hlog
+from .metrics import (
+    DECODE_STEP_BUCKETS,
+    REGISTRY,
+    SERVING_LATENCY_BUCKETS,
+)
+from .parallel.aot import aot_compile
+
+
+# ---------------------------------------------------------------------------
+# Metrics (hvd_decode_* family)
+# ---------------------------------------------------------------------------
+
+_m_seqs = REGISTRY.counter(
+    "hvd_decode_sequences_total",
+    "Decode sequences finished, by outcome (ok/failed/truncated).",
+    ("outcome",),
+)
+_m_tokens = REGISTRY.counter(
+    "hvd_decode_tokens_total",
+    "Tokens durably emitted to callers, by SLO lane.",
+    ("lane",),
+)
+_m_steps = REGISTRY.counter(
+    "hvd_decode_steps_total",
+    "Running-batch decode steps executed across all workers.",
+)
+_m_step_s = REGISTRY.histogram(
+    "hvd_decode_step_seconds",
+    "Wall time of one running-batch decode step.",
+    (),
+    buckets=DECODE_STEP_BUCKETS,
+)
+_m_occupancy = REGISTRY.gauge(
+    "hvd_decode_slot_occupancy",
+    "Occupied decode slots per worker.",
+    ("worker",),
+)
+_m_queue = REGISTRY.gauge(
+    "hvd_decode_queue_depth",
+    "Queued sequences awaiting admission, by lane.",
+    ("lane",),
+)
+_m_resumed = REGISTRY.counter(
+    "hvd_decode_sequences_resumed_total",
+    "Sequences re-admitted from their KV watermark, by cause.",
+    ("cause",),
+)
+_m_dupes = REGISTRY.counter(
+    "hvd_decode_duplicate_emissions_total",
+    "Token emissions rejected by the exactly-once latch.",
+)
+_m_steals = REGISTRY.counter(
+    "hvd_decode_admission_steals_total",
+    "Sequences stolen from another worker's admission queue.",
+)
+_m_shed = REGISTRY.counter(
+    "hvd_decode_sequences_shed_total",
+    "Sequences parked to free a slot for the interactive lane.",
+    ("lane",),
+)
+_m_rung_moves = REGISTRY.counter(
+    "hvd_decode_kv_rung_moves_total",
+    "KV cache growth events onto a larger ladder rung.",
+)
+_m_compiles = REGISTRY.counter(
+    "hvd_decode_compiles_total",
+    "Decode step compilations (pinned flat past warmup).",
+)
+_m_ttft = REGISTRY.histogram(
+    "hvd_decode_ttft_seconds",
+    "Time to first durably-emitted token.",
+    ("lane",),
+    buckets=SERVING_LATENCY_BUCKETS,
+)
+_m_goodput = REGISTRY.counter(
+    "hvd_decode_goodput_tokens_total",
+    "Tokens from sequences whose first token met its SLO class.",
+    ("slo",),
+)
+_m_slo_miss = REGISTRY.counter(
+    "hvd_decode_slo_miss_total",
+    "Sequences whose first token missed its SLO deadline.",
+    ("slo", "reason"),
+)
+_m_workers = REGISTRY.gauge(
+    "hvd_decode_workers",
+    "Live decode workers known to the frontend.",
+)
+
+
+class DecodeError(RuntimeError):
+    """A sequence failed permanently (retries exhausted or drained)."""
+
+
+class _WorkerDied(RuntimeError):
+    """Injected decode-step failure (fault seam ``decode.step``)."""
+
+
+# ---------------------------------------------------------------------------
+# KV page ladder
+# ---------------------------------------------------------------------------
+
+class KVLadder(NamedTuple):
+    """Pow2 KV-cache page rungs with a canonical compile digest.
+
+    The KV analog of serving's ``BucketLadder``: every context length
+    is served by the smallest rung that fits, rungs are pow2 multiples
+    of the page size, and the digest pins the AOT compile set so cache
+    growth never recompiles.
+    """
+
+    rungs: Tuple[int, ...]
+    page: int
+    digest: str
+
+    def rung_for(self, length: int) -> int:
+        for r in self.rungs:
+            if length <= r:
+                return r
+        raise ValueError(
+            "context length %d exceeds KV ladder max %d"
+            % (length, self.rungs[-1])
+        )
+
+
+def build_kv_ladder(env=None) -> KVLadder:
+    """Build the KV page ladder from HOROVOD_KV_* knobs."""
+    page = int(_config.env_value("HOROVOD_KV_PAGE_TOKENS", env=env))
+    maxctx = int(_config.env_value("HOROVOD_KV_MAX_CONTEXT", env=env))
+    if page < 1:
+        raise ValueError("HOROVOD_KV_PAGE_TOKENS must be >= 1")
+    if maxctx < page:
+        raise ValueError(
+            "HOROVOD_KV_MAX_CONTEXT (%d) < HOROVOD_KV_PAGE_TOKENS (%d)"
+            % (maxctx, page)
+        )
+    rungs = [page]
+    while rungs[-1] < maxctx:
+        rungs.append(rungs[-1] * 2)
+    if rungs[-1] != maxctx:
+        # Clamp the top rung to the configured max context: the digest
+        # must reflect the exact compiled shapes.
+        rungs[-1] = maxctx
+        rungs = sorted(set(rungs))
+    digest = "kv-ladder-v1|page=%d|r=%s" % (
+        page,
+        ",".join(str(r) for r in rungs),
+    )
+    return KVLadder(rungs=tuple(rungs), page=page, digest=digest)
+
+
+# ---------------------------------------------------------------------------
+# Toy autoregressive model (deterministic, history-dependent)
+# ---------------------------------------------------------------------------
+
+def make_toy_params(vocab: int = 32, d_model: int = 16, seed: int = 0):
+    """Deterministic toy LM parameters (embed + unembed)."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(seed)
+    embed = rng.standard_normal((vocab, d_model)).astype(np.float32)
+    unembed = rng.standard_normal((d_model, vocab)).astype(np.float32)
+    return {"embed": jnp.asarray(embed), "unembed": jnp.asarray(unembed)}
+
+
+def _toy_step(params, kv, tokens, positions, seeds):
+    """One decode step of the toy LM.  Pure: safe under jit (HVD004).
+
+    kv: (slots, rung, d_model) f32 — per-slot KV history.
+    tokens: (slots,) i32 — the token each slot feeds this step.
+    positions: (slots,) i32 — write position of that token.
+    seeds: (slots,) u32 — per-sequence sampling seed.
+
+    Returns (new_kv, next_tokens, logits).  Slots are independent
+    (vmapped writes, masked attention per slot), so neighbors can
+    never affect a slot's logits — this is what makes the re-prefill
+    bitwise-equivalence test meaningful.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    h = params["embed"][tokens]
+    kv2 = jax.vmap(lambda c, p, v: c.at[p].set(v))(kv, positions, h)
+    rung = kv.shape[1]
+    idx = jnp.arange(rung, dtype=jnp.int32)
+    mask = idx[None, :] <= positions[:, None]
+    scale = 1.0 / math.sqrt(kv.shape[2])
+    scores = jnp.einsum("srd,sd->sr", kv2, h) * scale
+    scores = jnp.where(mask, scores, -1e30)
+    att = jax.nn.softmax(scores, axis=-1)
+    ctx = jnp.einsum("sr,srd->sd", att, kv2)
+    logits = (ctx + h) @ params["unembed"]
+    # Counter-based hash noise keyed on (seed, position, vocab index):
+    # deterministic for a given history, different across seeds.
+    vocab = logits.shape[1]
+    vidx = jnp.arange(vocab, dtype=jnp.uint32)
+    x = (
+        seeds[:, None] * jnp.uint32(2654435761)
+        + positions[:, None].astype(jnp.uint32) * jnp.uint32(40503)
+        + vidx[None, :] * jnp.uint32(2246822519)
+    )
+    x = x ^ (x >> jnp.uint32(13))
+    x = x * jnp.uint32(2654435761)
+    x = x ^ (x >> jnp.uint32(16))
+    noise = (x >> jnp.uint32(8)).astype(jnp.float32) / jnp.float32(2**24)
+    nxt = jnp.argmax(logits + 0.5 * noise, axis=-1).astype(jnp.int32)
+    return kv2, nxt, logits
+
+
+# ---------------------------------------------------------------------------
+# Decode engine: slots, KV ladder, continuous batching
+# ---------------------------------------------------------------------------
+
+class _SeqSpec(NamedTuple):
+    """Everything a worker needs to (re-)run a sequence."""
+
+    sid: int
+    prompt: Tuple[int, ...]
+    resume: Tuple[int, ...]  # already-delivered tokens (replay region)
+    seed: int
+    max_new: int
+    epoch: int
+    lane: str
+
+
+class _Slot:
+    __slots__ = (
+        "spec", "stream", "pos", "limit", "replay_until",
+        "emitted", "clamped",
+    )
+
+    def __init__(self, spec: _SeqSpec, maxctx: int):
+        self.spec = spec
+        # The feed stream: prompt, then the replay region (tokens the
+        # caller already has), then tokens generated live this lease.
+        self.stream: List[int] = list(spec.prompt) + list(spec.resume)
+        self.pos = 0
+        room = maxctx - len(spec.prompt)
+        self.limit = min(spec.max_new, room)
+        self.clamped = self.limit < spec.max_new
+        self.replay_until = len(spec.resume)
+        self.emitted = 0  # tokens produced this lease (incl. replay)
+
+
+class DecodeEngine:
+    """Continuous-batching decode over a fixed slot count.
+
+    Frontend-agnostic: local worker threads and remote worker
+    processes both run one engine each.  The engine owns the KV array
+    (one dense (slots, rung, kv_dim) buffer riding the KV ladder) and
+    the per-slot feed streams; the caller owns admission, emission
+    latching and journaling.
+    """
+
+    def __init__(self, step_fn=None, params=None, kv_dim: Optional[int] = None,
+                 slots: Optional[int] = None, ladder: Optional[KVLadder] = None,
+                 env=None, capture_logits: bool = False, tag: str = "engine"):
+        import jax
+
+        if step_fn is None:
+            step_fn = _toy_step
+            if params is None:
+                params = make_toy_params()
+            if kv_dim is None:
+                kv_dim = int(params["embed"].shape[1])
+        if params is None or kv_dim is None:
+            raise ValueError("custom step_fn requires params and kv_dim")
+        if slots is None:
+            slots = int(_config.env_value(
+                "HOROVOD_SERVING_DECODE_SLOTS", env=env))
+        if ladder is None:
+            ladder = build_kv_ladder(env=env)
+        self.tag = tag
+        self.slots = slots
+        self.ladder = ladder
+        self.kv_dim = kv_dim
+        self.params = params
+        self.capture_logits = capture_logits
+        self._jit = jax.jit(step_fn)
+        self.compiles = 0
+        self._compiled: Dict[int, object] = {}
+        self._rung = ladder.rungs[0]
+        self._kv = None  # lazily allocated at first admit/warmup
+        self._slots: List[Optional[_Slot]] = [None] * slots
+        self._active = 0
+
+    # -- compile management -------------------------------------------------
+
+    def _ensure_kv(self):
+        import jax.numpy as jnp
+
+        if self._kv is None:
+            self._kv = jnp.zeros(
+                (self.slots, self._rung, self.kv_dim), dtype=jnp.float32)
+
+    def warmup(self):
+        """AOT-compile every ladder rung; pins compile count flat."""
+        import jax.numpy as jnp
+
+        for rung in self.ladder.rungs:
+            if rung in self._compiled:
+                continue
+            kv = jnp.zeros(
+                (self.slots, rung, self.kv_dim), dtype=jnp.float32)
+            toks = jnp.zeros((self.slots,), dtype=jnp.int32)
+            pos = jnp.zeros((self.slots,), dtype=jnp.int32)
+            seeds = jnp.zeros((self.slots,), dtype=jnp.uint32)
+            fn, _flops = aot_compile(
+                self._jit, self.params, kv, toks, pos, seeds)
+            self._compiled[rung] = fn
+            self.compiles += 1
+            _m_compiles.inc()
+        self._ensure_kv()
+
+    def _exec(self, kv, toks, pos, seeds):
+        rung = kv.shape[1]
+        fn = self._compiled.get(rung)
+        if fn is None:
+            self.compiles += 1
+            _m_compiles.inc()
+            fn = self._jit
+            # Cache the jitted callable per rung so a missing warmup
+            # costs one trace per rung, never one per step.
+            self._compiled[rung] = fn
+        return fn(self.params, kv, toks, pos, seeds)
+
+    # -- slot management ----------------------------------------------------
+
+    @property
+    def active(self) -> int:
+        return self._active
+
+    def free_slots(self) -> int:
+        return self.slots - self._active
+
+    def active_by_lane(self) -> Dict[str, int]:
+        out = {"interactive": 0, "batch": 0}
+        for s in self._slots:
+            if s is not None:
+                out[s.spec.lane] = out.get(s.spec.lane, 0) + 1
+        return out
+
+    def admit(self, spec: _SeqSpec) -> bool:
+        """Place a sequence into a free slot.  Returns False if full."""
+        import jax.numpy as jnp
+
+        maxctx = self.ladder.rungs[-1]
+        if len(spec.prompt) >= maxctx:
+            raise ValueError(
+                "prompt length %d >= KV max context %d"
+                % (len(spec.prompt), maxctx))
+        for i, s in enumerate(self._slots):
+            if s is None:
+                self._ensure_kv()
+                self._kv = self._kv.at[i].set(0.0)
+                self._slots[i] = _Slot(spec, maxctx)
+                self._active += 1
+                return True
+        return False
+
+    def drop(self, sid: int) -> bool:
+        for i, s in enumerate(self._slots):
+            if s is not None and s.spec.sid == sid:
+                self._slots[i] = None
+                self._active -= 1
+                return True
+        return False
+
+    def least_emitted_batch(self) -> Optional[_SeqSpec]:
+        """The batch-lane slot with the least progress (shed victim)."""
+        best = None
+        for s in self._slots:
+            if s is None or s.spec.lane != "batch":
+                continue
+            if best is None or s.emitted < best.emitted:
+                best = s
+        return best.spec if best is not None else None
+
+    def sequence_ids(self) -> List[int]:
+        return [s.spec.sid for s in self._slots if s is not None]
+
+    # -- the decode step ----------------------------------------------------
+
+    def step(self):
+        """One running-batch iteration.
+
+        Returns (emits, finishes):
+          emits    — list of (spec, gidx, token, logits_row_or_None)
+          finishes — list of (spec, outcome)
+        Replay-region outputs produce no emits (exactly-once resume).
+        """
+        import jax.numpy as jnp
+
+        if self._active == 0:
+            return [], []
+        self._ensure_kv()
+
+        # Grow the KV rung if any slot is about to write past it.
+        needed = 0
+        for s in self._slots:
+            if s is not None:
+                needed = max(needed, s.pos + 1)
+        while self._rung < needed:
+            action = _faults.fire(
+                "kv.page", exc=_WorkerDied, tag=self.tag)
+            if action == "hang":  # pragma: no cover - not legal for seam
+                pass
+            nxt = self.ladder.rung_for(self._rung + 1)
+            old = np.asarray(self._kv)
+            grown = np.zeros(
+                (self.slots, nxt, self.kv_dim), dtype=np.float32)
+            grown[:, : self._rung, :] = old
+            self._kv = jnp.asarray(grown)
+            self._rung = nxt
+            _m_rung_moves.inc()
+
+        toks = np.zeros((self.slots,), dtype=np.int32)
+        pos = np.zeros((self.slots,), dtype=np.int32)
+        seeds = np.zeros((self.slots,), dtype=np.uint32)
+        live = []
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            live.append(i)
+            toks[i] = s.stream[s.pos]
+            pos[i] = s.pos
+            seeds[i] = s.spec.seed & 0xFFFFFFFF
+
+        t0 = time.perf_counter()
+        kv2, nxt, logits = self._exec(
+            self._kv, jnp.asarray(toks), jnp.asarray(pos),
+            jnp.asarray(seeds))
+        nxt_host = np.asarray(nxt)
+        logits_host = np.asarray(logits) if self.capture_logits else None
+        self._kv = kv2
+        _m_step_s.observe(time.perf_counter() - t0)
+        _m_steps.inc()
+
+        emits = []
+        finishes = []
+        for i in live:
+            s = self._slots[i]
+            plen = len(s.spec.prompt)
+            fed = s.pos
+            s.pos += 1
+            if fed < plen - 1:
+                continue  # still prefilling the prompt
+            gidx = fed - (plen - 1)
+            if gidx < s.replay_until:
+                # Replay region: the caller already has this token.
+                # Advance the feed using the known token; emit nothing.
+                token = s.stream[plen + gidx] if plen + gidx < len(
+                    s.stream) else int(nxt_host[i])
+                s.emitted = max(s.emitted, gidx + 1)
+                continue
+            token = int(nxt_host[i])
+            s.stream.append(token)
+            s.emitted = gidx + 1
+            row = logits_host[i].copy() if logits_host is not None else None
+            emits.append((s.spec, gidx, token, row))
+            if gidx + 1 >= s.limit:
+                outcome = "truncated" if s.clamped else "ok"
+                finishes.append((s.spec, outcome))
+                self._slots[i] = None
+                self._active -= 1
+        if self._active == 0 and self._rung != self.ladder.rungs[0]:
+            # Idle: fall back to the base rung so the next burst
+            # starts cheap (no recompile — the rung is AOT-warm).
+            self._rung = self.ladder.rungs[0]
+            self._kv = None
+        return emits, finishes
+
+
+# ---------------------------------------------------------------------------
+# Sequence future: the caller handle + exactly-once token latch
+# ---------------------------------------------------------------------------
+
+class SequenceFuture:
+    """Caller handle for one decode sequence.
+
+    The token latch is per-(index, epoch): an emission is accepted
+    only when the sequence is live, the emitting lease's epoch matches
+    the current epoch, and the index is exactly the next token.  Every
+    re-admission or shed bumps the epoch, so a revenant worker's
+    emissions are rejected (and counted) rather than duplicated.
+    """
+
+    def __init__(self, sid: int, prompt, max_new: int, seed: int,
+                 slo_ms: Optional[float], interactive_ms: float):
+        self.id = sid
+        self.prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        self.max_new = int(max_new)
+        self.seed = int(seed)
+        self.slo_ms = slo_ms
+        if slo_ms is None:
+            self.lane = "batch"
+            self.slo_class = "default"
+            self.deadline = None
+        else:
+            self.lane = (
+                "interactive" if slo_ms <= interactive_ms else "batch")
+            self.slo_class = "%gms" % slo_ms
+            self.deadline = None  # stamped at submit
+        self.tokens: List[int] = []
+        self.epoch = 0
+        self.watermark = -1  # last journaled durable token index
+        self.resumes = 0
+        self.sheds = 0
+        self.eligible_at = 0.0
+        self.resume_cause = ""
+        self.outcome: Optional[str] = None
+        self.error: Optional[BaseException] = None
+        self.t_submit_ns = 0
+        self.t_admit_ns = 0
+        self.t_first_ns = 0
+        self.t_done_ns = 0
+        self._lock = threading.Lock()
+        self._event = threading.Event()
+
+    # -- latch ---------------------------------------------------------------
+
+    def emit(self, idx: int, token: int, epoch: int) -> bool:
+        """Accept token ``idx`` from lease ``epoch``.  Exactly-once."""
+        with self._lock:
+            if self.outcome is not None:
+                return False
+            if epoch != self.epoch:
+                return False
+            if idx != len(self.tokens):
+                return False
+            self.tokens.append(int(token))
+            if self.t_first_ns == 0:
+                self.t_first_ns = time.monotonic_ns()
+            return True
+
+    def finish(self, outcome: str, epoch: int,
+               error: Optional[BaseException] = None) -> bool:
+        with self._lock:
+            if self.outcome is not None:
+                return False
+            if epoch >= 0 and epoch != self.epoch:
+                return False
+            self.outcome = outcome
+            self.error = error
+            self.t_done_ns = time.monotonic_ns()
+            self._event.set()
+            return True
+
+    def advance_epoch(self) -> Tuple[int, int]:
+        """Bump the epoch; returns (new_epoch, delivered_frontier)."""
+        with self._lock:
+            self.epoch += 1
+            return self.epoch, len(self.tokens)
+
+    def delivered(self) -> int:
+        with self._lock:
+            return len(self.tokens)
+
+    # -- caller side -----------------------------------------------------------
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                "sequence %d not done within %.1fs" % (self.id, timeout))
+        if self.error is not None:
+            raise self.error
+        return np.asarray(self.tokens, dtype=np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Per-worker admission queue (the sharded admission plane)
+# ---------------------------------------------------------------------------
+
+class _AdmissionQueue:
+    """One worker's admission queue: an interactive and a batch deque."""
+
+    def __init__(self):
+        self.cond = threading.Condition(threading.Lock())
+        self.interactive: deque = deque()
+        self.batch: deque = deque()
+
+    def put(self, seq: SequenceFuture):
+        with self.cond:
+            (self.interactive if seq.lane == "interactive"
+             else self.batch).append(seq)
+            self.cond.notify_all()
+
+    def take(self, lane: str, now: float) -> Optional[SequenceFuture]:
+        dq = self.interactive if lane == "interactive" else self.batch
+        with self.cond:
+            for i, seq in enumerate(dq):
+                if seq.eligible_at <= now:
+                    del dq[i]
+                    return seq
+        return None
+
+    def depth(self) -> int:
+        return len(self.interactive) + len(self.batch)
+
+    def depth_lane(self, lane: str) -> int:
+        return len(self.interactive if lane == "interactive"
+                   else self.batch)
+
+    def drain(self) -> List[SequenceFuture]:
+        with self.cond:
+            out = list(self.interactive) + list(self.batch)
+            self.interactive.clear()
+            self.batch.clear()
+            return out
+
+
+# ---------------------------------------------------------------------------
+# Local worker thread
+# ---------------------------------------------------------------------------
+
+class _DecodeWorker(threading.Thread):
+    def __init__(self, fe: "DecodeFrontend", wid: str, engine: DecodeEngine):
+        super().__init__(name="decode-%s" % wid, daemon=True)
+        self.fe = fe
+        self.wid = wid
+        self.engine = engine
+
+    def run(self):
+        fe = self.fe
+        eng = self.engine
+        try:
+            eng.warmup()
+        except Exception:
+            hlog.error("decoding: worker %s warmup failed", self.wid, exc_info=True)
+            fe._worker_failed(self.wid, "warmup_error")
+            return
+        while True:
+            if fe._retired(self.wid):
+                return
+            # Fault seam: one fire per running-batch step.  An error
+            # kills this worker (its leases resume on survivors); a
+            # hang parks past the lease timeout, after which the
+            # watchdog revokes the lease — our later emissions are
+            # epoch-rejected (revenant path).
+            try:
+                action = _faults.fire(
+                    "decode.step", exc=_WorkerDied, tag=self.wid)
+            except _WorkerDied:
+                fe._worker_failed(self.wid, "fault_error")
+                return
+            if action == "hang":
+                time.sleep(fe.lease_timeout_s * 4.0)
+                if fe._retired(self.wid):
+                    return
+            try:
+                emits, finishes = eng.step()
+            except Exception:
+                hlog.error("decoding: worker %s step failed", self.wid, exc_info=True)
+                fe._worker_failed(self.wid, "step_error")
+                return
+            revoked = fe._emit_batch(self.wid, [
+                (spec.sid, gidx, tok, spec.epoch)
+                for (spec, gidx, tok, _row) in emits
+            ], [
+                (spec.sid, outcome, spec.epoch)
+                for (spec, outcome) in finishes
+            ])
+            for sid in revoked:
+                eng.drop(sid)
+            if fe._retired(self.wid):
+                return
+            shed_sid = fe._maybe_shed(self.wid, eng)
+            if shed_sid is not None:
+                eng.drop(shed_sid)
+            lanes = eng.active_by_lane()
+            for spec in fe._admit_for(
+                    self.wid, eng.free_slots(),
+                    lanes.get("interactive", 0), lanes.get("batch", 0),
+                    eng.slots):
+                eng.admit(spec)
+            _m_occupancy.labels(worker=self.wid).set(eng.active)
+            if eng.active == 0:
+                q = fe._queues.get(self.wid)
+                if q is not None:
+                    with q.cond:
+                        if q.depth() == 0:
+                            q.cond.wait(0.02)
+
+
+# ---------------------------------------------------------------------------
+# Decode frontend: sharded admission, lanes, recovery, lease/emit wire
+# ---------------------------------------------------------------------------
+
+class DecodeFrontend:
+    """Continuous-batching decode frontend with per-sequence recovery.
+
+    There is no central batcher thread: ``submit`` routes the sequence
+    to the least-loaded worker's admission queue, workers admit from
+    their own queue between decode steps, and an idle worker steals
+    from the longest queue.  All per-sequence state transitions
+    (admit, watermark, shed, resume, done) are journaled.
+    """
+
+    def __init__(self, workers: int = 1, step_fn=None, params=None,
+                 kv_dim: Optional[int] = None, env=None,
+                 capture_logits: bool = False,
+                 trace_tag: Optional[str] = None):
+        self._env = env
+        cfg = _config.Config(env=env)
+        self.slots = cfg.serving_decode_slots
+        self.default_max_new = cfg.serving_decode_max_new_tokens
+        self.watermark_stride = cfg.serving_decode_watermark_stride
+        self.interactive_ms = cfg.serving_decode_interactive_slo_ms
+        self.lane_budget = cfg.serving_decode_lane_budget
+        self.retry_limit = cfg.serving_decode_retry_limit
+        self.retry_backoff_ms = cfg.serving_decode_retry_backoff_ms
+        self.lease_timeout_s = cfg.serving_decode_lease_timeout_s
+        self.ladder = build_kv_ladder(env=env)
+        self._step_fn = step_fn
+        self._params = params
+        self._kv_dim = kv_dim
+        self._capture = capture_logits
+
+        self._lock = threading.RLock()
+        self._seqs: Dict[int, SequenceFuture] = {}
+        self._next_sid = 0
+        self._queues: Dict[str, _AdmissionQueue] = {}
+        self._leases: Dict[str, Dict[int, int]] = {}  # wid -> sid -> epoch
+        self._progress: Dict[str, float] = {}
+        self._retired_set: set = set()
+        self._threads: Dict[str, _DecodeWorker] = {}
+        self._orphans: List[SequenceFuture] = []
+        self._closed = False
+        self.counters = {
+            "submitted": 0, "completed": 0, "failed": 0, "truncated": 0,
+            "tokens": 0, "resumed": 0, "shed": 0, "dupes": 0, "steals": 0,
+        }
+        self._goodput: Dict[str, Dict[str, int]] = {}
+        self._service = None
+
+        role = "serving-%s" % (trace_tag or "decode")
+        _journal.configure(role, env=env)
+        _journal.record(
+            "decode_meta",
+            slots=self.slots,
+            watermark_stride=self.watermark_stride,
+            interactive_slo_ms=self.interactive_ms,
+            lane_budget=self.lane_budget,
+            retry_limit=self.retry_limit,
+            kv_ladder=self.ladder.digest,
+            workers=workers,
+        )
+        _live_decode_frontends.add(self)
+        for i in range(workers):
+            self.add_worker("w%d" % i)
+
+    # -- pool management ------------------------------------------------------
+
+    def add_worker(self, wid: str):
+        eng = DecodeEngine(
+            step_fn=self._step_fn, params=self._params,
+            kv_dim=self._kv_dim, slots=self.slots, ladder=self.ladder,
+            env=self._env, capture_logits=self._capture, tag=wid)
+        with self._lock:
+            if wid in self._queues:
+                raise ValueError("duplicate decode worker %r" % wid)
+            self._queues[wid] = _AdmissionQueue()
+            self._leases[wid] = {}
+            self._progress[wid] = time.monotonic()
+            orphans, self._orphans = self._orphans, []
+            t = _DecodeWorker(self, wid, eng)
+            self._threads[wid] = t
+            n = len(self._queues)
+        for seq in orphans:
+            self._route(seq)
+        _m_workers.set(n)
+        t.start()
+
+    def register_remote(self, wid: str):
+        """Register a remote worker (leases via the wire protocol)."""
+        with self._lock:
+            if wid in self._queues:
+                return
+            self._queues[wid] = _AdmissionQueue()
+            self._leases[wid] = {}
+            self._progress[wid] = time.monotonic()
+            orphans, self._orphans = self._orphans, []
+            n = len(self._queues)
+        for seq in orphans:
+            self._route(seq)
+        _m_workers.set(n)
+
+    def _retired(self, wid: str) -> bool:
+        with self._lock:
+            return self._closed or wid in self._retired_set
+
+    def workers(self) -> List[str]:
+        with self._lock:
+            return sorted(self._queues)
+
+    # -- submit / routing ------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               slo_ms: Optional[float] = None, seed: int = 0
+               ) -> SequenceFuture:
+        prompt = np.asarray(prompt, dtype=np.int32).reshape(-1)
+        maxctx = self.ladder.rungs[-1]
+        if len(prompt) >= maxctx:
+            raise ValueError(
+                "prompt length %d >= HOROVOD_KV_MAX_CONTEXT %d"
+                % (len(prompt), maxctx))
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        max_new = max_new_tokens or self.default_max_new
+        with self._lock:
+            if self._closed:
+                raise DecodeError("decode frontend is closed")
+            sid = self._next_sid
+            self._next_sid += 1
+            seq = SequenceFuture(
+                sid, prompt, max_new, seed, slo_ms, self.interactive_ms)
+            seq.t_submit_ns = time.monotonic_ns()
+            if seq.slo_ms is not None:
+                seq.deadline = time.monotonic() + seq.slo_ms / 1e3
+            self._seqs[sid] = seq
+            self.counters["submitted"] += 1
+        self._route(seq)
+        return seq
+
+    def _route(self, seq: SequenceFuture):
+        """Enqueue on the least-loaded worker's admission queue."""
+        with self._lock:
+            if not self._queues:
+                self._orphans.append(seq)
+                return
+            wid = min(
+                self._queues,
+                key=lambda w: (self._queues[w].depth()
+                               + len(self._leases.get(w, {}))))
+            q = self._queues[wid]
+        q.put(seq)
+        _m_queue.labels(lane=seq.lane).set(self._queue_depth(seq.lane))
+
+    def _queue_depth(self, lane: str) -> int:
+        with self._lock:
+            qs = list(self._queues.values())
+        return sum(q.depth_lane(lane) for q in qs)
+
+    def _steal_ready(self, wid: str) -> bool:
+        with self._lock:
+            others = [q for w, q in self._queues.items() if w != wid]
+        return any(q.depth() > 0 for q in others)
+
+    # -- admission fence (per worker, lane budget, work stealing) -------------
+
+    def _admit_for(self, wid: str, free: int, active_i: int,
+                   active_b: int, slots: int) -> List[_SeqSpec]:
+        """Admission fence for one worker between decode steps.
+
+        Interactive sequences admit first (own queue, then stolen from
+        the longest other queue).  Batch sequences admit only while
+        the interactive reservation (``ceil(lane_budget * slots)``
+        slots whenever interactive work is waiting) is respected.
+        """
+        if free <= 0:
+            return []
+        now = time.monotonic()
+        specs: List[_SeqSpec] = []
+        with self._lock:
+            if self._closed or wid in self._retired_set:
+                return []
+            own = self._queues.get(wid)
+            if own is None:
+                return []
+            others = [(w, q) for w, q in self._queues.items() if w != wid]
+        interactive_waiting = (
+            own.depth_lane("interactive")
+            + sum(q.depth_lane("interactive") for _w, q in others))
+        reserved = (math.ceil(self.lane_budget * slots)
+                    if interactive_waiting else 0)
+        taken_i = 0
+        taken_b = 0
+        while free > 0:
+            seq = own.take("interactive", now)
+            stolen = False
+            if seq is None and others:
+                donors = sorted(
+                    others, key=lambda wq: -wq[1].depth_lane("interactive"))
+                for _w, q in donors:
+                    seq = q.take("interactive", now)
+                    if seq is not None:
+                        stolen = True
+                        break
+            if seq is None:
+                break
+            specs.append(self._lease(wid, seq, now, stolen))
+            free -= 1
+            taken_i += 1
+        while free > 0:
+            if interactive_waiting:
+                # Respect the interactive reservation while any
+                # interactive work is queued anywhere.
+                if active_b + taken_b + 1 > slots - reserved:
+                    break
+            seq = own.take("batch", now)
+            stolen = False
+            if seq is None and others:
+                donors = sorted(
+                    others, key=lambda wq: -wq[1].depth_lane("batch"))
+                for _w, q in donors:
+                    seq = q.take("batch", now)
+                    if seq is not None:
+                        stolen = True
+                        break
+            if seq is None:
+                break
+            specs.append(self._lease(wid, seq, now, stolen))
+            free -= 1
+            taken_b += 1
+        return specs
+
+    def _lease(self, wid: str, seq: SequenceFuture, now: float,
+               stolen: bool) -> _SeqSpec:
+        with self._lock:
+            self._leases.setdefault(wid, {})[seq.id] = seq.epoch
+            self._progress[wid] = now
+            if stolen:
+                self.counters["steals"] += 1
+        if stolen:
+            _m_steals.inc()
+        resume = tuple(seq.tokens)
+        first = seq.t_admit_ns == 0
+        if first:
+            seq.t_admit_ns = time.monotonic_ns()
+            _journal.record(
+                "seq_admitted",
+                sid=seq.id, worker=wid, lane=seq.lane,
+                slo=seq.slo_class, prompt_len=int(len(seq.prompt)),
+                max_new=seq.max_new,
+                queue_wait_ms=(seq.t_admit_ns - seq.t_submit_ns) / 1e6,
+            )
+        elif seq.resume_cause:
+            _journal.record(
+                "seq_resumed",
+                sid=seq.id, worker=wid, lane=seq.lane,
+                from_token=len(resume), watermark=seq.watermark,
+                cause=seq.resume_cause, attempt=seq.resumes,
+            )
+            seq.resume_cause = ""
+        return _SeqSpec(
+            sid=seq.id, prompt=tuple(int(t) for t in seq.prompt),
+            resume=resume, seed=seq.seed, max_new=seq.max_new,
+            epoch=seq.epoch, lane=seq.lane)
+
+    # -- shedding --------------------------------------------------------------
+
+    def _maybe_shed(self, wid: str, eng: DecodeEngine) -> Optional[int]:
+        """Park the least-progressed batch sequence when interactive
+        work is starved: no free slot anywhere for a waiting
+        interactive sequence, and this worker's batch occupancy
+        exceeds the non-reserved share."""
+        if eng.free_slots() > 0:
+            return None
+        if self._queue_depth("interactive") == 0:
+            return None
+        lanes = eng.active_by_lane()
+        reserved = math.ceil(self.lane_budget * eng.slots)
+        if lanes.get("batch", 0) <= eng.slots - reserved:
+            return None
+        victim = eng.least_emitted_batch()
+        if victim is None:
+            return None
+        self._park(victim.sid, wid)
+        return victim.sid
+
+    def _park(self, sid: int, wid: str):
+        with self._lock:
+            seq = self._seqs.get(sid)
+            if seq is None:
+                return
+            self._leases.get(wid, {}).pop(sid, None)
+            self.counters["shed"] += 1
+        epoch, frontier = seq.advance_epoch()
+        seq.sheds += 1
+        seq.eligible_at = 0.0
+        _m_shed.labels(lane=seq.lane).inc()
+        _journal.record(
+            "seq_shed", sid=sid, worker=wid, lane=seq.lane,
+            at_token=frontier, sheds=seq.sheds)
+        self._route(seq)
+
+    # -- emission: the exactly-once token path ---------------------------------
+
+    def _emit_batch(self, wid: str,
+                    emits: List[Tuple[int, int, int, int]],
+                    finishes: List[Tuple[int, str, int]]) -> List[int]:
+        """Latch a worker's step output.  Returns revoked sids.
+
+        ``emits`` rows are (sid, gidx, token, epoch); ``finishes``
+        rows are (sid, outcome, epoch).  A sid is revoked when it is
+        unknown or its lease epoch is stale — the worker must drop the
+        slot (shed, re-admitted elsewhere, or already finished).
+        """
+        now = time.monotonic()
+        with self._lock:
+            self._progress[wid] = now
+            seqmap = {
+                sid: self._seqs.get(sid)
+                for sid in {e[0] for e in emits} | {f[0] for f in finishes}
+            }
+        revoked: List[int] = []
+        watermarks: List[Tuple[SequenceFuture, int]] = []
+        accepted_tokens: Dict[str, int] = {}
+        dupes = 0
+        for sid, gidx, token, epoch in emits:
+            seq = seqmap.get(sid)
+            if seq is None:
+                revoked.append(sid)
+                continue
+            if seq.emit(gidx, token, epoch):
+                accepted_tokens[seq.lane] = (
+                    accepted_tokens.get(seq.lane, 0) + 1)
+                if gidx == 0:
+                    _m_ttft.labels(lane=seq.lane).observe(
+                        (seq.t_first_ns - seq.t_submit_ns) / 1e9)
+                if (gidx + 1) % self.watermark_stride == 0:
+                    watermarks.append((seq, gidx))
+            else:
+                dupes += 1
+                if epoch != seq.epoch or seq.outcome is not None:
+                    revoked.append(sid)
+        for lane, n in accepted_tokens.items():
+            _m_tokens.labels(lane=lane).inc(n)
+        if dupes:
+            _m_dupes.inc(dupes)
+        with self._lock:
+            total = sum(accepted_tokens.values())
+            self.counters["tokens"] += total
+            self.counters["dupes"] += dupes
+        for seq, gidx in watermarks:
+            seq.watermark = gidx
+            _journal.record(
+                "seq_watermark", sid=seq.id, worker=wid,
+                token=gidx, lane=seq.lane)
+        for sid, outcome, epoch in finishes:
+            seq = seqmap.get(sid)
+            if seq is None:
+                revoked.append(sid)
+                continue
+            if not self._finish_seq(seq, outcome, epoch, wid):
+                revoked.append(sid)
+        return sorted(set(revoked))
+
+    def _finish_seq(self, seq: SequenceFuture, outcome: str, epoch: int,
+                    wid: str, error: Optional[BaseException] = None) -> bool:
+        if not seq.finish(outcome, epoch, error=error):
+            return False
+        with self._lock:
+            self._seqs.pop(seq.id, None)
+            for leases in self._leases.values():
+                leases.pop(seq.id, None)
+            if outcome == "ok":
+                self.counters["completed"] += 1
+            elif outcome == "truncated":
+                self.counters["truncated"] += 1
+            else:
+                self.counters["failed"] += 1
+            good = self._goodput.setdefault(
+                seq.slo_class, {"ok": 0, "miss": 0, "tokens": 0})
+            hit = True
+            if seq.deadline is not None:
+                hit = (seq.t_first_ns != 0 and
+                       (seq.t_first_ns - seq.t_submit_ns) / 1e9
+                       <= seq.slo_ms / 1e3)
+            if outcome in ("ok", "truncated") and hit:
+                good["ok"] += 1
+                good["tokens"] += len(seq.tokens)
+            else:
+                good["miss"] += 1
+        _m_seqs.labels(outcome=outcome).inc()
+        if outcome in ("ok", "truncated") and hit:
+            _m_goodput.labels(slo=seq.slo_class).inc(len(seq.tokens))
+        elif seq.deadline is not None and not hit:
+            _m_slo_miss.labels(
+                slo=seq.slo_class,
+                reason="ttft" if outcome in ("ok", "truncated")
+                else outcome).inc()
+        _journal.record(
+            "seq_done",
+            sid=seq.id, outcome=outcome, lane=seq.lane,
+            slo=seq.slo_class, tokens=len(seq.tokens),
+            prompt_len=int(len(seq.prompt)), worker=wid,
+            resumes=seq.resumes, sheds=seq.sheds,
+            deadline_hit=bool(hit),
+            submit_ns=seq.t_submit_ns, admit_ns=seq.t_admit_ns,
+            first_ns=seq.t_first_ns, done_ns=seq.t_done_ns,
+        )
+        return True
+
+    # -- failure handling: watermark resume -------------------------------------
+
+    def _worker_failed(self, wid: str, cause: str):
+        """Revoke a dead worker: re-admit its leases from the
+        watermark on survivors, redistribute its queue."""
+        with self._lock:
+            if wid in self._retired_set:
+                return
+            self._retired_set.add(wid)
+            leases = self._leases.pop(wid, {})
+            q = self._queues.pop(wid, None)
+            self._progress.pop(wid, None)
+            self._threads.pop(wid, None)
+            n = len(self._queues)
+        _m_workers.set(n)
+        hlog.warning(
+            "decode worker %s failed (%s): %d leased, %d queued",
+            wid, cause, len(leases), q.depth() if q else 0)
+        queued = q.drain() if q is not None else []
+        for sid in sorted(leases):
+            with self._lock:
+                seq = self._seqs.get(sid)
+            if seq is None:
+                continue
+            epoch, frontier = seq.advance_epoch()
+            seq.resumes += 1
+            if seq.resumes > self.retry_limit:
+                _journal.record(
+                    "seq_failed", sid=sid, worker=wid, cause=cause,
+                    resumes=seq.resumes, at_token=frontier)
+                self._finish_seq(
+                    seq, "failed", -1, wid,
+                    error=DecodeError(
+                        "sequence %d exceeded retry limit %d (%s)"
+                        % (sid, self.retry_limit, cause)))
+                continue
+            backoff = (self.retry_backoff_ms / 1e3
+                       * (2 ** (seq.resumes - 1)))
+            seq.eligible_at = time.monotonic() + backoff
+            seq.resume_cause = cause
+            with self._lock:
+                self.counters["resumed"] += 1
+            _m_resumed.labels(cause=cause).inc()
+            self._route(seq)
+        for seq in queued:
+            self._route(seq)
+
+    def _watchdog_loop(self):
+        while True:
+            time.sleep(min(self.lease_timeout_s / 4.0, 1.0))
+            with self._lock:
+                if self._closed:
+                    return
+                now = time.monotonic()
+                stale = [
+                    wid for wid, leases in self._leases.items()
+                    if leases and
+                    self._progress.get(wid, now) +
+                    self.lease_timeout_s < now
+                ]
+            for wid in stale:
+                self._worker_failed(wid, "timeout")
+
+    def start_watchdog(self):
+        t = threading.Thread(
+            target=self._watchdog_loop, name="decode-watchdog",
+            daemon=True)
+        t.start()
+        return t
+
+    # -- stats / shutdown --------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self.counters)
+            out["inflight"] = len(self._seqs)
+            out["workers"] = sorted(self._queues)
+            out["goodput"] = {
+                k: dict(v) for k, v in self._goodput.items()}
+        out["ladder"] = self.ladder.digest
+        out["compiles"] = {
+            wid: t.engine.compiles
+            for wid, t in list(self._threads.items())}
+        out["queue_depth"] = {
+            "interactive": self._queue_depth("interactive"),
+            "batch": self._queue_depth("batch"),
+        }
+        return out
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._seqs:
+                    return True
+            time.sleep(0.01)
+        return False
+
+    def close(self):
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            stragglers = list(self._seqs.values())
+            self._seqs.clear()
+            threads = list(self._threads.values())
+            self._threads.clear()
+        for seq in stragglers:
+            seq.finish(
+                "failed", -1,
+                error=DecodeError(
+                    "decode frontend closed with sequence %d in flight"
+                    % seq.id))
+            _m_seqs.labels(outcome="failed").inc()
+        if self._service is not None:
+            try:
+                self._service.close()
+            except Exception:
+                pass
+        for t in threads:
+            t.join(timeout=2.0)
+
+    # -- remote lease/emit protocol ------------------------------------------------
+
+    def decode_endpoint(self, port: int = 0,
+                        secret: Optional[str] = None) -> Tuple[int, str]:
+        """Expose the lease/emit wire; returns (port, secret)."""
+        from .runner import secret as _secret_mod
+        from .runner.service import BasicService
+
+        sec = (secret if secret is not None
+               else (_secret_mod.from_env() or _secret_mod.make_secret()))
+        svc = BasicService("decoding", sec, port=port)
+        svc.handle("lease", self._h_lease)
+        svc.handle("emit", self._h_emit)
+        self._service = svc
+        return svc.port, sec
+
+    def _h_lease(self, req: dict, peer) -> dict:
+        wid = str(req["worker"])
+        self.register_remote(wid)
+        with self._lock:
+            if self._closed:
+                return {"seqs": [], "stop": True}
+            self._progress[wid] = time.monotonic()
+        specs = self._admit_for(
+            wid, int(req.get("free", 0)),
+            int(req.get("active_interactive", 0)),
+            int(req.get("active_batch", 0)),
+            int(req.get("slots", self.slots)))
+        return {
+            "stop": False,
+            "seqs": [
+                {
+                    "id": s.sid, "prompt": list(s.prompt),
+                    "resume": list(s.resume), "seed": s.seed,
+                    "max_new": s.max_new, "epoch": s.epoch,
+                    "lane": s.lane,
+                }
+                for s in specs
+            ],
+        }
+
+    def _h_emit(self, req: dict, peer) -> dict:
+        wid = str(req["worker"])
+        revoked = self._emit_batch(
+            wid,
+            [(int(e[0]), int(e[1]), int(e[2]), int(e[3]))
+             for e in req.get("emits", ())],
+            [(int(f[0]), str(f[1]), int(f[2]))
+             for f in req.get("finished", ())])
+        with self._lock:
+            stop = self._closed
+        return {"ok": True, "revoke": revoked, "stop": stop}
+
+
+# ---------------------------------------------------------------------------
+# Postmortem provider
+# ---------------------------------------------------------------------------
+
+import weakref
+
+_live_decode_frontends: "weakref.WeakSet[DecodeFrontend]" = weakref.WeakSet()
+
+
+def _postmortem_decode() -> str:
+    lines: List[str] = []
+    for fe in list(_live_decode_frontends):
+        try:
+            with fe._lock:
+                queued = {
+                    wid: (q.depth_lane("interactive"),
+                          q.depth_lane("batch"))
+                    for wid, q in fe._queues.items()}
+                leases = {
+                    wid: sorted(l) for wid, l in fe._leases.items() if l}
+                inflight = len(fe._seqs)
+            lines.append(
+                "decode frontend: %d in flight, queues=%s, leases=%s"
+                % (inflight, queued, leases))
+        except Exception:
+            lines.append("decode frontend: <unavailable>")
+    return "\n".join(lines)
+
+
+_tracing.register_postmortem_provider("decoding", _postmortem_decode)
+
+
+# ---------------------------------------------------------------------------
+# Remote decode worker process
+# ---------------------------------------------------------------------------
+
+def remote_decode_loop(addr: str, port: int, step_fn=None, params=None,
+                       kv_dim: Optional[int] = None,
+                       wid: Optional[str] = None,
+                       secret: Optional[str] = None, env=None,
+                       max_seqs: int = 0):
+    """Run one remote decode worker against a frontend endpoint.
+
+    Leases sequences, runs the engine, emits token batches every
+    ``HOROVOD_SERVING_DECODE_EMIT_STRIDE`` steps, drops any sequence
+    the frontend revokes.  A ``decode.step`` crash is a real
+    ``os._exit`` mid-sequence — the process dies with its KV cache.
+    Returns the number of sequences finished when the frontend says
+    stop (and the engine is idle), or ``max_seqs`` is reached.
+    """
+    from .runner import secret as _secret_mod
+    from .runner.service import BasicClient
+
+    if wid is None:
+        wid = "remote-%d" % os.getpid()
+    if secret is None:
+        secret = _secret_mod.from_env()
+    if _journal._journal is None:
+        _journal.configure("decode-worker-%s" % wid, env=env)
+    emit_stride = int(_config.env_value(
+        "HOROVOD_SERVING_DECODE_EMIT_STRIDE", env=env))
+    eng = DecodeEngine(
+        step_fn=step_fn, params=params, kv_dim=kv_dim,
+        env=env, tag=wid)
+    eng.warmup()
+    cli = BasicClient(addr, port, secret, timeout=10.0)
+    finished_total = 0
+    pending_emits: List[Tuple[int, int, int, int]] = []
+    pending_fin: List[Tuple[int, str, int]] = []
+    steps_since_flush = 0
+    stop = False
+
+    def flush() -> bool:
+        nonlocal pending_emits, pending_fin, steps_since_flush
+        rep = cli.try_request({
+            "type": "emit", "worker": wid,
+            "emits": [list(e) for e in pending_emits],
+            "finished": [list(f) for f in pending_fin],
+        }, retries=3)
+        pending_emits = []
+        pending_fin = []
+        steps_since_flush = 0
+        if rep is None:
+            return True
+        for sid in rep.get("revoke", ()):
+            eng.drop(int(sid))
+        return bool(rep.get("stop"))
+
+    while True:
+        if eng.free_slots() > 0 and not stop:
+            lanes = eng.active_by_lane()
+            rep = cli.try_request({
+                "type": "lease", "worker": wid,
+                "free": eng.free_slots(), "slots": eng.slots,
+                "active_interactive": lanes.get("interactive", 0),
+                "active_batch": lanes.get("batch", 0),
+            }, retries=3)
+            if rep is None:
+                stop = True
+            else:
+                stop = bool(rep.get("stop"))
+                for s in rep.get("seqs", ()):
+                    eng.admit(_SeqSpec(
+                        sid=int(s["id"]),
+                        prompt=tuple(int(t) for t in s["prompt"]),
+                        resume=tuple(int(t) for t in s["resume"]),
+                        seed=int(s["seed"]), max_new=int(s["max_new"]),
+                        epoch=int(s["epoch"]), lane=str(s["lane"])))
+        if eng.active == 0:
+            if pending_emits or pending_fin:
+                stop = flush() or stop
+            if stop:
+                return finished_total
+            if max_seqs and finished_total >= max_seqs:
+                return finished_total
+            time.sleep(0.02)
+            continue
+        # Fault seam: a crash here is a real process death mid-step.
+        action = _faults.fire("decode.step", exc=_WorkerDied, tag=wid)
+        if action == "hang":
+            lease_s = float(_config.env_value(
+                "HOROVOD_SERVING_DECODE_LEASE_TIMEOUT_S", env=env))
+            time.sleep(lease_s * 4.0)
+        emits, finishes = eng.step()
+        for spec, gidx, tok, _row in emits:
+            pending_emits.append((spec.sid, gidx, tok, spec.epoch))
+        for spec, outcome in finishes:
+            pending_fin.append((spec.sid, outcome, spec.epoch))
+            finished_total += 1
+        steps_since_flush += 1
+        if (steps_since_flush >= emit_stride or finishes
+                or eng.free_slots() > 0):
+            stop = flush() or stop
+        if max_seqs and finished_total >= max_seqs and eng.active == 0:
+            if pending_emits or pending_fin:
+                flush()
+            return finished_total

@@ -132,6 +132,20 @@ POINTS: Dict[str, frozenset] = {
     # on survivors), "crash" in a remote member is a real mid-swap
     # process death.
     "weights.adopt": frozenset({"delay", "error", "crash"}),
+    # decoding.py decode-engine iteration, fired once per running-batch
+    # step with tag=<worker id> — mid-SEQUENCE death, the common
+    # autoregressive failure: "error" kills the worker between token
+    # steps (its in-flight sequences are re-admitted on survivors from
+    # their KV watermarks), "crash" in a remote decode member is a real
+    # mid-sequence process death, "hang" parks the worker holding its
+    # running batch so the lease watchdog must re-admit — the revenant
+    # path the per-sequence exactly-once token latch then absorbs.
+    "decode.step": frozenset({"delay", "error", "crash", "hang"}),
+    # decoding.py KV-cache page-rung growth (a pow2 ladder move, fired
+    # once per rung move with tag=<worker id>): "error" kills the
+    # worker mid-move — recovery must re-prefill from the watermark,
+    # never trust a half-migrated cache.
+    "kv.page": frozenset({"delay", "error", "crash"}),
 }
 
 ACTIONS = frozenset().union(*POINTS.values())

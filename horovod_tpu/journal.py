@@ -116,6 +116,16 @@ CRITICAL_EVENTS = frozenset({
     # rejected digest and the rolled-back-to digest are what the
     # post-mortem of a bad push keys on.
     "weights_published", "weights_adopted", "weights_rejected",
+    # Continuous-batching decode (round 18): the one-shot config
+    # record, a sequence's re-admission after a worker death (the
+    # watermark-resume edge MTTR attribution keys on), a batch-lane
+    # shed under pool shrinkage, and the retry-budget-exhausted
+    # terminal are all rare, incident-grade edges. The per-sequence
+    # seq_admitted / seq_done lifecycle records and the per-stride
+    # seq_watermark records stay batched — they are token-path
+    # volume, and the watermark's recovery value is already bounded
+    # by its stride.
+    "decode_meta", "seq_resumed", "seq_shed", "seq_failed",
 })
 
 

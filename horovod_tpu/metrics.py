@@ -68,6 +68,16 @@ RECOVERY_BUCKETS = (0.1, 0.5, 1.0, 2.0, 5.0, 15.0, 60.0, 300.0,
 # multi-second flagship publish.
 WEIGHT_SWAP_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
                        0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 15.0, 60.0)
+# Continuous-batching decode (decoding.py): one iteration of the
+# running batch — a single AOT-compiled token step plus host-side
+# emission — sits in the tens-of-microseconds-to-milliseconds band on
+# a toy model and stretches toward a second on a flagship; the ladder
+# needs resolution inside a single step, not across a request, which
+# is why it starts an order of magnitude below SERVING_PHASE_BUCKETS'
+# useful range.
+DECODE_STEP_BUCKETS = (1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
+                       1e-3, 2.5e-3, 5e-3, 0.01, 0.025, 0.05, 0.1,
+                       0.25, 1.0)
 
 
 def _fmt(v: float) -> str:

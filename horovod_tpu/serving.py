@@ -8,6 +8,33 @@ tier needs already exists in this repo: AOT compilation
 fault grammar (faults.py) and the lifecycle journal (journal.py).
 This module composes them — it adds no new distributed primitive.
 
+The serving tier has two planes (round 18):
+
+- **The request/response plane (this module).** One-shot inference:
+  a request is one array in, one array out; the unit of scheduling,
+  retry and exactly-once delivery is the *batch*, cut by a central
+  batcher thread against a latency budget.
+
+- **The decode plane (decoding.py).** Autoregressive decode: a
+  sequence lives across hundreds of steps, scheduling is
+  iteration-level (continuous batching — sequences join/leave the
+  running batch per decode step), the KV cache rides its own pow2
+  page ladder (`KVLadder`, the same digest-pin discipline as this
+  module's `BucketLadder`), and the unit of exactly-once delivery is
+  the *token*: a per-(sequence, epoch) latch generalizing this
+  module's per-batch completion latch, with journaled KV watermarks
+  so a dead worker's in-flight sequences resume on survivors without
+  re-emitting a delivered token.  The r16 attribution pinned the
+  scale-out regression on this module's single batcher loop
+  (batch_cut 95.1%); the decode plane therefore replaces the central
+  batcher with per-worker admission queues plus work-stealing.
+
+Shared between the planes: `BucketLadder`/`_pow2_ladder` shape
+discipline, `_pct` percentile rules, the BasicService HMAC wire, the
+faults/journal/metrics seams, and `doctor serve` — whose
+serving_report folds both planes' journals (`batch_trace` vs
+`seq_admitted`/`seq_watermark`/`seq_resumed`/`seq_done`).
+
 Architecture (driver-side `ServingFrontend` + an elastic worker pool):
 
 - **Admission / dynamic batching.** `submit()` enqueues one request;

@@ -17,7 +17,16 @@ them — into one `serving_report.json`:
 - when both a one-worker and a two-worker leg are present, an
   `attribution` block decomposing the added per-request latency of
   the 2-worker leg by phase and naming the dominant phase — the
-  measured answer to ROADMAP item 2's scale-out regression.
+  measured answer to ROADMAP item 2's scale-out regression;
+- for decode legs (decoding.py journals `seq_admitted` /
+  `seq_watermark` / `seq_resumed` / `seq_done`), a per-leg `decode`
+  block with per-sequence phase lanes (admission / first_token /
+  stream), lane tables, watermark-resume spans, shed/failed
+  accounting and goodput vs SLO class — plus a `decode_attribution`
+  block (the decode-plane rerun of the batch attribution) when a
+  1-worker and a 2-worker decode leg are both present.  These blocks
+  are strictly additive: a journal directory without per-sequence
+  events produces byte-identical output to earlier releases.
 
 Byte-deterministic by the incident-report protocol (journal.py):
 identical input bytes produce identical report bytes — sorted keys,
@@ -46,6 +55,13 @@ REPORT_SCHEMA = "serving-report-v1"
 # importable without the serving runtime's jax dependency chain.
 PHASES = ("batch_cut", "queue_wait", "pad", "compute", "unpad",
           "complete")
+
+# Per-sequence phase lanes for decode legs (decoding.py journals
+# `seq_admitted` / `seq_watermark` / `seq_resumed` / `seq_done`):
+# admission is the decode analog of batch_cut + queue_wait (submit to
+# first admission), first_token covers prefill up to the first
+# durably-emitted token, stream is the steady-state decode tail.
+DECODE_PHASES = ("admission", "first_token", "stream")
 
 
 def _pct(sorted_vals: Sequence[int], q: float) -> int:
@@ -231,6 +247,137 @@ def _timeline_sources(dir_: str) -> List[Dict[str, Any]]:
     return rows
 
 
+def _decode_phase_edges(ev: dict) -> Dict[str, int]:
+    """One sequence's phase durations (ns, clamped >= 0) from a
+    seq_done event's lifecycle stamps."""
+    sub = int(ev.get("submit_ns", 0))
+    admit = int(ev.get("admit_ns", 0)) or sub
+    first = int(ev.get("first_ns", 0))
+    done = int(ev.get("done_ns", 0)) or admit
+    raw = {
+        "admission": admit - sub,
+        "first_token": (first - admit) if first else 0,
+        "stream": (done - first) if first else 0,
+    }
+    return {p: max(0, d) for p, d in raw.items()}
+
+
+def _decode_phase_table(per_seq: List[Dict[str, int]]
+                        ) -> Dict[str, Any]:
+    total_all = 0
+    sums: Dict[str, int] = {p: 0 for p in DECODE_PHASES}
+    vals: Dict[str, List[int]] = {p: [] for p in DECODE_PHASES}
+    for phases in per_seq:
+        for p in DECODE_PHASES:
+            d = phases.get(p, 0)
+            sums[p] += d
+            vals[p].append(d)
+            total_all += d
+    out: Dict[str, Any] = {}
+    for p in DECODE_PHASES:
+        vs = sorted(vals[p])
+        if not vs:
+            out[p] = {"n": 0}
+            continue
+        out[p] = {
+            "n": len(vs),
+            "p50_ms": _ms(_pct(vs, 0.50)),
+            "p99_ms": _ms(_pct(vs, 0.99)),
+            "mean_ms": _ms(sums[p] / len(vs)),
+            "total_ms": _ms(sums[p]),
+            "share": (round(sums[p] / total_all, 4)
+                      if total_all else 0.0),
+        }
+    return out
+
+
+def _decode_leg(events: List[dict]) -> Dict[str, Any]:
+    """Per-sequence lanes for one decode leg: lane tables, phase
+    decomposition, watermark-resume spans, shed/failed accounting and
+    goodput vs SLO class (exactly-once evidence for `doctor serve`)."""
+    dones = [e for e in events if e["type"] == "seq_done"]
+    resumes = [e for e in events if e["type"] == "seq_resumed"]
+    sheds = [e for e in events if e["type"] == "seq_shed"]
+    failures = [e for e in events if e["type"] == "seq_failed"]
+    meta = next((e for e in events if e["type"] == "decode_meta"), {})
+    watermarks: Dict[str, int] = {}
+    for e in events:
+        if e["type"] == "seq_watermark":
+            sid = str(e.get("sid"))
+            watermarks[sid] = max(watermarks.get(sid, -1),
+                                  int(e.get("token", -1)))
+
+    lanes: Dict[str, Dict[str, Any]] = {}
+    per_seq: List[Dict[str, int]] = []
+    ttfts: Dict[str, List[int]] = {}
+    for ev in dones:
+        lane = str(ev.get("lane", "?"))
+        row = lanes.setdefault(lane, {
+            "sequences": 0, "tokens": 0, "resumed": 0, "shed": 0,
+            "failed": 0})
+        row["sequences"] += 1
+        row["tokens"] += int(ev.get("tokens", 0))
+        if int(ev.get("resumes", 0)) > 0:
+            row["resumed"] += 1
+        if int(ev.get("sheds", 0)) > 0:
+            row["shed"] += 1
+        if str(ev.get("outcome")) == "failed":
+            row["failed"] += 1
+        per_seq.append(_decode_phase_edges(ev))
+        first = int(ev.get("first_ns", 0))
+        if first:
+            ttfts.setdefault(lane, []).append(
+                max(0, first - int(ev.get("submit_ns", 0))))
+    for lane, vs in sorted(ttfts.items()):
+        vs.sort()
+        lanes[lane]["ttft_p50_ms"] = _ms(_pct(vs, 0.50))
+        lanes[lane]["ttft_p99_ms"] = _ms(_pct(vs, 0.99))
+
+    spans = []
+    for ev in sorted(resumes, key=lambda e: (int(e.get("sid", -1)),
+                                             int(e.get("attempt", 0)))):
+        sid = str(ev.get("sid"))
+        spans.append({
+            "sid": int(ev.get("sid", -1)),
+            "worker": str(ev.get("worker", "?")),
+            "cause": str(ev.get("cause", "?")),
+            "attempt": int(ev.get("attempt", 0)),
+            "from_token": int(ev.get("from_token", 0)),
+            "watermark": int(ev.get("watermark", -1)),
+            "journaled_watermark": watermarks.get(sid, -1),
+        })
+
+    goodput: Dict[str, Dict[str, int]] = {}
+    for ev in dones:
+        cls = goodput.setdefault(str(ev.get("slo", "?")),
+                                 {"hit": 0, "late": 0, "failed": 0})
+        if str(ev.get("outcome")) == "failed":
+            cls["failed"] += 1
+        elif ev.get("deadline_hit", True):
+            cls["hit"] += 1
+        else:
+            cls["late"] += 1
+
+    workers = sorted({str(e.get("worker", "?"))
+                      for e in dones + resumes})
+    return {
+        "schema": "decode-lanes-v1",
+        "meta_workers": int(meta.get("workers", 0)),
+        "kv_ladder": str(meta.get("kv_ladder", "")),
+        "watermark_stride": meta.get("watermark_stride"),
+        "workers": workers,
+        "sequences": len(dones),
+        "tokens": sum(int(e.get("tokens", 0)) for e in dones),
+        "lanes": lanes,
+        "phases": _decode_phase_table(per_seq),
+        "resume_spans": spans,
+        "resumed_sequences": len({s["sid"] for s in spans}),
+        "shed_events": len(sheds),
+        "failed_sequences": len(failures),
+        "goodput": goodput,
+    }
+
+
 def _leg_report(role: str, events: List[dict]) -> Dict[str, Any]:
     traces = [e for e in events if e["type"] == "batch_trace"]
     executed = {str(e["batch"]): e for e in traces}
@@ -245,7 +392,7 @@ def _leg_report(role: str, events: List[dict]) -> Dict[str, Any]:
                               - int(ev["submit_ns"][i])))
     totals.sort()
     workers = sorted({str(e["worker"]) for e in traces})
-    return {
+    leg = {
         "role": role,
         "tag": str(meta.get("tag", "")),
         "ladder": str(meta.get("ladder", "")),
@@ -264,6 +411,12 @@ def _leg_report(role: str, events: List[dict]) -> Dict[str, Any]:
         "retry_chains": _retry_chains(events, executed),
         "goodput": _goodput(traces, events),
     }
+    # Additive: a decode block appears only when the leg carries
+    # per-sequence events, so committed batch-plane reports (r16/r17)
+    # regenerate byte-identically.
+    if any(e["type"] == "seq_done" for e in events):
+        leg["decode"] = _decode_leg(events)
+    return leg
 
 
 def _attribution(legs: List[Dict[str, Any]]
@@ -317,6 +470,60 @@ def _attribution(legs: List[Dict[str, Any]]
     }
 
 
+def _decode_attribution(legs: List[Dict[str, Any]]
+                        ) -> Optional[Dict[str, Any]]:
+    """The decode-plane rerun of `_attribution`: decompose the
+    per-sequence cost of going from a one-worker to a two-worker
+    decode leg across DECODE_PHASES.  `admission` is the decode
+    analog of the batch plane's batch_cut — the r16 artifact pinned
+    95.1% of the scale-out regression there; this block is the
+    evidence that the sharded admission plane collapsed it."""
+    def pick(n: int) -> Optional[Dict[str, Any]]:
+        for leg in legs:
+            d = leg.get("decode")
+            if d is None or not d["sequences"]:
+                continue
+            workers = d["meta_workers"] or len(d["workers"])
+            if workers == n:
+                return leg
+        return None
+
+    base, scaled = pick(1), pick(2)
+    if base is None or scaled is None:
+        return None
+    deltas = {}
+    for p in DECODE_PHASES:
+        b = base["decode"]["phases"].get(p, {}).get("mean_ms", 0.0) \
+            or 0.0
+        s = scaled["decode"]["phases"].get(p, {}).get(
+            "mean_ms", 0.0) or 0.0
+        deltas[p] = (b, s, round(s - b, 4))
+    regression = sum(d for _, _, d in deltas.values() if d > 0)
+    by_phase = {}
+    for p, (b, s, delta) in deltas.items():
+        by_phase[p] = {
+            "base_mean_ms": b, "scaled_mean_ms": s,
+            "delta_ms": delta,
+            "share": (round(delta / regression, 4)
+                      if regression > 0 and delta > 0 else 0.0),
+        }
+    ranked = sorted(by_phase,
+                    key=lambda p: (-by_phase[p]["delta_ms"], p))
+    base_sh = base["decode"]["phases"].get(
+        "admission", {}).get("share", 0.0) or 0.0
+    scaled_sh = scaled["decode"]["phases"].get(
+        "admission", {}).get("share", 0.0) or 0.0
+    return {
+        "base_leg": base["role"], "scaled_leg": scaled["role"],
+        "by_phase": by_phase,
+        "regression_ms": round(regression, 4),
+        "dominant_phase": ranked[0],
+        "dominant_share": by_phase[ranked[0]]["share"],
+        "admission_share_base": base_sh,
+        "admission_share_scaled": scaled_sh,
+    }
+
+
 def serving_report(dir_: str) -> Dict[str, Any]:
     """The byte-deterministic analyzer result (see module doc)."""
     events, sources = _journal.load_journals(dir_)
@@ -325,12 +532,12 @@ def serving_report(dir_: str) -> Dict[str, Any]:
         role = str(e.get("role", "?"))
         if role.startswith("serving"):
             by_role.setdefault(role, []).append(e)
-    if not any(e["type"] == "batch_trace"
+    if not any(e["type"] in ("batch_trace", "seq_done")
                for evs in by_role.values() for e in evs):
         raise ValueError(
-            f"no serving batch_trace events under {dir_!r} — was the "
-            "run recorded with HOROVOD_SERVING_TRACE=1 and "
-            "HOROVOD_JOURNAL_DIR set?")
+            f"no serving batch_trace or seq_done events under "
+            f"{dir_!r} — was the run recorded with "
+            "HOROVOD_SERVING_TRACE=1 and HOROVOD_JOURNAL_DIR set?")
     legs = [_leg_report(role, by_role[role])
             for role in sorted(by_role)]
     report = {
@@ -342,6 +549,9 @@ def serving_report(dir_: str) -> Dict[str, Any]:
     attribution = _attribution(legs)
     if attribution is not None:
         report["attribution"] = attribution
+    decode_attr = _decode_attribution(legs)
+    if decode_attr is not None:
+        report["decode_attribution"] = decode_attr
     return report
 
 
@@ -390,6 +600,43 @@ def render_serving_report(report: Dict[str, Any]) -> str:
             lines.append(
                 f"    slo {cls}: hit {g['hit']}  late {g['late']}  "
                 f"failed {g['failed']}")
+        dec = leg.get("decode")
+        if dec:
+            lines.append(
+                f"    decode: {dec['sequences']} sequences / "
+                f"{dec['tokens']} tokens on "
+                f"{dec['meta_workers'] or len(dec['workers'])} "
+                f"worker(s), {dec['resumed_sequences']} resumed, "
+                f"{dec['shed_events']} shed, "
+                f"{dec['failed_sequences']} failed")
+            for p in DECODE_PHASES:
+                row = dec["phases"].get(p, {})
+                if not row.get("n"):
+                    continue
+                lines.append(
+                    f"      {p:<12} p50 {row['p50_ms']:>9} ms  "
+                    f"p99 {row['p99_ms']:>9} ms  "
+                    f"share {100 * row['share']:5.1f}%")
+            for lane in sorted(dec["lanes"]):
+                row = dec["lanes"][lane]
+                extra = ""
+                if "ttft_p50_ms" in row:
+                    extra = (f"  ttft p50 {row['ttft_p50_ms']} ms  "
+                             f"p99 {row['ttft_p99_ms']} ms")
+                lines.append(
+                    f"      lane {lane}: {row['sequences']} seqs, "
+                    f"{row['tokens']} tokens{extra}")
+            for sp in dec["resume_spans"]:
+                lines.append(
+                    f"      resume seq {sp['sid']}: -> "
+                    f"{sp['worker']} from token {sp['from_token']} "
+                    f"(watermark {sp['watermark']}, {sp['cause']}, "
+                    f"attempt {sp['attempt']})")
+            for cls in sorted(dec["goodput"]):
+                g = dec["goodput"][cls]
+                lines.append(
+                    f"      slo {cls}: hit {g['hit']}  "
+                    f"late {g['late']}  failed {g['failed']}")
     attr = report.get("attribution")
     if attr:
         lines.append(
@@ -403,4 +650,15 @@ def render_serving_report(report: Dict[str, Any]) -> str:
         lines.append("  top2: " + ", ".join(
             f"{t['phase']} {100 * t['share']:.1f}%"
             for t in attr["top2"]))
+    dattr = report.get("decode_attribution")
+    if dattr:
+        lines.append(
+            f"decode attribution ({dattr['base_leg']} -> "
+            f"{dattr['scaled_leg']}): dominant phase "
+            f"{dattr['dominant_phase']} "
+            f"({100 * dattr['dominant_share']:.1f}% of "
+            f"{dattr['regression_ms']:g} ms regression); admission "
+            f"share {100 * dattr['admission_share_base']:.1f}% -> "
+            f"{100 * dattr['admission_share_scaled']:.1f}% of "
+            "sequence latency")
     return "\n".join(lines)

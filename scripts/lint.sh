@@ -24,7 +24,8 @@
 # plus their call-graph neighbors, and runs the jaxpr tier only when
 # the focus set touches the semantic surface (parallel/,
 # ops/bucketing.py, numerics.py, serving.py, serving_trace.py,
-# weights.py, analysis/). CI runs the full pass (no args).
+# decoding.py, weights.py, analysis/). CI runs the full pass
+# (no args).
 set -u
 cd "$(dirname "$0")/.."
 
@@ -75,7 +76,7 @@ if [ "$CHANGED_ONLY" = "1" ]; then
                  git ls-files --others --exclude-standard 2>/dev/null; } \
                | sort -u )
     if ! printf '%s\n' "$changed" | grep -qE \
-        '^horovod_tpu/(parallel/|ops/bucketing\.py|ops/compression\.py|numerics\.py|serving\.py|serving_trace\.py|weights\.py|analysis/)'
+        '^horovod_tpu/(parallel/|ops/bucketing\.py|ops/compression\.py|numerics\.py|serving\.py|serving_trace\.py|decoding\.py|weights\.py|analysis/)'
     then
         run_jaxpr=0
         echo "== hvdlint (jaxpr tier): skipped (no semantic-tier files changed) =="

@@ -1,0 +1,82 @@
+"""HVD004 fixture: decode-step side-effects inside the jitted
+continuous-batching step (round 18).
+
+decoding.py's contract mirrors serving.py's: the `decode.step` /
+`kv.page` seam fires, the hvd_decode_* metrics, the per-sequence
+journal records (seq_watermark / seq_done) and the step-latency clock
+all live in the UNTRACED worker loop around the AOT-compiled decode
+step; the step itself (`_toy_step` and any user step_fn) is pure jnp
+math over (params, kv, tokens, positions, seeds). The positives are
+the tempting wrong version — journaling the watermark or timing the
+step from inside the trace, which would bake one trace-time sample
+into every compiled rung; the negatives are the engine-loop shape the
+subsystem actually uses.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu import faults
+from horovod_tpu.metrics import REGISTRY
+
+_m_fix_decode_steps = REGISTRY.counter(
+    "hvdfix_decode_steps_total",
+    "Seeded decode trace-impurity target.")
+
+
+@jax.jit
+def decode_step_counts_steps(params, kv, tokens):
+    _m_fix_decode_steps.inc()  # EXPECT: HVD004
+    h = params["embed"][tokens]
+    return kv, h
+
+
+@jax.jit
+def decode_step_journals_watermark(kv, tokens, positions):
+    from horovod_tpu import journal
+    journal.record("seq_watermark", sid=0, token=7)  # EXPECT: HVD004
+    return kv.at[0].set(0.0), tokens
+
+
+@jax.jit
+def decode_step_times_itself(kv, tokens):
+    t0 = time.perf_counter()  # EXPECT: HVD004
+    return kv * t0, tokens
+
+
+@jax.jit
+def decode_step_fires_seam(kv, tokens):
+    faults.fire("decode.step")  # EXPECT: HVD004
+    return kv, tokens + 1
+
+
+# -- negatives: the engine-loop shape decoding.py actually uses ------------
+
+@jax.jit
+def pure_decode_step(params, kv, tokens, positions):
+    """The real decode-step shape: masked attention over the KV rung,
+    vmapped per-slot writes, counter-based hash sampling — all pure."""
+    h = params["embed"][tokens]
+    kv2 = jax.vmap(lambda c, p, v: c.at[p].set(v))(kv, positions, h)
+    idx = jnp.arange(kv.shape[1], dtype=jnp.int32)
+    mask = idx[None, :] <= positions[:, None]
+    scores = jnp.einsum("srd,sd->sr", kv2, h)
+    att = jax.nn.softmax(jnp.where(mask, scores, -1e30), axis=-1)
+    ctx = jnp.einsum("sr,srd->sd", att, kv2)
+    logits = (ctx + h) @ params["unembed"]
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return kv2, nxt
+
+
+def engine_loop_effects_outside_trace(params, kv, tokens, positions):
+    # seam fire, step metric, latency clock and watermark journal wrap
+    # the compiled step from plain python — the intended split
+    faults.fire("decode.step", tag="w0")
+    t0 = time.perf_counter()
+    kv2, nxt = pure_decode_step(params, kv, tokens, positions)
+    _m_fix_decode_steps.inc()
+    from horovod_tpu import journal
+    journal.record("seq_watermark", sid=0, token=7)
+    return kv2, nxt, time.perf_counter() - t0

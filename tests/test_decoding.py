@@ -1,0 +1,670 @@
+"""Continuous-batching decode tests: KV-ladder determinism and the
+flat compile pin under cache growth, the per-(sequence, epoch)
+exactly-once token latch, watermark monotonicity in the journal,
+re-prefill equivalence (resumed logits bitwise-match an uninterrupted
+decode at the same seed), SLO-lane shedding, admission work-stealing,
+injected `decode.step` fault recovery, a real-process mid-SEQUENCE
+worker kill over the lease/emit wire (zero dropped sequences, zero
+re-emitted tokens), and the `doctor serve` decode-lane extension with
+its byte-identity pin on the committed r16 artifact."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu import decoding, faults, journal
+from horovod_tpu.common import config
+from horovod_tpu.decoding import (DecodeEngine, DecodeError,
+                                  DecodeFrontend, SequenceFuture,
+                                  _SeqSpec, build_kv_ladder)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+R16_DIR = os.path.join(REPO, "benchmarks", "serving_trace_r16")
+R16_ARTIFACT = os.path.join(REPO, "benchmarks",
+                            "SERVING_ATTRIBUTION_r16.json")
+CHAOS_WORKER = os.path.join(REPO, "tests", "decode_chaos_worker.py")
+R18_DIR = os.path.join(REPO, "benchmarks", "serving_decode_r18")
+R18_ARTIFACT = os.path.join(REPO, "benchmarks",
+                            "SERVING_ATTRIBUTION_r18.json")
+R18_BENCH = os.path.join(REPO, "benchmarks",
+                         "BENCH_serving_decode_r18.json")
+TRAJECTORY = os.path.join(REPO, "benchmarks", "BENCH_trajectory.json")
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_and_journal_state():
+    """Frontends (re)configure the module journal and tests arm the
+    fault plan; restore both so state never leaks across tests."""
+    yield
+    faults.configure("", seed=0)
+    if journal._journal is not None:
+        journal._journal.close()
+    journal._journal = None
+
+
+def _env(tmp_path=None, **over):
+    env = {
+        "HOROVOD_KV_PAGE_TOKENS": "8",
+        "HOROVOD_KV_MAX_CONTEXT": "64",
+        "HOROVOD_SERVING_DECODE_SLOTS": "4",
+        "HOROVOD_SERVING_DECODE_MAX_NEW_TOKENS": "16",
+        "HOROVOD_SERVING_DECODE_WATERMARK_STRIDE": "4",
+        "HOROVOD_SERVING_DECODE_LEASE_TIMEOUT_S": "2.0",
+        "HOROVOD_SERVING_DECODE_RETRY_BACKOFF_MS": "5",
+    }
+    if tmp_path is not None:
+        jdir = os.path.join(str(tmp_path), "journal")
+        os.makedirs(jdir, exist_ok=True)
+        env["HOROVOD_JOURNAL_DIR"] = jdir
+    env.update({k: str(v) for k, v in over.items()})
+    return env
+
+
+def _journal_events(tmp_path, role):
+    path = os.path.join(str(tmp_path), "journal",
+                        f"journal-{role}.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def _drain(fe, futs, timeout=120):
+    return [list(f.result(timeout=timeout)) for f in futs]
+
+
+# -- KV ladder ---------------------------------------------------------------
+
+
+class TestKVLadder:
+    def test_pow2_rungs_from_page(self):
+        lad = build_kv_ladder(env={"HOROVOD_KV_PAGE_TOKENS": "16",
+                                   "HOROVOD_KV_MAX_CONTEXT": "256"})
+        assert lad.rungs == (16, 32, 64, 128, 256)
+        assert lad.page == 16
+
+    def test_non_pow2_max_is_its_own_top_rung(self):
+        lad = build_kv_ladder(env={"HOROVOD_KV_PAGE_TOKENS": "16",
+                                   "HOROVOD_KV_MAX_CONTEXT": "48"})
+        assert lad.rungs == (16, 32, 48)
+
+    def test_rung_for_and_oversize(self):
+        lad = build_kv_ladder(env={"HOROVOD_KV_PAGE_TOKENS": "8",
+                                   "HOROVOD_KV_MAX_CONTEXT": "32"})
+        assert [lad.rung_for(n) for n in (1, 8, 9, 17, 32)] == \
+            [8, 8, 16, 32, 32]
+        with pytest.raises(ValueError):
+            lad.rung_for(33)
+
+    def test_digest_is_canonical_string(self):
+        lad = build_kv_ladder(env={"HOROVOD_KV_PAGE_TOKENS": "16",
+                                   "HOROVOD_KV_MAX_CONTEXT": "64"})
+        assert lad.digest == "kv-ladder-v1|page=16|r=16,32,64"
+
+    def test_bad_knobs_raise(self):
+        with pytest.raises(ValueError):
+            build_kv_ladder(env={"HOROVOD_KV_PAGE_TOKENS": "0",
+                                 "HOROVOD_KV_MAX_CONTEXT": "64"})
+        with pytest.raises(ValueError):
+            build_kv_ladder(env={"HOROVOD_KV_PAGE_TOKENS": "32",
+                                 "HOROVOD_KV_MAX_CONTEXT": "16"})
+
+
+def test_all_decode_knobs_declared():
+    """Every HOROVOD_SERVING_DECODE_* / HOROVOD_KV_* tunable is a
+    declared knob (the HVD002 registry/docs-drift gate hangs off
+    this list)."""
+    declared = {k.env: k for k in config.KNOBS}
+    expected = {
+        "HOROVOD_SERVING_DECODE_SLOTS": 4,
+        "HOROVOD_SERVING_DECODE_MAX_NEW_TOKENS": 64,
+        "HOROVOD_SERVING_DECODE_WATERMARK_STRIDE": 8,
+        "HOROVOD_SERVING_DECODE_INTERACTIVE_SLO_MS": 250.0,
+        "HOROVOD_SERVING_DECODE_LANE_BUDGET": 0.5,
+        "HOROVOD_SERVING_DECODE_RETRY_LIMIT": 3,
+        "HOROVOD_SERVING_DECODE_RETRY_BACKOFF_MS": 25.0,
+        "HOROVOD_SERVING_DECODE_LEASE_TIMEOUT_S": 10.0,
+        "HOROVOD_SERVING_DECODE_EMIT_STRIDE": 1,
+        "HOROVOD_KV_PAGE_TOKENS": 16,
+        "HOROVOD_KV_MAX_CONTEXT": 256,
+    }
+    for name, default in expected.items():
+        assert name in declared, name
+        assert declared[name].default == default, name
+
+
+# -- the exactly-once token latch --------------------------------------------
+
+
+class TestSequenceLatch:
+    def _seq(self, slo_ms=None):
+        return SequenceFuture(0, [1, 2], max_new=8, seed=0,
+                              slo_ms=slo_ms, interactive_ms=250.0)
+
+    def test_in_order_emission_accepted(self):
+        s = self._seq()
+        assert s.emit(0, 5, epoch=0)
+        assert s.emit(1, 6, epoch=0)
+        assert s.tokens == [5, 6]
+
+    def test_duplicate_index_rejected(self):
+        s = self._seq()
+        assert s.emit(0, 5, epoch=0)
+        assert not s.emit(0, 5, epoch=0)   # exact duplicate
+        assert not s.emit(0, 9, epoch=0)   # conflicting duplicate
+        assert s.tokens == [5]
+
+    def test_out_of_order_rejected(self):
+        s = self._seq()
+        assert not s.emit(1, 5, epoch=0)
+        assert s.tokens == []
+
+    def test_stale_epoch_rejected(self):
+        """The revenant path: a lease revoked by re-admission or shed
+        cannot emit — its epoch no longer matches."""
+        s = self._seq()
+        assert s.emit(0, 5, epoch=0)
+        new_epoch, frontier = s.advance_epoch()
+        assert (new_epoch, frontier) == (1, 1)
+        assert not s.emit(1, 6, epoch=0)    # revenant
+        assert s.emit(1, 6, epoch=1)        # rightful owner
+        assert s.tokens == [5, 6]
+
+    def test_finish_latches_exactly_once(self):
+        s = self._seq()
+        assert s.finish("ok", epoch=0)
+        assert not s.finish("ok", epoch=0)       # duplicate completion
+        assert not s.finish("failed", epoch=0)   # conflicting dup
+        assert not s.emit(0, 5, epoch=0)         # post-completion emit
+        assert list(s.result(timeout=1)) == []
+
+    def test_stale_epoch_finish_rejected(self):
+        s = self._seq()
+        s.advance_epoch()
+        assert not s.finish("ok", epoch=0)
+        assert s.finish("ok", epoch=1)
+
+    def test_lane_classification(self):
+        assert self._seq(slo_ms=100.0).lane == "interactive"
+        assert self._seq(slo_ms=1000.0).lane == "batch"
+        s = self._seq(slo_ms=None)
+        assert s.lane == "batch" and s.slo_class == "default"
+
+
+# -- engine: compile pin, rung growth, re-prefill equivalence -----------------
+
+
+class TestEngine:
+    def _engine(self, env=None, **kw):
+        return DecodeEngine(env=env or _env(), **kw)
+
+    def _run(self, eng, spec):
+        emits, finishes = [], []
+        eng.admit(spec)
+        while eng.active:
+            e, f = eng.step()
+            emits += e
+            finishes += f
+        return emits, finishes
+
+    def test_compile_count_flat_past_warmup(self):
+        """Cache growth across every rung never recompiles: the
+        compile count is pinned to len(rungs) by AOT warmup."""
+        eng = self._engine()
+        eng.warmup()
+        assert eng.compiles == len(eng.ladder.rungs)
+        # 3-token prompt + 50 new tokens crosses rungs 8->16->32->64
+        spec = _SeqSpec(0, (1, 2, 3), (), seed=1, max_new=50,
+                        epoch=0, lane="batch")
+        emits, finishes = self._run(eng, spec)
+        assert len(emits) == 50
+        assert finishes[0][1] == "ok"
+        assert eng.compiles == len(eng.ladder.rungs)
+
+    def test_truncated_at_max_context(self):
+        eng = self._engine()
+        eng.warmup()
+        spec = _SeqSpec(0, tuple(range(1, 60)), (), seed=1,
+                        max_new=50, epoch=0, lane="batch")
+        emits, finishes = self._run(eng, spec)
+        assert finishes[0][1] == "truncated"
+        assert len(emits) == 64 - 59
+
+    def test_reprefill_equivalence_bitwise(self):
+        """The watermark-resume contract: re-prefilling the prompt
+        plus the delivered tokens reproduces the interrupted decode
+        BITWISE — same tokens and same logits at the same seed — and
+        the replay region emits nothing."""
+        env = _env()
+        prompt, k, total = (3, 1, 4), 7, 20
+        eng = self._engine(env=env, capture_logits=True)
+        eng.warmup()
+        spec = _SeqSpec(0, prompt, (), seed=11, max_new=total,
+                        epoch=0, lane="batch")
+        emits, _ = self._run(eng, spec)
+        tokens = [t for _, _, t, _ in emits]
+        logits = {g: row for _, g, _, row in emits}
+        assert len(tokens) == total
+
+        eng2 = self._engine(env=env, capture_logits=True)
+        eng2.warmup()
+        spec2 = _SeqSpec(0, prompt, tuple(tokens[:k]), seed=11,
+                         max_new=total, epoch=1, lane="batch")
+        emits2, finishes2 = self._run(eng2, spec2)
+        # zero re-emitted tokens: the replay region is silent
+        assert min(g for _, g, _, _ in emits2) == k
+        assert [t for _, _, t, _ in emits2] == tokens[k:]
+        for _, g, _, row in emits2:
+            assert np.array_equal(row, logits[g]), g
+        assert finishes2[0][1] == "ok"
+
+    def test_neighbor_slots_cannot_change_results(self):
+        """Slots are independent: the same sequence decodes to the
+        same tokens whether it runs alone or beside others."""
+        env = _env()
+        eng = self._engine(env=env)
+        eng.warmup()
+        solo, _ = self._run(
+            eng, _SeqSpec(0, (5, 6), (), 3, 12, 0, "batch"))
+        eng2 = self._engine(env=env)
+        eng2.warmup()
+        eng2.admit(_SeqSpec(1, (9, 9, 9), (), 4, 12, 0, "batch"))
+        eng2.admit(_SeqSpec(2, (5, 6), (), 3, 12, 0, "batch"))
+        eng2.admit(_SeqSpec(3, (7,), (), 5, 12, 0, "batch"))
+        emits = []
+        while eng2.active:
+            e, _ = eng2.step()
+            emits += e
+        packed = [t for s, _, t, _ in emits if s.sid == 2]
+        assert packed == [t for _, _, t, _ in solo]
+
+
+# -- local frontend -----------------------------------------------------------
+
+
+class TestFrontendLocal:
+    def test_round_trip_and_determinism(self, tmp_path):
+        env = _env(tmp_path)
+        fe = DecodeFrontend(workers=2, env=env, trace_tag="rt")
+        try:
+            futs = [fe.submit([1, 2, 3], max_new_tokens=12, seed=s)
+                    for s in range(5)]
+            outs = _drain(fe, futs)
+            assert all(len(o) == 12 for o in outs)
+            again = fe.submit([1, 2, 3], max_new_tokens=12,
+                              seed=0).result(timeout=60)
+            assert list(again) == outs[0]
+            st = fe.stats()
+            assert st["completed"] == 6 and st["failed"] == 0
+            assert st["dupes"] == 0
+        finally:
+            fe.close()
+
+    def test_watermark_monotone_in_journal(self, tmp_path):
+        env = _env(tmp_path)   # stride 4
+        fe = DecodeFrontend(workers=1, env=env, trace_tag="wm")
+        try:
+            f = fe.submit([1, 2], max_new_tokens=16, seed=2)
+            f.result(timeout=60)
+        finally:
+            fe.close()
+        evs = _journal_events(tmp_path, "serving-wm")
+        marks = [e["token"] for e in evs
+                 if e["type"] == "seq_watermark" and e["sid"] == f.id]
+        assert marks == [3, 7, 11, 15]      # stride multiples, in order
+        assert marks == sorted(marks)
+        done = [e for e in evs if e["type"] == "seq_done"]
+        assert done and done[0]["tokens"] == 16
+        assert done[0]["outcome"] == "ok"
+
+    def test_fault_error_resumes_from_watermark(self, tmp_path):
+        """A worker killed mid-sequence by the decode.step seam: its
+        sequences resume on the survivor and the delivered stream
+        bitwise-matches an uninterrupted run — zero dropped, zero
+        re-emitted."""
+        env = _env(tmp_path)
+        fe = DecodeFrontend(workers=1, env=env, trace_tag="base")
+        try:
+            base = [list(fe.submit([1, 2, 3], max_new_tokens=40,
+                                   seed=s).result(timeout=120))
+                    for s in range(2)]
+        finally:
+            fe.close()
+
+        faults.configure("decode.step:error:at=12", seed=0)
+        fe2 = DecodeFrontend(workers=2, env=env, trace_tag="kill")
+        fe2.start_watchdog()
+        try:
+            futs = [fe2.submit([1, 2, 3], max_new_tokens=40, seed=s)
+                    for s in range(2)]
+            outs = _drain(fe2, futs)
+            assert outs == base
+            st = fe2.stats()
+            assert st["resumed"] >= 1
+            assert st["dupes"] == 0
+            assert st["completed"] == 2 and st["failed"] == 0
+        finally:
+            fe2.close()
+        evs = _journal_events(tmp_path, "serving-kill")
+        resumed = [e for e in evs if e["type"] == "seq_resumed"]
+        assert resumed and resumed[0]["cause"] == "fault_error"
+        assert resumed[0]["from_token"] >= resumed[0]["watermark"]
+
+    def test_retry_limit_exhausted_fails_visibly(self, tmp_path):
+        env = _env(tmp_path, HOROVOD_SERVING_DECODE_RETRY_LIMIT="0")
+        faults.configure("decode.step:error:at=5", seed=0)
+        fe = DecodeFrontend(workers=1, env=env, trace_tag="exhaust")
+        try:
+            futs = [fe.submit([1, 2, 3], max_new_tokens=30, seed=s)
+                    for s in range(2)]
+            failed = 0
+            for f in futs:
+                with pytest.raises(DecodeError):
+                    f.result(timeout=60)
+                failed += 1
+            assert failed == 2
+            assert fe.stats()["failed"] == 2
+        finally:
+            fe.close()
+        evs = _journal_events(tmp_path, "serving-exhaust")
+        assert [e for e in evs if e["type"] == "seq_failed"]
+
+    def test_batch_lane_sheds_for_interactive(self, tmp_path):
+        """Graceful degradation: with the pool full of batch work and
+        an interactive sequence waiting, the least-progressed batch
+        sequence is parked (and later finishes) while the interactive
+        lane gets its slot."""
+        env = _env(tmp_path, HOROVOD_SERVING_DECODE_SLOTS="2",
+                   HOROVOD_SERVING_DECODE_LANE_BUDGET="0.5")
+        # The toy LM steps in microseconds — slow every decode step
+        # so the batch sequences are genuinely long-running when the
+        # interactive one arrives.
+        faults.configure("decode.step:delay:ms=15,every=1", seed=0)
+        fe = DecodeFrontend(workers=1, env=env, trace_tag="shed")
+        try:
+            heavy = [fe.submit([1, 2, 3], max_new_tokens=50, seed=s,
+                               slo_ms=10000.0) for s in range(2)]
+            eng = fe._threads["w0"].engine
+            deadline = time.monotonic() + 30
+            while (eng.active_by_lane().get("batch", 0) < 2
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)   # wait out AOT warmup + admission
+            assert eng.active_by_lane()["batch"] == 2
+            quick = fe.submit([4, 5], max_new_tokens=8, seed=9,
+                              slo_ms=50.0)
+            assert quick.lane == "interactive"
+            assert len(quick.result(timeout=60)) == 8
+            outs = _drain(fe, heavy)
+            assert all(len(o) == 50 for o in outs)
+            st = fe.stats()
+            assert st["shed"] >= 1
+            assert st["completed"] == 3 and st["failed"] == 0
+            assert st["dupes"] == 0
+        finally:
+            fe.close()
+        evs = _journal_events(tmp_path, "serving-shed")
+        sheds = [e for e in evs if e["type"] == "seq_shed"]
+        assert sheds and sheds[0]["lane"] == "batch"
+
+    def test_admission_steals_from_longest_queue(self, tmp_path):
+        """Sharded admission: a worker with an empty queue steals
+        from another worker's backlog instead of idling."""
+        env = _env(tmp_path, HOROVOD_SERVING_DECODE_SLOTS="2")
+        fe = DecodeFrontend(workers=1, env=env, trace_tag="steal")
+        try:
+            futs = [fe.submit([1, 2], max_new_tokens=20, seed=s)
+                    for s in range(6)]   # all queue on w0
+            fe.add_worker("w9")          # empty queue: must steal
+            _drain(fe, futs)
+            assert fe.stats()["steals"] >= 1
+        finally:
+            fe.close()
+
+    def test_close_fails_stragglers_visibly(self, tmp_path):
+        env = _env(tmp_path)
+        fe = DecodeFrontend(workers=1, env=env, trace_tag="close")
+        f = fe.submit([1, 2], max_new_tokens=1000, seed=0)
+        fe.close()
+        with pytest.raises(DecodeError):
+            f.result(timeout=10)
+
+    def test_submit_validates_prompt(self, tmp_path):
+        env = _env(tmp_path)
+        fe = DecodeFrontend(workers=0, env=env, trace_tag="val")
+        try:
+            with pytest.raises(ValueError):
+                fe.submit([], max_new_tokens=4)
+            with pytest.raises(ValueError):
+                fe.submit(list(range(64)), max_new_tokens=4)
+        finally:
+            fe.close()
+
+
+# -- the real-process mid-sequence kill ---------------------------------------
+
+
+class TestRemoteKill:
+    def _spawn(self, port, secret, wid, extra_env):
+        env = dict(os.environ)
+        env.update(extra_env)
+        env.update({
+            "DECODE_TEST_ADDR": "127.0.0.1",
+            "DECODE_TEST_PORT": str(port),
+            "DECODE_TEST_SECRET": secret,
+            "DECODE_TEST_WID": wid,
+            "JAX_PLATFORMS": "cpu",
+        })
+        return subprocess.Popen(
+            [sys.executable, CHAOS_WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+
+    def test_real_worker_kill_mid_sequence(self, tmp_path):
+        """The headline: a REAL process crash (exit 43) mid-sequence.
+        Every in-flight sequence resumes from its KV watermark on the
+        survivor — zero dropped sequences, zero re-emitted tokens,
+        streams bitwise-identical to an uninterrupted run."""
+        env = _env(tmp_path,
+                   HOROVOD_SERVING_DECODE_LEASE_TIMEOUT_S="1.0")
+        fe = DecodeFrontend(workers=1, env=env, trace_tag="killbase")
+        try:
+            base = [list(fe.submit([1, 2, 3], max_new_tokens=24,
+                                   seed=s).result(timeout=120))
+                    for s in range(3)]
+        finally:
+            fe.close()
+
+        fe2 = DecodeFrontend(workers=0, env=env, trace_tag="killrun")
+        fe2.start_watchdog()
+        port, secret = fe2.decode_endpoint()
+        worker_env = {k: str(v) for k, v in env.items()}
+        crashy = self._spawn(
+            port, secret, "crashy",
+            dict(worker_env, HOROVOD_FAULTS="decode.step:crash:at=15",
+                 HOROVOD_FAULTS_SEED="0"))
+        try:
+            futs = [fe2.submit([1, 2, 3], max_new_tokens=24, seed=s)
+                    for s in range(3)]
+            rc = crashy.wait(timeout=180)
+            assert rc == faults.CRASH_EXIT_CODE
+            survivor = self._spawn(port, secret, "survivor",
+                                   dict(worker_env))
+            try:
+                outs = _drain(fe2, futs, timeout=180)
+                # zero dropped: every sequence completed...
+                assert [f.outcome for f in futs] == ["ok"] * 3
+                # ...zero re-emitted: streams match uninterrupted runs
+                assert outs == base
+                st = fe2.stats()
+                assert st["resumed"] >= 1
+                assert st["dupes"] == 0 and st["failed"] == 0
+            finally:
+                fe2.close()
+                survivor.wait(timeout=60)
+        finally:
+            if crashy.poll() is None:
+                crashy.kill()
+        evs = _journal_events(tmp_path, "serving-killrun")
+        resumed = [e for e in evs if e["type"] == "seq_resumed"]
+        assert resumed
+        assert all(e["from_token"] >= max(0, e["watermark"])
+                   for e in resumed)
+
+
+# -- doctor serve: decode lanes ------------------------------------------------
+
+
+class TestServingTraceDecode:
+    def _record_leg(self, tmp_path, workers, tag, fault=None):
+        env = _env(tmp_path,
+                   HOROVOD_KV_MAX_CONTEXT="32")
+        if fault:
+            faults.configure(fault, seed=0)
+        fe = DecodeFrontend(workers=workers, env=env, trace_tag=tag)
+        fe.start_watchdog()
+        try:
+            futs = [fe.submit([1, 2, 3], max_new_tokens=12, seed=s,
+                              slo_ms=(50.0 if s % 2 else 5000.0))
+                    for s in range(6)]
+            _drain(fe, futs)
+        finally:
+            fe.close()
+            faults.configure("", seed=0)
+            if journal._journal is not None:
+                journal._journal.close()
+            journal._journal = None
+
+    def test_decode_only_journal_reports(self, tmp_path):
+        from horovod_tpu import serving_trace
+        self._record_leg(tmp_path, 1, "d1")
+        self._record_leg(tmp_path, 2, "d2",
+                         fault="decode.step:error:at=25")
+        jdir = os.path.join(str(tmp_path), "journal")
+        report = serving_trace.serving_report(jdir)
+        legs = {l["role"]: l for l in report["legs"]}
+        d1 = legs["serving-d1"]["decode"]
+        d2 = legs["serving-d2"]["decode"]
+        assert d1["sequences"] == 6 and d1["tokens"] == 72
+        assert d1["meta_workers"] == 1 and d2["meta_workers"] == 2
+        assert set(d1["lanes"]) == {"interactive", "batch"}
+        assert d2["resume_spans"], "fault leg must carry resume spans"
+        sp = d2["resume_spans"][0]
+        assert sp["from_token"] >= sp["watermark"]
+        assert "decode_attribution" in report
+        attr = report["decode_attribution"]
+        assert attr["base_leg"] == "serving-d1"
+        assert attr["scaled_leg"] == "serving-d2"
+        # the rendered summary mentions the decode lanes
+        text = serving_trace.render_serving_report(report)
+        assert "decode:" in text and "resume seq" in text
+
+    def test_doctor_serve_exit_contract_decode_only(self, tmp_path):
+        from horovod_tpu.runner import doctor
+        self._record_leg(tmp_path, 1, "solo")
+        jdir = os.path.join(str(tmp_path), "journal")
+        assert doctor.main(["serve", jdir]) == 0
+        assert os.path.exists(os.path.join(jdir,
+                                           "serving_report.json"))
+
+    def test_doctor_serve_empty_dir_still_fails(self, tmp_path):
+        from horovod_tpu.runner import doctor
+        empty = os.path.join(str(tmp_path), "empty")
+        os.makedirs(empty)
+        assert doctor.main(["serve", empty]) == 1
+
+    def test_r16_artifact_regenerates_byte_identically(self):
+        """The schema-extension pin: the decode blocks are additive,
+        so the committed batch-plane artifact regenerates to the
+        exact committed bytes — and carries no decode keys."""
+        from horovod_tpu import serving_trace
+        report = serving_trace.serving_report(R16_DIR)
+        new = json.dumps(report, indent=1, sort_keys=True) + "\n"
+        with open(R16_ARTIFACT) as f:
+            committed = f.read()
+        assert new == committed
+        assert "decode_attribution" not in report
+        assert all("decode" not in leg for leg in report["legs"])
+
+
+class TestCommittedDecodeArtifacts:
+    """The r18 acceptance pins: SERVING_ATTRIBUTION_r18.json
+    regenerates byte-identically from the committed decode recording
+    (benchmarks/serving_decode_r18/), the committed bench doc shows a
+    monotone 1->2->4-worker tokens/s curve, and the chaos leg proves
+    a real mid-sequence worker death resumed every in-flight sequence
+    with zero dropped sequences and zero re-emitted tokens."""
+
+    def test_r18_artifact_regenerates_byte_identically(self, tmp_path):
+        from horovod_tpu import serving_trace
+        out = os.path.join(str(tmp_path), "regen.json")
+        serving_trace.write_serving_report(R18_DIR, out=out)
+        with open(R18_ARTIFACT, "rb") as f:
+            want = f.read()
+        assert open(out, "rb").read() == want
+        # the recording's in-dir report is the same bytes too
+        assert open(os.path.join(R18_DIR, "serving_report.json"),
+                    "rb").read() == want
+
+    def test_r18_attribution_acceptance(self):
+        report = json.load(open(R18_ARTIFACT))
+        from horovod_tpu import serving_trace
+        assert report["schema"] == serving_trace.REPORT_SCHEMA
+        legs = {leg["role"]: leg for leg in report["legs"]}
+        assert {"serving-d1", "serving-d2", "serving-dkill"} <= \
+            set(legs)
+        for role in ("serving-d1", "serving-d2", "serving-dkill"):
+            assert "decode" in legs[role]
+        attr = report["decode_attribution"]
+        assert attr["base_leg"] == "serving-d1"
+        assert attr["scaled_leg"] == "serving-d2"
+        # the r16 lesson applied: admission must not pay for the
+        # second worker on the decode plane
+        assert attr["dominant_phase"] != "admission"
+        assert attr["admission_share_scaled"] < \
+            attr["admission_share_base"]
+        # the chaos leg's resume spans are in the committed report
+        kill = legs["serving-dkill"]["decode"]
+        assert kill["resumed_sequences"] >= 1
+        assert kill["failed_sequences"] == 0
+        assert all(s["from_token"] >= 0
+                   for s in kill["resume_spans"])
+
+    def test_r18_bench_doc_pins(self):
+        doc = json.load(open(R18_BENCH))
+        t1 = doc["scaleout"]["workers1"]["tokens_per_s"]
+        t2 = doc["scaleout"]["workers2"]["tokens_per_s"]
+        t4 = doc["scaleout"]["workers4"]["tokens_per_s"]
+        assert t1 < t2 < t4  # the r15 regression is gone
+        chaos = doc["chaos"]
+        assert chaos["worker_exit_code"] == 43
+        assert chaos["dropped"] == 0
+        assert chaos["failed"] == 0
+        assert chaos["resumed"] >= 1
+        assert chaos["duplicate_tokens_suppressed"] == 0
+        assert chaos["streams_match_uninterrupted_baseline"] is True
+        attr = json.load(open(R18_ARTIFACT))["decode_attribution"]
+        assert doc["decode_attribution"]["admission_share_scaled"] \
+            == attr["admission_share_scaled"]
+
+    def test_r18_trajectory_row(self):
+        traj = json.load(open(TRAJECTORY))
+        row = traj["r18_decode"]
+        doc = json.load(open(R18_BENCH))
+        assert row["scaleout_4worker_tokens_per_s"] == \
+            doc["scaleout"]["workers4"]["tokens_per_s"]
+        assert row["chaos_dropped_sequences"] == 0
+        assert row["chaos_streams_match_baseline"] is True
+        attr = json.load(open(R18_ARTIFACT))["decode_attribution"]
+        assert row["admission_share_base"] == \
+            attr["admission_share_base"]
+        assert row["admission_share_scaled"] == \
+            attr["admission_share_scaled"]
+        assert row["source"] == \
+            "benchmarks/BENCH_serving_decode_r18.json + " \
+            "benchmarks/SERVING_ATTRIBUTION_r18.json"
