@@ -28,7 +28,7 @@ import tokenize
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 RULE_IDS = ("HVD001", "HVD002", "HVD003", "HVD004", "HVD005",
-            "HVD006", "HVD007")
+            "HVD006", "HVD007", "HVD008", "HVD009")
 
 _SUPPRESS_RE = re.compile(
     r"#\s*hvdlint:\s*(disable|disable-next|disable-file)\s*="
@@ -302,6 +302,137 @@ class KnobRegistry:
         return reg if reg.knobs else None
 
 
+@dataclasses.dataclass
+class EventDecl:
+    """One declared journal event type, extracted from an
+    EventSchema(...) call in the EVENT_SCHEMAS registry list."""
+
+    name: str
+    line: int
+    writer: str = "any"
+    required: Tuple[str, ...] = ()
+    optional: Tuple[str, ...] = ()
+    critical: bool = False
+
+    @property
+    def fields(self) -> Set[str]:
+        return set(self.required) | set(self.optional)
+
+
+def _str_tuple(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """Tuple of string constants from a tuple/list display; None when
+    any element is not a plain string literal."""
+    if not isinstance(node, (ast.Tuple, ast.List)):
+        return None
+    out: List[str] = []
+    for elt in node.elts:
+        s = str_const(elt)
+        if s is None:
+            return None
+        out.append(s)
+    return tuple(out)
+
+
+class EventRegistry:
+    """The `EventSchema` declarations of a journal module, extracted
+    from its AST (never imported) — HVD008's analog of KnobRegistry.
+    Also captures the module's BASE_FIELDS envelope set so the rule
+    never hardcodes the record plumbing's field names."""
+
+    # Fallback when the declaring module has no extractable
+    # BASE_FIELDS (older fixture corpora).
+    DEFAULT_BASE_FIELDS = frozenset(
+        {"type", "role", "rank", "pid", "mono_ns", "t", "n"})
+
+    def __init__(self, rel: str):
+        self.rel = rel
+        self.line = 0
+        self.events: List[EventDecl] = []
+        self.base_fields: Set[str] = set(self.DEFAULT_BASE_FIELDS)
+
+    @property
+    def declared(self) -> Set[str]:
+        return {e.name for e in self.events}
+
+    def decl(self, name: str) -> Optional[EventDecl]:
+        for e in self.events:
+            if e.name == name:
+                return e
+        return None
+
+    @classmethod
+    def extract(cls, sf: "SourceFile") -> Optional["EventRegistry"]:
+        """Returns a registry if `sf` declares one (an EVENT_SCHEMAS
+        list of EventSchema(...) calls), else None."""
+        if sf.tree is None:
+            return None
+        reg = cls(sf.rel)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            if node.value is None:
+                continue
+            for tgt in targets:
+                name = tgt.id if isinstance(tgt, ast.Name) else ""
+                if name == "EVENT_SCHEMAS" and isinstance(
+                        node.value, ast.List):
+                    reg.line = node.lineno
+                    for elt in node.value.elts:
+                        decl = cls._decl_from_call(elt)
+                        if decl is not None:
+                            reg.events.append(decl)
+                elif name == "BASE_FIELDS":
+                    base = cls._base_fields(node.value)
+                    if base is not None:
+                        reg.base_fields = base
+        return reg if reg.events else None
+
+    @staticmethod
+    def _decl_from_call(elt: ast.AST) -> Optional[EventDecl]:
+        if not (isinstance(elt, ast.Call)
+                and call_name(elt) == "EventSchema" and elt.args):
+            return None
+        name = str_const(elt.args[0])
+        if not name:
+            return None
+        writer = (str_const(elt.args[1])
+                  if len(elt.args) > 1 else None) or "any"
+        required: Tuple[str, ...] = ()
+        optional: Tuple[str, ...] = ()
+        critical = False
+        for kw in elt.keywords:
+            if kw.arg == "required":
+                required = _str_tuple(kw.value) or ()
+            elif kw.arg == "optional":
+                optional = _str_tuple(kw.value) or ()
+            elif kw.arg == "critical" and isinstance(
+                    kw.value, ast.Constant):
+                critical = bool(kw.value.value)
+        return EventDecl(name, elt.lineno, writer,
+                         required, optional, critical)
+
+    @staticmethod
+    def _base_fields(node: ast.AST) -> Optional[Set[str]]:
+        """`frozenset({...})` / set / tuple / list display of string
+        constants."""
+        if (isinstance(node, ast.Call)
+                and call_name(node) in ("frozenset", "set")
+                and node.args):
+            node = node.args[0]
+        elts = getattr(node, "elts", None)
+        if elts is None:
+            return None
+        out = set()
+        for e in elts:
+            s = str_const(e)
+            if s is None:
+                return None
+            out.add(s)
+        return out
+
+
 class Project:
     """The full set of files under analysis plus cross-file tables the
     whole-program rules (HVD002/HVD003) need."""
@@ -322,6 +453,14 @@ class Project:
             if reg is not None:
                 self.registry = reg
                 self.registry_file = sf
+                break
+        self.event_registry: Optional[EventRegistry] = None
+        self.event_registry_file: Optional[SourceFile] = None
+        for sf in self.files:
+            ereg = EventRegistry.extract(sf)
+            if ereg is not None:
+                self.event_registry = ereg
+                self.event_registry_file = sf
                 break
 
     def in_focus(self, sf: "SourceFile") -> bool:

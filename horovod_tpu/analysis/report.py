@@ -15,6 +15,14 @@ from .model import Finding
 
 JSON_VERSION = 1
 
+# Lint output itself must be reproducible (CI diffs, baselines):
+# hvdlint HVD009 seeds its reachability check from these names.
+DETERMINISTIC_ENTRYPOINTS = (
+    "render_text",
+    "render_json",
+    "render_github",
+)
+
 
 def render_text(findings: List[Finding],
                 suppressed: int = 0,

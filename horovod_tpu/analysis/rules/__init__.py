@@ -28,6 +28,8 @@ from .locks import LockDisciplineRule       # noqa: E402
 from .trace import TracePurityRule          # noqa: E402
 from .protocol import ProtocolRule          # noqa: E402
 from .lockset import LocksetRule            # noqa: E402
+from .events import EventSchemaRule         # noqa: E402
+from .determinism import DeterminismRule    # noqa: E402
 from .jaxpr_rules import JaxprVerifierRule  # noqa: E402
 
 # The pure-AST tiers: what `run_analysis` executes. HVD007 is NOT in
@@ -41,6 +43,8 @@ ALL_RULES: List[Type[Rule]] = [
     TracePurityRule,
     ProtocolRule,
     LocksetRule,
+    EventSchemaRule,
+    DeterminismRule,
 ]
 
 SEMANTIC_RULES: List[Type[Rule]] = [

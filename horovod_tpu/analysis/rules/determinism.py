@@ -1,0 +1,265 @@
+"""HVD009 — byte-determinism verifier for the artifact/analyzer plane.
+
+The repo's proof surface is byte-deterministic regeneration: `doctor
+incident` / `doctor serve`, `bench.py --trajectory`, the profiling
+digests, and the lint reports themselves are all test-pinned to
+reproduce committed artifacts byte-for-byte. A wall-clock read or a
+set-order walk on one of those paths does not fail loudly — it
+corrupts an attribution report until a byte-identity pin flakes,
+usually long after the commit that introduced it.
+
+Modules opt their byte-pinned surface in by declaring a module-level
+`DETERMINISTIC_ENTRYPOINTS = ("fn", ...)` tuple naming top-level
+functions. This rule seeds the whole-repo call graph
+(analysis/graph.py) with those functions and flags, in every
+reachable function body:
+
+  * wall-clock reads (time.time / monotonic / perf_counter,
+    datetime.now/utcnow) — timestamps in output bytes;
+  * `random` module calls and unseeded `Random()` / numpy generator
+    constructions — `random.Random(<seed>)` with an argument is
+    deterministic and allowed;
+  * iteration directly over a set display / `set()` / `frozenset()`
+    — set order is salted per process; wrap in `sorted(...)`;
+  * `os.listdir` / `glob.glob` / `iglob` / `scandir` / `iterdir`
+    results iterated without an intervening sort — filesystem order
+    is arbitrary (assign-then-`sorted(x)` / `x.sort()` is fine, and
+    order-insensitive reductions like `max(...)` never iterate);
+  * `json.dump(s)` without a truthy `sort_keys` — dict order is
+    insertion order, i.e. code-path-dependent;
+  * `id(...)` — address-keyed output differs per process.
+
+Findings name the (lexicographically first) entry point that reaches
+the offending function, so the report reads as "this corrupts THAT
+artifact". The reachability frontier deliberately stops at resolved
+project-internal calls — graph.py's documented-modest resolution —
+so the honest gap is unresolved indirection, not noise.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..model import Finding, Project, SourceFile, attr_chain, call_name
+from . import Rule
+from .trace import _WALLCLOCK
+from .. import graph as graph_mod
+
+# Callables whose result does not depend on the iteration order of
+# their argument: wrapping an unordered source in one of these is
+# deterministic by construction.
+_ORDER_INSENSITIVE = {"sorted", "max", "min", "len", "set",
+                      "frozenset", "sum", "any", "all"}
+
+_FS_WALKS = {"os.listdir", "listdir", "glob.glob", "glob.iglob",
+             "iglob", "os.scandir", "scandir"}
+_FS_METHODS = {"iterdir", "glob", "rglob"}
+
+_RANDOM_MODULES = ("random", "np.random", "numpy.random")
+
+
+def _is_wallclock(call: ast.Call) -> Optional[str]:
+    chain = attr_chain(call.func)
+    if not chain:
+        return None
+    if chain in _WALLCLOCK:
+        return chain
+    tail2 = ".".join(chain.split(".")[-2:])
+    return tail2 if tail2 in _WALLCLOCK else None
+
+
+def _is_random(call: ast.Call) -> Optional[str]:
+    """Description of an unpinned randomness source, or None. A
+    seeded construction (`random.Random(17)`, `default_rng(0)`,
+    `RandomState(0)`) is deterministic and allowed."""
+    chain = attr_chain(call.func)
+    last = chain.split(".")[-1] if chain else call_name(call)
+    if last in ("Random", "RandomState", "default_rng", "PRNGKey"):
+        return None if (call.args or call.keywords) else \
+            f"unseeded {last}()"
+    for mod in _RANDOM_MODULES:
+        if chain.startswith(mod + "."):
+            return f"{chain}(...)"
+    return None
+
+
+def _fs_walk_call(node: ast.AST) -> Optional[str]:
+    """The dotted name when `node` is a filesystem-enumeration call
+    whose result order is arbitrary."""
+    if not isinstance(node, ast.Call):
+        return None
+    chain = attr_chain(node.func)
+    if chain in _FS_WALKS:
+        return chain
+    last = chain.split(".")[-1] if chain else ""
+    if last in _FS_METHODS and "." in chain:
+        return chain
+    return None
+
+
+def _unordered_iterable(node: ast.AST) -> Optional[str]:
+    """Description when iterating `node` directly is order-salted:
+    a set display or a set()/frozenset() construction."""
+    if isinstance(node, ast.Set):
+        return "a set display"
+    if (isinstance(node, ast.Call)
+            and call_name(node) in ("set", "frozenset")
+            and attr_chain(node.func) in ("set", "frozenset")):
+        return f"{call_name(node)}(...)"
+    return None
+
+
+class DeterminismRule(Rule):
+    id = "HVD009"
+    summary = ("nondeterminism source (wall clock, unseeded random, "
+               "set-order iteration, unsorted directory walk, json "
+               "without sort_keys, id()) reachable from a "
+               "byte-deterministic entry point")
+
+    def run(self, project: Project) -> List[Finding]:
+        g = graph_mod.get_call_graph(project)
+        seeds: List[str] = []
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            for name in self._declared_entrypoints(sf):
+                key = f"{sf.rel}::{name}"
+                if key in g.funcs:
+                    seeds.append(key)
+        if not seeds:
+            return []
+        seeds = sorted(set(seeds))
+        reachable = g.reach(seeds)
+        # First (lexicographic) entry point reaching each function —
+        # the artifact a finding corrupts.
+        entry_of: Dict[str, str] = {}
+        for seed in seeds:
+            for key in g.reach([seed]):
+                entry_of.setdefault(key, seed.split("::", 1)[-1])
+        findings: List[Finding] = []
+        seen: Set[Tuple[str, int, int, str]] = set()
+        by_rel = {sf.rel: sf for sf in project.files}
+        for key in sorted(reachable):
+            info = g.funcs.get(key)
+            if info is None:
+                continue
+            sf = by_rel.get(info.rel)
+            if sf is None or sf.tree is None:
+                continue
+            via = entry_of.get(key, "?")
+            for f in self._check_function(sf, info.node, via):
+                dk = (f.path, f.line, f.col, f.message)
+                if dk not in seen:  # nested defs are walked twice
+                    seen.add(dk)
+                    findings.append(f)
+        return findings
+
+    @staticmethod
+    def _declared_entrypoints(sf: SourceFile) -> List[str]:
+        out: List[str] = []
+        for node in sf.tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and node.targets[0].id
+                    == "DETERMINISTIC_ENTRYPOINTS"):
+                continue
+            elts = getattr(node.value, "elts", None) or []
+            for e in elts:
+                if (isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)):
+                    out.append(e.value)
+        return out
+
+    # -- per-function checks ------------------------------------------
+
+    def _check_function(self, sf: SourceFile, fn: ast.AST,
+                        via: str) -> List[Finding]:
+        findings: List[Finding] = []
+
+        def flag(node: ast.AST, what: str) -> None:
+            findings.append(Finding(
+                self.id, sf.rel, node.lineno, node.col_offset + 1,
+                f"{what} on a byte-deterministic path (reachable "
+                f"from entry point '{via}'); identical inputs must "
+                f"produce identical artifact bytes",
+                sf.context_of(node)))
+
+        # Vars bound to a filesystem walk in this function, minus vars
+        # that are ever sorted (x = sorted(...), x.sort()) — iterating
+        # a surviving var is an unsorted-walk finding.
+        walk_vars: Dict[str, str] = {}
+        sorted_vars: Set[str] = set()
+        for node in ast.walk(fn):
+            if (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                src = _fs_walk_call(node.value)
+                tgt = node.targets[0].id
+                if src is not None:
+                    walk_vars[tgt] = src
+                elif (isinstance(node.value, ast.Call)
+                      and call_name(node.value) == "sorted"):
+                    sorted_vars.add(tgt)
+            elif (isinstance(node, ast.Call)
+                  and isinstance(node.func, ast.Attribute)
+                  and node.func.attr == "sort"
+                  and isinstance(node.func.value, ast.Name)):
+                sorted_vars.add(node.func.value.id)
+
+        def check_iter(it: ast.AST) -> None:
+            what = _unordered_iterable(it)
+            if what is not None:
+                flag(it, f"iteration over {what} (set order is "
+                         f"salted per process)")
+                return
+            src = _fs_walk_call(it)
+            if src is not None:
+                flag(it, f"iteration over unsorted {src} "
+                         f"(filesystem order is arbitrary)")
+                return
+            if (isinstance(it, ast.Name) and it.id in walk_vars
+                    and it.id not in sorted_vars):
+                flag(it, f"iteration over unsorted "
+                         f"{walk_vars[it.id]} result "
+                         f"'{it.id}' (filesystem order is "
+                         f"arbitrary)")
+
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                check_iter(node.iter)
+            elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                   ast.GeneratorExp, ast.DictComp)):
+                for gen in node.generators:
+                    check_iter(gen.iter)
+            if not isinstance(node, ast.Call):
+                continue
+            wc = _is_wallclock(node)
+            if wc is not None:
+                flag(node, f"wall-clock read {wc}() (timestamps "
+                           f"differ per run)")
+                continue
+            rnd = _is_random(node)
+            if rnd is not None:
+                flag(node, f"randomness source {rnd} without a "
+                           f"pinned seed")
+                continue
+            chain = attr_chain(node.func)
+            if chain.split(".")[-1] in ("dump", "dumps") \
+                    and chain.split(".")[0] in ("json", "_json"):
+                sk = next((kw for kw in node.keywords
+                           if kw.arg == "sort_keys"), None)
+                ok = (sk is not None
+                      and not (isinstance(sk.value, ast.Constant)
+                               and not sk.value.value))
+                if not ok:
+                    flag(node, f"{chain}() without sort_keys=True "
+                               f"(dict order is code-path-"
+                               f"dependent)")
+                continue
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id == "id" and node.args):
+                flag(node, "id() in output (addresses differ per "
+                           "process)")
+        return findings

@@ -1,0 +1,487 @@
+"""HVD008 — journal event-schema enforcement.
+
+The typed journal-event vocabulary is written from ~10 modules and
+consumed by three offline analyzers whose output bytes are pinned by
+committed artifacts. Nothing type-checks either side: a misspelled
+field name at a `journal.record(...)` site silently journals the
+wrong key, and a misspelled key in an analyzer silently drops the
+field from an attribution report — until a byte-identity pin flakes.
+This rule lifts HVD002's registry pattern to journal events, against
+the `EVENT_SCHEMAS` declaration in journal.py (AST-extracted, never
+imported — model.EventRegistry):
+
+1. Every write site (`<journal-ish>.record("<name>", field=...)` and
+   `<journal-ish>.event("<name>", field=...)`) with a literal event
+   name must name a declared event, pass every required field
+   (suppressed when the call expands `**kwargs` — the analyzer cannot
+   see through it), and pass no undeclared field. `_`-prefixed
+   keywords are write-site plumbing (`_critical`), not fields.
+2. Symmetrically, every consumer key is checked: a comparison of
+   `<var>["type"]` against a string literal (==, !=, in, not in — the
+   membership container may be a local set/tuple/list literal reached
+   through one name hop) must name declared events, and field reads
+   (`v["f"]`, `v.get("f")`) on a variable NARROWED to one or more
+   event types — by an `if v["type"] == "...":` guard, a
+   `ty = v["type"]` alias, a `[e for e in evs if e["type"] == "..."]`
+   comprehension filter, or a `next((e for e in evs if ...), ...)`
+   probe — must name declared fields of the narrowed types (plus the
+   envelope BASE_FIELDS and the loader's `_src`).
+3. A declared event no write site ever emits is dead vocabulary
+   (stale docs, unreachable analyzer legs) — flagged at its
+   declaration, like HVD002's unused-knob leg.
+4. The user_guide's event-schema table (delimited by
+   `hvdlint:event-schema-table` markers and generated from the
+   registry by `journal.event_schema_table_md`) must agree with the
+   declaration both ways: no stale rows, no undocumented events. The
+   doc file is located by convention — the registry module must be
+   named `journal.py` — so fixture registries never scan real docs.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..model import (EventRegistry, Finding, Project, SourceFile,
+                     attr_chain, str_const)
+from . import Rule
+
+# Marker comments delimiting the generated table in the user guide.
+DOC_BEGIN = "<!-- hvdlint:event-schema-table:begin -->"
+DOC_END = "<!-- hvdlint:event-schema-table:end -->"
+
+_EVENT_METHODS = ("record", "event")
+
+
+def _journal_write(call: ast.Call) -> Optional[str]:
+    """Literal event name when `call` is a journal write site; None
+    otherwise (including dynamic event names, which are unverifiable
+    and belong to the record plumbing itself)."""
+    f = call.func
+    if not isinstance(f, ast.Attribute) or f.attr not in _EVENT_METHODS:
+        return None
+    recv = attr_chain(f.value)
+    last = recv.split(".")[-1] if recv else ""
+    # `.record` on anything journal-ish (module alias `_journal`, the
+    # module itself); `.event` additionally on the Journal object
+    # idioms (`self` inside journal.py, the `j = configure(...)`
+    # local). tracing.py's bare `record(...)` and `_tracing.record`
+    # are a different seam and never match.
+    if "journal" not in recv.lower() and not (
+            f.attr == "event" and last in ("j", "self")):
+        return None
+    if not call.args:
+        return None
+    return str_const(call.args[0])
+
+
+def _narrow_from_test(test: ast.AST,
+                      aliases: Dict[str, str]
+                      ) -> Optional[Tuple[str, Set[str]]]:
+    """(varname, {event types}) when `test` positively narrows a
+    variable's event type: `v["type"] == "x"`, `v["type"] in (...)`,
+    or the same through a `ty = v["type"]` alias."""
+    if not (isinstance(test, ast.Compare) and len(test.ops) == 1):
+        return None
+    var = _type_subscript_var(test.left)
+    if var is None and isinstance(test.left, ast.Name):
+        var = aliases.get(test.left.id)
+    if var is None:
+        return None
+    op = test.ops[0]
+    comp = test.comparators[0]
+    if isinstance(op, ast.Eq):
+        s = str_const(comp)
+        return (var, {s}) if s else None
+    if isinstance(op, ast.In):
+        lits = _str_elts(comp)
+        return (var, set(lits)) if lits else None
+    return None
+
+
+def _type_subscript_var(node: ast.AST) -> Optional[str]:
+    """'v' for the expression `v["type"]`."""
+    if (isinstance(node, ast.Subscript)
+            and isinstance(node.value, ast.Name)):
+        key = node.slice
+        if isinstance(key, ast.Index):  # py<3.9 compat trees
+            key = key.value
+        if str_const(key) == "type":
+            return node.value.id
+    return None
+
+
+def _str_elts(node: ast.AST) -> Optional[List[str]]:
+    """String literals of a tuple/list/set display; None when the
+    node is not a display of plain string constants."""
+    elts = getattr(node, "elts", None)
+    if elts is None:
+        return None
+    out = []
+    for e in elts:
+        s = str_const(e)
+        if s is None:
+            return None
+        out.append(s)
+    return out
+
+
+def _comp_filter_types(node: ast.AST,
+                       aliases: Dict[str, str]) -> Optional[Set[str]]:
+    """{event types} a comprehension/generator restricts its element
+    to: `[e for e in evs if e["type"] == "x"]` and the `next((...))`
+    probe around the generator form."""
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id == "next" and node.args):
+        node = node.args[0]
+    if not isinstance(node, (ast.ListComp, ast.GeneratorExp)):
+        return None
+    if len(node.generators) != 1:
+        return None
+    gen = node.generators[0]
+    if not (isinstance(gen.target, ast.Name)
+            and isinstance(node.elt, ast.Name)
+            and node.elt.id == gen.target.id):
+        return None
+    types: Set[str] = set()
+    for test in gen.ifs:
+        nar = _narrow_from_test(test, aliases)
+        if nar is not None and nar[0] == gen.target.id:
+            types |= nar[1]
+    return types or None
+
+
+class EventSchemaRule(Rule):
+    id = "HVD008"
+    summary = ("journal write site or analyzer consumer disagreeing "
+               "with the EVENT_SCHEMAS registry, dead event "
+               "declaration, or event-schema docs drift")
+
+    def run(self, project: Project) -> List[Finding]:
+        reg = project.event_registry
+        if reg is None:
+            return []
+        findings: List[Finding] = []
+        written: Set[str] = set()
+        for sf in project.files:
+            if sf.tree is None:
+                continue
+            self._check_writes(sf, reg, written, findings)
+            self._check_consumers(sf, reg, findings)
+        # ---- declared-but-never-written events ----------------------
+        rf = project.event_registry_file
+        if rf is not None:
+            for decl in reg.events:
+                if decl.name not in written:
+                    findings.append(Finding(
+                        self.id, rf.rel, decl.line, 1,
+                        f"event '{decl.name}' is declared in "
+                        f"EVENT_SCHEMAS but no write site ever emits "
+                        f"it; dead vocabulary misleads the docs and "
+                        f"the analyzers", "<module>"))
+        findings.extend(doc_event_table_findings(project))
+        return findings
+
+    # -- writer side --------------------------------------------------
+
+    def _check_writes(self, sf: SourceFile, reg: EventRegistry,
+                      written: Set[str],
+                      findings: List[Finding]) -> None:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _journal_write(node)
+            if name is None:
+                continue
+            written.add(name)
+            decl = reg.decl(name)
+            ctx = sf.context_of(node)
+            if decl is None:
+                findings.append(Finding(
+                    self.id, sf.rel, node.lineno,
+                    node.col_offset + 1,
+                    f"journal write of undeclared event '{name}'; "
+                    f"add an EventSchema to EVENT_SCHEMAS in "
+                    f"{reg.rel} so analyzers and docs can see it",
+                    ctx))
+                continue
+            has_star = any(kw.arg is None for kw in node.keywords)
+            passed = {kw.arg for kw in node.keywords
+                      if kw.arg and not kw.arg.startswith("_")}
+            unknown = sorted(passed - decl.fields)
+            for f in unknown:
+                findings.append(Finding(
+                    self.id, sf.rel, node.lineno,
+                    node.col_offset + 1,
+                    f"event '{name}' write passes undeclared field "
+                    f"'{f}'; declare it in the EventSchema or fix "
+                    f"the field name", ctx))
+            if not has_star:
+                missing = sorted(set(decl.required) - passed)
+                for f in missing:
+                    findings.append(Finding(
+                        self.id, sf.rel, node.lineno,
+                        node.col_offset + 1,
+                        f"event '{name}' write is missing required "
+                        f"field '{f}'", ctx))
+
+    # -- consumer side ------------------------------------------------
+
+    def _check_consumers(self, sf: SourceFile, reg: EventRegistry,
+                         findings: List[Finding]) -> None:
+        declared = reg.declared
+        # Per-scope pre-pass: `ty = v["type"]` aliases, names bound to
+        # string-display literals (membership containers), and names
+        # bound to type-filtered comprehensions. Keyed by enclosing
+        # function so unrelated scopes never leak into each other.
+        aliases: Dict[str, Dict[str, str]] = {}
+        displays: Dict[str, Dict[str, List[str]]] = {}
+        var_types: Dict[str, Dict[str, Set[str]]] = {}
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            scope = sf.context_of(node)
+            tgt = node.targets[0].id
+            src = _type_subscript_var(node.value)
+            if src is not None:
+                aliases.setdefault(scope, {})[tgt] = src
+                continue
+            lits = _str_elts(node.value)
+            if lits is not None:
+                displays.setdefault(scope, {})[tgt] = lits
+                continue
+            ts = _comp_filter_types(
+                node.value, aliases.get(scope, {}))
+            if ts is not None:
+                var_types.setdefault(scope, {})[tgt] = ts
+
+        # Leg 1: every literal an event-type expression is compared
+        # against must be declared.
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Compare)
+                    and len(node.ops) == 1):
+                continue
+            scope = sf.context_of(node)
+            var = _type_subscript_var(node.left)
+            if var is None and isinstance(node.left, ast.Name):
+                var = aliases.get(scope, {}).get(node.left.id)
+            if var is None:
+                continue
+            comp = node.comparators[0]
+            lits: List[str] = []
+            s = str_const(comp)
+            if s is not None:
+                lits = [s]
+            elif _str_elts(comp) is not None:
+                lits = _str_elts(comp)
+            elif isinstance(comp, ast.Name):
+                lits = displays.get(scope, {}).get(comp.id, [])
+            for lit in lits:
+                if lit not in declared:
+                    findings.append(Finding(
+                        self.id, sf.rel, node.lineno,
+                        node.col_offset + 1,
+                        f"consumer keys on undeclared event "
+                        f"'{lit}'; not in EVENT_SCHEMAS "
+                        f"({reg.rel}) — stale key or typo",
+                        sf.context_of(node)))
+
+        # Leg 2: field reads on narrowed variables.
+        allowed_extra = set(reg.base_fields) | {"_src"}
+
+        def allowed_fields(types: Set[str]) -> Optional[Set[str]]:
+            out = set(allowed_extra)
+            for t in types:
+                decl = reg.decl(t)
+                if decl is None:
+                    return None  # undeclared: already flagged
+                out |= decl.fields
+            return out
+
+        # Walk each scope (module + every function) separately with
+        # its own tables; function/class defs are scope boundaries —
+        # narrowing never crosses them.
+        scopes: List[Tuple[str, List[ast.stmt]]] = []
+        if isinstance(sf.tree, ast.Module):
+            scopes.append(("<module>", sf.tree.body))
+        for fn, q in getattr(sf, "qualname", {}).items():
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append((q, fn.body))
+        for scope, body in scopes:
+            for st in body:
+                self._walk_stmt(sf, st, {},
+                                aliases.get(scope, {}),
+                                var_types.get(scope, {}),
+                                allowed_fields, findings)
+
+    def _walk_stmt(self, sf, st, constraints, aliases, var_types,
+                   allowed_fields, findings) -> None:
+        recurse = lambda body, cons: [  # noqa: E731
+            self._walk_stmt(sf, s, cons, aliases, var_types,
+                            allowed_fields, findings)
+            for s in body]
+        check = lambda node, cons: self._check_exprs(  # noqa: E731
+            sf, node, cons, var_types, allowed_fields, findings)
+        if isinstance(st, ast.If):
+            check(st.test, constraints)
+            nar = _narrow_from_test(st.test, aliases)
+            c2 = dict(constraints)
+            if nar is not None:
+                allowed = allowed_fields(nar[1])
+                if allowed is not None:
+                    c2[nar[0]] = allowed
+            recurse(st.body, c2)
+            recurse(st.orelse, constraints)
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            check(st.iter, constraints)
+            c2 = dict(constraints)
+            if (isinstance(st.iter, ast.Name)
+                    and isinstance(st.target, ast.Name)
+                    and st.iter.id in var_types):
+                allowed = allowed_fields(var_types[st.iter.id])
+                if allowed is not None:
+                    c2[st.target.id] = allowed
+            recurse(st.body, c2)
+            recurse(st.orelse, constraints)
+        elif isinstance(st, (ast.While,)):
+            check(st.test, constraints)
+            recurse(st.body, constraints)
+            recurse(st.orelse, constraints)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                check(item.context_expr, constraints)
+            recurse(st.body, constraints)
+        elif isinstance(st, ast.Try):
+            recurse(st.body, constraints)
+            for h in st.handlers:
+                recurse(h.body, constraints)
+            recurse(st.orelse, constraints)
+            recurse(st.finalbody, constraints)
+        elif isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            pass  # scope boundary: walked in its own iteration
+        else:
+            check(st, constraints)
+
+    def _check_exprs(self, sf, node, constraints, var_types,
+                     allowed_fields, findings) -> None:
+        """Field reads (`v["f"]` loads, `v.get("f")`) on constrained
+        variables anywhere under `node`. Variables bound to a
+        type-filtered comprehension/next() probe constrain their own
+        direct reads and the targets of comprehensions iterating
+        them."""
+        eff = dict(constraints)
+        for v, ts in var_types.items():
+            if v not in eff:
+                allowed = allowed_fields(ts)
+                if allowed is not None:
+                    eff[v] = allowed
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.ListComp, ast.SetComp,
+                                ast.GeneratorExp, ast.DictComp)):
+                for gen in sub.generators:
+                    if (isinstance(gen.iter, ast.Name)
+                            and isinstance(gen.target, ast.Name)
+                            and gen.iter.id in var_types):
+                        allowed = allowed_fields(
+                            var_types[gen.iter.id])
+                        if allowed is not None:
+                            eff[gen.target.id] = allowed
+        if not eff:
+            return
+        for sub in ast.walk(node):
+            var = field = None
+            if (isinstance(sub, ast.Subscript)
+                    and isinstance(sub.ctx, ast.Load)
+                    and isinstance(sub.value, ast.Name)):
+                key = sub.slice
+                if isinstance(key, ast.Index):
+                    key = key.value
+                var, field = sub.value.id, str_const(key)
+            elif (isinstance(sub, ast.Call)
+                  and isinstance(sub.func, ast.Attribute)
+                  and sub.func.attr == "get"
+                  and isinstance(sub.func.value, ast.Name)
+                  and sub.args):
+                var, field = sub.func.value.id, str_const(sub.args[0])
+            if var is None or field is None:
+                continue
+            allowed = eff.get(var)
+            if allowed is not None and field not in allowed:
+                findings.append(Finding(
+                    self.id, sf.rel, sub.lineno, sub.col_offset + 1,
+                    f"consumer reads field '{field}' of a record "
+                    f"narrowed to a declared event that does not "
+                    f"carry it; the read silently yields nothing — "
+                    f"stale field or typo", sf.context_of(sub)))
+
+
+def doc_event_table_findings(project: Project) -> List[Finding]:
+    """Leg 4: the user_guide's marker-delimited event-schema table vs
+    the registry, both directions."""
+    reg = project.event_registry
+    rf = project.event_registry_file
+    if reg is None or rf is None:
+        return []
+    if os.path.basename(rf.path) != "journal.py":
+        return []  # fixture/synthetic registries: no docs convention
+    root = os.path.dirname(os.path.dirname(os.path.abspath(rf.path)))
+    doc_path = os.path.join(root, "docs", "user_guide.md")
+    if not os.path.isfile(doc_path):
+        return []
+    pkg_rel_root = os.path.dirname(os.path.dirname(rf.rel))
+    doc_rel = "/".join(p for p in (pkg_rel_root, "docs",
+                                   "user_guide.md") if p)
+    try:
+        with open(doc_path, "r", encoding="utf-8",
+                  errors="replace") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return []
+    findings: List[Finding] = []
+    begin = end = None
+    for i, line in enumerate(lines, start=1):
+        if DOC_BEGIN in line and begin is None:
+            begin = i
+        elif DOC_END in line and begin is not None and end is None:
+            end = i
+    if begin is None or end is None:
+        findings.append(Finding(
+            "HVD008", doc_rel, 1, 1,
+            f"user_guide has no '{DOC_BEGIN}' / '{DOC_END}' "
+            f"event-schema table (generate it with "
+            f"journal.event_schema_table_md); the journal event "
+            f"vocabulary in {reg.rel} is undocumented",
+            "<event-table>"))
+        return findings
+    documented: Dict[str, int] = {}
+    for lineno in range(begin + 1, end):
+        line = lines[lineno - 1]
+        if not line.lstrip().startswith("|"):
+            continue
+        cells = [c.strip() for c in line.split("|")]
+        if len(cells) < 3:
+            continue
+        for name in re.findall(r"`([a-z][a-z0-9_]*)`", cells[1]):
+            documented.setdefault(name, lineno)
+    declared = reg.declared
+    for name in sorted(documented):
+        if name not in declared:
+            findings.append(Finding(
+                "HVD008", doc_rel, documented[name], 1,
+                f"user_guide event-schema table row names '{name}', "
+                f"which is not declared in {reg.rel} — a stale row "
+                f"still teaching users a renamed or removed event",
+                "<event-table>"))
+    for name in sorted(declared - set(documented)):
+        findings.append(Finding(
+            "HVD008", doc_rel, begin, 1,
+            f"event '{name}' declared in {reg.rel} is missing from "
+            f"the user_guide event-schema table — regenerate it "
+            f"with journal.event_schema_table_md",
+            "<event-table>"))
+    return findings

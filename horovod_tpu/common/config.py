@@ -269,6 +269,14 @@ KNOBS: List[Knob] = [
          "to a single .1 sibling (the offline analyzer reads both), "
          "bounding an unattended soak at two segments per process. "
          "0 disables rotation."),
+    Knob("HOROVOD_JOURNAL_STRICT", _parse_bool, False,
+         "Validate every journaled event against the declared "
+         "journal.EVENT_SCHEMAS registry at write time and warn "
+         "(once per event type, never raise) on an undeclared event, "
+         "a missing required field, or an undeclared field. Off by "
+         "default: the same contract is enforced statically by "
+         "hvdlint HVD008; this runtime leg exists for soaks and "
+         "chaos runs exercising code paths lint cannot see."),
     # -- autotune ------------------------------------------------------------
     Knob("HOROVOD_AUTOTUNE", _parse_bool, False,
          "Enable online autotuning of fusion threshold and cycle time."),
@@ -719,6 +727,7 @@ class Config:
         "journal_dir": "HOROVOD_JOURNAL_DIR",
         "journal_fsync": "HOROVOD_JOURNAL_FSYNC",
         "journal_rotate_mb": "HOROVOD_JOURNAL_ROTATE_MB",
+        "journal_strict": "HOROVOD_JOURNAL_STRICT",
         "autotune": "HOROVOD_AUTOTUNE",
         "autotune_log": "HOROVOD_AUTOTUNE_LOG",
         "autotune_mode": "HOROVOD_AUTOTUNE_MODE",

@@ -56,6 +56,7 @@ of overhead test (tests/test_journal.py).
 
 from __future__ import annotations
 
+import dataclasses
 import glob as _glob
 import json
 import os
@@ -89,44 +90,415 @@ _m_events = _METRICS.counter(
     "hvd_journal_events_total",
     "Lifecycle events appended to this process's journal.")
 
+# Envelope fields Journal.event() stamps on EVERY record; writers
+# never pass them and schemas never declare them.
+BASE_FIELDS = frozenset({"type", "role", "rank", "pid", "mono_ns",
+                         "t", "n"})
+
+
+@dataclasses.dataclass(frozen=True)
+class EventSchema:
+    """One declared journal event type: the typed vocabulary contract
+    between every writer (`journal.record("<name>", field=...)`) and
+    every offline consumer (`doctor incident` / `doctor serve` /
+    serving_trace.py). hvdlint rule HVD008 checks both sides of the
+    contract against this registry — the journal-event analog of
+    config.py's Knob registry."""
+
+    name: str
+    writer: str                       # driver | worker | serving | any
+    doc: str
+    required: Tuple[str, ...] = ()
+    optional: Tuple[str, ...] = ()
+    # True: fsync'd unconditionally (the last thing a dying process
+    # says, or a phase edge the MTTR decomposition is built from);
+    # False: batched under HOROVOD_JOURNAL_FSYNC (hot-path volume).
+    critical: bool = False
+
+
+# The declared journal-event vocabulary. One EventSchema per type,
+# with its required/optional field sets and fsync criticality. This
+# list is the single source of truth three ways:
+#   * runtime: CRITICAL_EVENTS and the HOROVOD_JOURNAL_STRICT
+#     validation derive from it;
+#   * static analysis: hvdlint HVD008 AST-extracts it (never imports
+#     this module) and checks every record site and consumer key
+#     repo-wide against it;
+#   * docs: the user_guide event-schema table is generated from it
+#     (event_schema_table_md), so a new event that skips the registry
+#     fails lint instead of silently missing the docs.
+# Keep entries statically declarative — literal names and literal
+# field tuples — or the AST extraction (and therefore HVD008's
+# whole-repo check) cannot see them.
+EVENT_SCHEMAS: List[EventSchema] = [
+    # -- journal plumbing (every process) -----------------------------
+    EventSchema(
+        "journal_meta", "any",
+        "Segment header: schema id, monotonic/wall anchors, host, "
+        "elastic epoch, the armed fault spec + seed. Critical via "
+        "the write-site flag (first line of every segment).",
+        required=("schema", "anchor_mono_ns", "anchor_unix", "host",
+                  "epoch", "faults", "faults_seed"),
+        optional=("slice",)),
+    EventSchema(
+        "init_done", "worker",
+        "Worker joined a world: elastic epoch, world size, local "
+        "rank.",
+        required=("epoch", "world_size", "local_rank")),
+    EventSchema(
+        "clock_sync", "worker",
+        "PR-5 calibrated offset to rank 0 persisted for the offline "
+        "merge's cross-host clock alignment.",
+        required=("offset_ns", "rtt_ns")),
+    # -- elastic worker lifecycle -------------------------------------
+    EventSchema(
+        "assignment", "worker",
+        "Elastic rank reassignment accepted by a live worker.",
+        required=("new_rank", "size", "epoch")),
+    EventSchema(
+        "reinit_begin", "worker",
+        "Worker entering re-initialization for a new epoch.",
+        required=("epoch",)),
+    EventSchema(
+        "restore", "worker",
+        "In-process state restore ran (HorovodInternalError path).",
+        required=("step",), critical=True),
+    EventSchema(
+        "sync_done", "worker",
+        "state.sync() finished: the restore phase edge, with the "
+        "step resumed at.",
+        required=("step", "epoch"), critical=True),
+    EventSchema(
+        "snapshot_loaded", "worker",
+        "Durable snapshot loaded on (re)start, with its step.",
+        required=("step",), critical=True),
+    EventSchema(
+        "commit", "worker",
+        "One elastic commit; `durable` marks commits that issued the "
+        "snapshot write — the watermark a restarted gang is held to.",
+        required=("epoch",), optional=("durable", "step"),
+        critical=True),
+    EventSchema(
+        "first_commit", "worker",
+        "First post-recovery commit — closes the MTTR decomposition.",
+        required=("seconds", "epoch"), optional=("durable", "step"),
+        critical=True),
+    EventSchema(
+        "compression_commit", "worker",
+        "Error-feedback residual state committed alongside an "
+        "elastic commit (norm + leaf count for drift audits).",
+        required=("step", "residual_leaves", "residual_norm")),
+    EventSchema(
+        "watermark", "worker",
+        "Measured loss check: journal watermark vs resumed step "
+        "(feeds hvd_committed_step_loss_total).",
+        required=("watermark", "resumed", "loss"), critical=True),
+    EventSchema(
+        "hosts_updated", "worker",
+        "Membership-change notification observed at a commit "
+        "boundary.",
+        required=("epoch", "step")),
+    EventSchema(
+        "internal_error", "worker",
+        "HorovodInternalError at the elastic boundary.",
+        required=("error", "step"), critical=True),
+    EventSchema(
+        "numerics_escalation", "worker",
+        "Skip-step escalation: consecutive non-finite steps hit the "
+        "configured limit.",
+        required=("skips", "limit"), critical=True),
+    EventSchema(
+        "replica_divergence", "worker",
+        "SDC sentinel verdict: parameter digests diverged across "
+        "replicas.",
+        required=("divergent_ranks",), optional=("non_restorable",),
+        critical=True),
+    # -- chaos / flight recorder (any role) ---------------------------
+    EventSchema(
+        "fault_fired", "any",
+        "A chaos-seam firing (point, action, hit count) — fsync'd "
+        "BEFORE the action applies, so even a `crash` names its own "
+        "cause.",
+        required=("point", "action", "hit"), optional=("tag",),
+        critical=True),
+    EventSchema(
+        "postmortem_written", "any",
+        "This process dumped its own flight recorder (SIGUSR2, "
+        "internal error, or teardown).",
+        required=("file", "reason", "trigger", "step"),
+        critical=True),
+    # -- elastic driver -----------------------------------------------
+    EventSchema(
+        "driver_start", "driver",
+        "Driver booted: command line and the elastic np window.",
+        required=("command", "min_np", "max_np")),
+    EventSchema(
+        "spawn", "driver",
+        "One worker slot (re)spawned: rank, host, child pid.",
+        required=("exit_rank", "host", "child_pid")),
+    EventSchema(
+        "epoch_published", "driver",
+        "Membership epoch published: size and rank→host assignments "
+        "(and slice map on multi-slice pods).",
+        required=("epoch", "size", "hosts"), optional=("slices",),
+        critical=True),
+    EventSchema(
+        "respawn_done", "driver",
+        "Every slot of the new epoch spawned.",
+        required=("epoch", "ranks"), critical=True),
+    EventSchema(
+        "worker_exit", "driver",
+        "A worker process exited, with its code.",
+        required=("exit_rank", "host", "code"), critical=True),
+    EventSchema(
+        "hung_worker", "driver",
+        "Stale-heartbeat verdict: the liveness detector shot a "
+        "worker (age vs timeout).",
+        required=("exit_rank", "host", "age_s", "timeout_s"),
+        critical=True),
+    EventSchema(
+        "detect", "driver",
+        "Failure classification (crash / hung / preempt) that opens "
+        "a recovery — one per bad rank.",
+        required=("cause", "exit_rank", "host", "code", "age_s",
+                  "reset"),
+        optional=("slice",), critical=True),
+    EventSchema(
+        "gang_restart_begin", "driver",
+        "Teardown phase opened for a gang restart.",
+        required=("reset", "epoch"), critical=True),
+    EventSchema(
+        "teardown_done", "driver",
+        "Gang dead: the teardown phase edge.",
+        required=("reset",), critical=True),
+    EventSchema(
+        "blacklist", "driver",
+        "Host blacklisted, with the escalated window and failure "
+        "count (and its slice, when it has one).",
+        required=("host", "window_s", "failures"),
+        optional=("slice",), critical=True),
+    EventSchema(
+        "slice_lost", "driver",
+        "Whole-slice eviction: member hosts, cause, window, failure "
+        "count — the slice-atomicity ledger.",
+        required=("slice", "hosts", "cause", "window_s", "failures"),
+        critical=True),
+    EventSchema(
+        "slice_admitted", "driver",
+        "Whole-slice (re-)admission with member hosts and slots.",
+        required=("slice", "hosts", "slots"), critical=True),
+    EventSchema(
+        "host_preempt", "driver",
+        "The host.preempt seam's SIGTERM storm against one host "
+        "(ranks hit, grace); anchors the following detect's t_fail.",
+        required=("host", "ranks", "grace_s"), optional=("slice",),
+        critical=True),
+    EventSchema(
+        "postmortem", "driver",
+        "A dead worker's flight-recorder dump linked as a "
+        "first-class event (rank, file, reason, step).",
+        required=("exit_rank", "code", "file", "reason", "step",
+                  "trigger", "in_flight"),
+        critical=True),
+    EventSchema(
+        "task_exit", "driver",
+        "Per-host task service observed a local worker exit.",
+        required=("exit_rank", "code", "host")),
+    EventSchema(
+        "job_done", "driver",
+        "Job finished with this exit code.",
+        required=("code",), critical=True),
+    EventSchema(
+        "wire_reject", "any",
+        "Control-plane service rejected an unauthenticated or "
+        "malformed peer frame.",
+        required=("service", "peer", "error")),
+    # -- serving batch plane (rounds 15-16) ---------------------------
+    EventSchema(
+        "serving_meta", "serving",
+        "Serving frontend's one-shot config record: ladder digest, "
+        "batch/budget/SLO knobs, trace tag, weights dir — what "
+        "`doctor serve` keys a leg's identity on.",
+        required=("ladder", "max_batch", "budget_ms", "trace",
+                  "default_slo_ms", "tag"),
+        # optional, not required: r16 artifacts predate the live
+        # weight pipeline and must keep validating unchanged.
+        optional=("weights",), critical=True),
+    EventSchema(
+        "batch_admitted", "serving",
+        "One batch cut from the admission queue (hot-path volume; "
+        "batched fsync).",
+        required=("batch", "size", "bucket", "bucket_len",
+                  "queue_depth", "wait_ms")),
+    EventSchema(
+        "batch_trace", "serving",
+        "Per-batch phase stamps + per-request submit/done arrays — "
+        "the raw material of `doctor serve`'s phase decomposition "
+        "(hot-path volume; batched fsync).",
+        required=("batch", "worker", "attempt", "bucket", "size",
+                  "requests", "slo", "deadline_hit", "submit_ns",
+                  "done_ns", "admit_ns", "claim_ns", "exec0_ns",
+                  "exec1_ns", "unpad_ns", "hops"),
+        # optional, not required: r16 artifacts predate the live
+        # weight pipeline and must keep validating unchanged.
+        optional=("weights",)),
+    EventSchema(
+        "batch_retried", "serving",
+        "A batch re-dispatched after a worker death, with the hop's "
+        "cause and attempt.",
+        required=("batch", "attempt", "cause", "worker", "pending"),
+        critical=True),
+    EventSchema(
+        "batch_failed", "serving",
+        "Retry budget exhausted: the batch failed visibly, with its "
+        "lost requests and hop history.",
+        required=("batch", "attempts", "cause", "worker", "lost",
+                  "slo", "hops"),
+        critical=True),
+    EventSchema(
+        "scale_event", "serving",
+        "Worker pool resize (autoscale or worker death), with queue "
+        "depth and reason.",
+        required=("direction", "workers_from", "workers_to",
+                  "queue_depth", "reason"),
+        optional=("worker", "epoch"), critical=True),
+    # -- live weight pipeline (round 17) ------------------------------
+    EventSchema(
+        "weights_published", "any",
+        "A weight version published to the pull plane (kind: "
+        "publish / rollback / repair).",
+        required=("digest", "seq", "step", "kind", "ms"),
+        critical=True),
+    EventSchema(
+        "weights_adopted", "serving",
+        "A serving worker hot-swapped to a published version, with "
+        "swap latency and staleness.",
+        required=("worker", "digest", "seq", "step", "ms",
+                  "staleness_steps"),
+        critical=True),
+    EventSchema(
+        "weights_rejected", "serving",
+        "A serving worker refused a version (digest mismatch, torn "
+        "snapshot, rollback fence), naming what it kept serving.",
+        required=("worker", "digest", "seq", "reason", "detail",
+                  "serving"),
+        critical=True),
+    # -- continuous-batching decode plane (round 18) ------------------
+    EventSchema(
+        "decode_meta", "serving",
+        "Decode frontend's one-shot config record: slot count, "
+        "watermark stride, SLO/lane/retry knobs, KV ladder digest.",
+        required=("slots", "watermark_stride", "interactive_slo_ms",
+                  "lane_budget", "retry_limit", "kv_ladder",
+                  "workers"),
+        critical=True),
+    EventSchema(
+        "seq_admitted", "serving",
+        "One sequence admitted to a decode slot (token-path volume; "
+        "batched fsync).",
+        required=("sid", "worker", "lane", "slo", "prompt_len",
+                  "max_new", "queue_wait_ms")),
+    EventSchema(
+        "seq_watermark", "serving",
+        "Durable KV watermark advanced for one sequence (per-stride "
+        "volume; batched fsync — recovery value is bounded by the "
+        "stride).",
+        required=("sid", "worker", "token", "lane")),
+    EventSchema(
+        "seq_resumed", "serving",
+        "A sequence re-admitted after a worker death, resuming from "
+        "the journaled KV watermark — the exactly-once edge MTTR "
+        "attribution keys on.",
+        required=("sid", "worker", "lane", "from_token", "watermark",
+                  "cause", "attempt"),
+        critical=True),
+    EventSchema(
+        "seq_shed", "serving",
+        "A batch-lane sequence shed under pool shrinkage, at its "
+        "token frontier.",
+        required=("sid", "worker", "lane", "at_token", "sheds"),
+        critical=True),
+    EventSchema(
+        "seq_done", "serving",
+        "Sequence lifecycle terminal with outcome, token counts and "
+        "the submit/admit/first/done stamps `doctor serve`'s decode "
+        "lanes decompose (token-path volume; batched fsync).",
+        required=("sid", "outcome", "lane", "slo", "tokens",
+                  "prompt_len", "worker", "resumes", "sheds",
+                  "deadline_hit", "submit_ns", "admit_ns", "first_ns",
+                  "done_ns")),
+    EventSchema(
+        "seq_failed", "serving",
+        "Retry budget exhausted for one sequence: failed visibly at "
+        "its token frontier.",
+        required=("sid", "worker", "cause", "resumes", "at_token"),
+        critical=True),
+]
+
+SCHEMA_BY_NAME: Dict[str, EventSchema] = {
+    s.name: s for s in EVENT_SCHEMAS}
+EVENT_NAMES = frozenset(SCHEMA_BY_NAME)
+
 # Events that must hit the disk even when HOROVOD_JOURNAL_FSYNC
-# batches: they are the last thing a dying process says (fault_fired
-# precedes os._exit; internal_error precedes teardown) or the phase
-# edges the MTTR decomposition is built from.
-CRITICAL_EVENTS = frozenset({
-    "fault_fired", "internal_error", "detect", "worker_exit",
-    "hung_worker", "gang_restart_begin", "teardown_done",
-    "epoch_published", "respawn_done", "commit", "restore",
-    "snapshot_loaded", "sync_done", "watermark", "first_commit",
-    "numerics_escalation", "replica_divergence", "postmortem",
-    "postmortem_written", "blacklist", "job_done",
-    "slice_lost", "slice_admitted", "host_preempt",
-    # Serving (round 15): retries and pool resizes are rare,
-    # incident-grade edges (batch_admitted stays batched — it is
-    # per-batch hot-path volume).
-    "batch_retried", "scale_event",
-    # Serving trace (round 16): the frontend's one-shot config record
-    # and the retry-budget-exhausted terminal are rare and what
-    # `doctor serve` keys legs and failure accounting on; the
-    # per-batch `batch_trace` phase record stays batched like
-    # batch_admitted.
-    "serving_meta", "batch_failed",
-    # Live weight pipeline (round 17): publish / adopt / reject are
-    # the rare, incident-grade edges of a rolling model update — the
-    # rejected digest and the rolled-back-to digest are what the
-    # post-mortem of a bad push keys on.
-    "weights_published", "weights_adopted", "weights_rejected",
-    # Continuous-batching decode (round 18): the one-shot config
-    # record, a sequence's re-admission after a worker death (the
-    # watermark-resume edge MTTR attribution keys on), a batch-lane
-    # shed under pool shrinkage, and the retry-budget-exhausted
-    # terminal are all rare, incident-grade edges. The per-sequence
-    # seq_admitted / seq_done lifecycle records and the per-stride
-    # seq_watermark records stay batched — they are token-path
-    # volume, and the watermark's recovery value is already bounded
-    # by its stride.
-    "decode_meta", "seq_resumed", "seq_shed", "seq_failed",
-})
+# batches — derived from the registry's criticality bit (the
+# historical literal set is pinned by tests/test_journal.py).
+CRITICAL_EVENTS = frozenset(
+    s.name for s in EVENT_SCHEMAS if s.critical)
+
+
+def schema_problems(type_: str,
+                    fields: Dict[str, Any]) -> List[str]:
+    """Deviations of one (type, fields) write from the declared
+    registry; empty when conformant. Never raises — this backs the
+    HOROVOD_JOURNAL_STRICT warning path and the artifact-validation
+    tests, not a hard gate."""
+    schema = SCHEMA_BY_NAME.get(type_)
+    if schema is None:
+        return [f"undeclared event type '{type_}' (add an "
+                f"EventSchema to journal.EVENT_SCHEMAS)"]
+    out = []
+    names = set(fields)
+    missing = sorted(set(schema.required) - names)
+    if missing:
+        out.append(f"event '{type_}' missing required field(s) "
+                   f"{missing}")
+    unknown = sorted(names - set(schema.required)
+                     - set(schema.optional) - BASE_FIELDS)
+    if unknown:
+        out.append(f"event '{type_}' carries undeclared field(s) "
+                   f"{unknown}")
+    return out
+
+
+def validate_event(rec: Dict[str, Any]) -> List[str]:
+    """schema_problems for a PARSED journal record: the envelope
+    fields Journal.event stamped (and the loader's `_src`) are
+    stripped before checking."""
+    type_ = str(rec.get("type", ""))
+    fields = {k: v for k, v in rec.items()
+              if k not in BASE_FIELDS and k != "_src"}
+    return schema_problems(type_, fields)
+
+
+def event_schema_table_md() -> str:
+    """The user_guide's event-schema table, generated from
+    EVENT_SCHEMAS so docs cannot drift from the registry (hvdlint
+    HVD008 checks the committed table against this rendering)."""
+    lines = [
+        "| Event | Writer | Fields (`*` = optional) | Meaning |",
+        "|---|---|---|---|",
+    ]
+    for s in EVENT_SCHEMAS:
+        flds = ", ".join(
+            [f"`{f}`" for f in s.required]
+            + [f"`{f}`*" for f in s.optional]) or "—"
+        name = f"`{s.name}`" + (" †" if s.critical else "")
+        lines.append(f"| {name} | {s.writer} | {flds} | {s.doc} |")
+    lines.append("")
+    lines.append("† fsync'd unconditionally (CRITICAL_EVENTS); "
+                 "unmarked events batch under "
+                 "`HOROVOD_JOURNAL_FSYNC`.")
+    return "\n".join(lines)
 
 
 class Journal:
@@ -143,12 +515,15 @@ class Journal:
     degrades observability, not training."""
 
     def __init__(self, path: str, role: str, rank: int = -1,
-                 fsync_every: int = 1, rotate_bytes: int = 0):
+                 fsync_every: int = 1, rotate_bytes: int = 0,
+                 strict: bool = False):
         self.path = path
         self.role = role
         self.rank = int(rank)
         self._fsync_every = max(1, int(fsync_every))
         self._rotate_bytes = int(rotate_bytes)
+        self._strict = bool(strict)
+        self._schema_warned: set = set()
         self._lock = threading.Lock()
         self._n = 0
         self._since_sync = 0
@@ -186,6 +561,14 @@ class Journal:
 
     def event(self, type_: str, _critical: bool = False,
               **fields: Any) -> None:
+        if self._strict and type_ not in self._schema_warned:
+            # Warn-once per event type, never raise: schema drift
+            # degrades observability, it must not kill training.
+            problems = schema_problems(type_, fields)
+            if problems:
+                self._schema_warned.add(type_)
+                hlog.warning("journal: HOROVOD_JOURNAL_STRICT: %s",
+                             "; ".join(problems))
         mono, unix = self._now()
         rec: Dict[str, Any] = dict(fields)
         rec.update({
@@ -302,7 +685,9 @@ def configure(role: str, rank: int = -1,
             fsync_every=_config.env_value("HOROVOD_JOURNAL_FSYNC",
                                           env=env),
             rotate_bytes=_config.env_value("HOROVOD_JOURNAL_ROTATE_MB",
-                                           env=env) * (1 << 20))
+                                           env=env) * (1 << 20),
+            strict=_config.env_value("HOROVOD_JOURNAL_STRICT",
+                                     env=env))
     except OSError as e:
         hlog.warning("journal: cannot open %s (%s); lifecycle "
                      "journal disabled for this process", path, e)
@@ -806,6 +1191,20 @@ def _timeline_entries(events: List[dict], t0: float) -> List[list]:
                                "rank", "pid", "_src")}
         out.append([_rel(float(e["t"]), t0), who, e["type"], detail])
     return out
+
+
+# Functions whose OUTPUT BYTES are pinned by committed artifacts:
+# identical journal bytes must always produce identical report bytes.
+# hvdlint HVD009 seeds its call-graph reachability from these names
+# and flags any nondeterminism source (wall clock, unseeded random,
+# set-order iteration, unsorted directory walks, json without
+# sort_keys) on a reachable path.
+DETERMINISTIC_ENTRYPOINTS = (
+    "incident_report",
+    "write_incident_report",
+    "render_incident_report",
+    "journal_digest",
+)
 
 
 def incident_report(dir_: str) -> Dict[str, Any]:

@@ -348,6 +348,11 @@ def latest_xplane(trace_dir: str) -> Optional[str]:
     return max(pbs) if pbs else None
 
 
+# Byte-identity-pinned analyzer surface: hvdlint HVD009 seeds its
+# reachability check from these names (see journal.py's twin).
+DETERMINISTIC_ENTRYPOINTS = ("digest_trace",)
+
+
 def digest_trace(trace_dir_or_pb: str, top: int = 5) -> Dict[str, Any]:
     """Digest of a capture: accepts the trace dir bench.py wrote or a
     direct .xplane.pb path. Raises FileNotFoundError when no capture

@@ -4,28 +4,39 @@
 # lock discipline, trace purity, collective-protocol consistency,
 # lockset races) + the hvdlint SEMANTIC tier (HVD007: the traced
 # step builders' collective invariants, source-hash cached) + the
-# native core's -Werror compile check (plus a -Wthread-safety leg
-# when clang is available) + the wire-parser fuzzer under
-# ASan/UBSan when the toolchain supports it. Exit nonzero on any
-# finding — this is the CI entry point; tests/test_lint.py runs the
-# hvdlint halves in-process as part of tier-1.
+# hvdlint ARTIFACT-PLANE tiers (HVD008: every journal.record site
+# and doctor/serving consumer vs the declared journal.EVENT_SCHEMAS
+# registry incl. the generated user_guide table; HVD009:
+# nondeterminism sources reachable from the byte-pinned report
+# entry points) + the native core's -Werror compile check (plus a
+# -Wthread-safety leg when clang is available) + the wire-parser
+# fuzzer under ASan/UBSan when the toolchain supports it. Exit
+# nonzero on any finding — this is the CI entry point;
+# tests/test_lint.py runs the hvdlint halves in-process as part of
+# tier-1.
 #
 # Legs that cannot run on a given host (no ruff, no clang, no
 # sanitizer runtime) SKIP GRACEFULLY but never silently: each prints
 # a "SKIPPED-LEG:" line and the final verdict enumerates every
 # skipped leg, so a green run on a thin container is visibly NOT the
 # full gate. The full gate is ruff + hvdlint(AST) + hvdlint(jaxpr) +
-# cc -Werror + clang -Wthread-safety + fuzz_wire(ASan/UBSan); CI
-# hosts are expected to run all six (docs/user_guide.md "Static
-# analysis" records the expected-legs contract).
+# hvdlint(event-schema) + hvdlint(determinism) + cc -Werror +
+# clang -Wthread-safety + fuzz_wire(ASan/UBSan); CI hosts are
+# expected to run all eight (docs/user_guide.md "Static analysis"
+# records the expected-legs contract).
 #
 # Pre-commit fast path: `scripts/lint.sh --changed-only [REF]` makes
 # hvdlint analyze only the files touched since REF (default HEAD)
-# plus their call-graph neighbors, and runs the jaxpr tier only when
+# plus their call-graph neighbors, runs the jaxpr tier only when
 # the focus set touches the semantic surface (parallel/,
 # ops/bucketing.py, numerics.py, serving.py, serving_trace.py,
-# decoding.py, weights.py, analysis/). CI runs the full pass
-# (no args).
+# decoding.py, weights.py, analysis/), and gates the event-schema
+# leg the same way on the journal-writing surface (journal.py, the
+# elastic/runner/serving/decode/weights writers, the analyzers, and
+# the generated user_guide event table). The event-schema and
+# determinism legs are whole-program rules (never-written events,
+# call-graph reachability), so when gated in they run over the full
+# tree rather than the focus set. CI runs the full pass (no args).
 set -u
 cd "$(dirname "$0")/.."
 
@@ -63,6 +74,7 @@ fi
 echo "== hvdlint (AST tiers) =="
 JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
     python -m horovod_tpu.analysis horovod_tpu/ \
+    --select HVD001,HVD002,HVD003,HVD004,HVD005,HVD006 \
     ${HVDLINT_ARGS[@]+"${HVDLINT_ARGS[@]}"} || rc=1
 
 # Semantic tier: traces the real step builders (HVD007). In
@@ -90,6 +102,40 @@ if [ "$run_jaxpr" = "1" ]; then
     fi
     JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" "${JAXPR_CMD[@]}" || rc=1
 fi
+
+# Event-schema tier (HVD008): whole-vocabulary rule — the
+# declared-but-never-written check needs every writer in view, so a
+# gated-in run always covers the full tree. In --changed-only mode
+# it runs only when the journal-writing surface (or the generated
+# docs table it is held in lockstep with) changed.
+run_events=1
+if [ "$CHANGED_ONLY" = "1" ]; then
+    changed=$( { git diff --name-only "$CHANGED_REF" -- 2>/dev/null;
+                 git ls-files --others --exclude-standard 2>/dev/null; } \
+               | sort -u )
+    if ! printf '%s\n' "$changed" | grep -qE \
+        '^(horovod_tpu/(journal\.py|serving_trace\.py|serving\.py|decoding\.py|weights\.py|faults\.py|numerics\.py|tracing\.py|elastic/|runner/|analysis/|common/config\.py)|docs/user_guide\.md)'
+    then
+        run_events=0
+        echo "== hvdlint (event-schema tier): skipped (no journal-surface files changed) =="
+    fi
+fi
+if [ "$run_events" = "1" ]; then
+    echo "== hvdlint (event-schema tier, HVD008) =="
+    JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+        python -m horovod_tpu.analysis horovod_tpu/ --select HVD008 \
+        || rc=1
+fi
+
+# Byte-determinism tier (HVD009): also whole-program (call-graph
+# reachability from DETERMINISTIC_ENTRYPOINTS), and cheap on the
+# content-hash-cached index — it runs unconditionally so a
+# pre-commit pass can never miss a helper three calls under a
+# byte-pinned report.
+echo "== hvdlint (determinism tier, HVD009) =="
+JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+    python -m horovod_tpu.analysis horovod_tpu/ bench.py \
+    --select HVD009 || rc=1
 
 echo "== cc check (-Wall -Wextra -Werror) =="
 if command -v "${CXX:-g++}" >/dev/null 2>&1; then

@@ -1,0 +1,147 @@
+"""HVD008 fixture: seeded event-schema positives/negatives.
+
+Declares its own miniature EVENT_SCHEMAS registry — the analyzer
+adopts the first declaring file in the scanned set, so the corpus is
+self-contained and never reads the real journal.py (and, because this
+file is not named journal.py, the docs-drift leg stays off). The
+legacy hvd004_* fixtures write four real event names
+(commit / seq_watermark / batch_admitted / weights_adopted) with
+partial fields; the
+registry declares relaxed shims for those so the HVD004 corpus stays
+HVD008-clean.
+"""
+
+import dataclasses
+from typing import List, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class EventSchema:
+    name: str
+    writer: str
+    doc: str
+    required: Tuple[str, ...] = ()
+    optional: Tuple[str, ...] = ()
+    critical: bool = False
+
+
+BASE_FIELDS = frozenset({"type", "role", "rank", "pid", "mono_ns",
+                         "t", "n"})
+
+EVENT_SCHEMAS: List[EventSchema] = [
+    EventSchema("fx_commit", "worker", "Fixture commit edge.",
+                required=("epoch",), optional=("durable",),
+                critical=True),
+    EventSchema("fx_probe", "serving", "Fixture probe record.",
+                required=("batch", "cause")),
+    EventSchema("fx_dead", "driver", "Never written anywhere."),  # EXPECT: HVD008
+    # Relaxed shims for the legacy hvd004_* fixtures' write sites —
+    # those files exercise trace purity, not schemas.
+    EventSchema("commit", "worker", "Legacy shim.",
+                optional=("step",)),
+    EventSchema("seq_watermark", "serving", "Legacy shim.",
+                optional=("sid", "token")),
+    EventSchema("batch_admitted", "serving", "Legacy shim.",
+                optional=("batch",)),
+    EventSchema("weights_adopted", "worker", "Legacy shim.",
+                optional=("digest",)),
+]
+
+
+class _Journal:
+    def record(self, type_, **fields):
+        return type_, fields
+
+
+journal = _Journal()
+
+
+# -- writer side -----------------------------------------------------------
+
+
+def conformant_write():
+    journal.record("fx_commit", epoch=3, durable=True)
+
+
+def undeclared_event():
+    journal.record("fx_ghost", epoch=1)  # EXPECT: HVD008
+
+
+def missing_required_field():
+    journal.record("fx_probe", batch=7)  # EXPECT: HVD008
+
+
+def undeclared_field():
+    journal.record("fx_probe", batch=7, cause="x", causee="y")  # EXPECT: HVD008
+
+
+def star_kwargs_suppress_missing_check(fields):
+    # the analyzer cannot see through **expansion: required-field
+    # enforcement is the runtime strict mode's job here
+    journal.record("fx_probe", **fields)
+
+
+def dynamic_name_is_unverifiable(name):
+    journal.record(name, batch=1)
+
+
+def underscore_kwargs_are_plumbing():
+    journal.record("fx_commit", epoch=1, _critical=True)
+
+
+def suppressed_write():
+    # hvdlint: disable-next=HVD008 (fixture: exercising suppression)
+    journal.record("fx_ghost2", x=1)
+
+
+def non_journal_receivers_do_not_match(tuner):
+    # a .record() on a non-journal receiver is a different seam
+    tuner.record("fx_ghost3", sample=1)
+
+
+# -- consumer side ---------------------------------------------------------
+
+
+def consumer_guard_and_fields_ok(events):
+    for e in events:
+        if e["type"] == "fx_commit":
+            yield e["epoch"], e.get("durable"), e["rank"], e.get("_src")
+
+
+def consumer_stale_type_key(events):
+    return [e for e in events if e["type"] == "fx_removed"]  # EXPECT: HVD008
+
+
+def consumer_alias_misspelled_field(events):
+    for e in events:
+        ty = e["type"]
+        if ty == "fx_probe":
+            yield e["batch"], e.get("caus")  # EXPECT: HVD008
+
+
+def consumer_membership_with_zombie(events):
+    keep = ("fx_commit", "fx_zombie")
+    return [e for e in events if e["type"] in keep]  # EXPECT: HVD008
+
+
+def consumer_comp_filter_misspelled_field(events):
+    probes = [e for e in events if e["type"] == "fx_probe"]
+    return [(p["batch"], p["causey"]) for p in probes]  # EXPECT: HVD008
+
+
+def consumer_next_probe_misspelled_field(events):
+    meta = next((e for e in events if e["type"] == "fx_commit"), {})
+    return meta.get("epoch"), meta.get("epochh")  # EXPECT: HVD008
+
+
+def consumer_unconstrained_reads_are_fine(events):
+    # no narrowing: a generic walk may read anything
+    return [e.get("whatever") for e in events]
+
+
+def consumer_else_branch_is_unconstrained(events):
+    for e in events:
+        if e["type"] == "fx_commit":
+            yield e["epoch"]
+        else:
+            yield e.get("anything_at_all")

@@ -9,6 +9,13 @@ AST tier (run_analysis; EXPECT-anchored):
     serialized every contender behind process startup (HVD003).
   * PR 6 — torch async handles submitted but never synchronized leaked
     their engine entries for the life of the session (HVD005).
+  * PR 18 schema drift — the decode doctor keyed resume watermarks on
+    a misspelled field, silently dropping every record it was written
+    to count (HVD008).
+  * PR 18 byte-identity flake — the trajectory consolidation walked
+    per-round bench artifacts with an unsorted glob, so regenerated
+    reports matched the committed bytes only when the filesystem
+    happened to agree (HVD009).
 
 Jaxpr tier (HVD007, traced by TestHistoricalRegressions through
 analysis.jaxpr_verify.verify_traced — no EXPECT markers because these
@@ -27,11 +34,15 @@ are IR-level defects the AST pass cannot see, which is the point):
     planned ride and the missing separate exact f32 vote.
 """
 
+import glob
+import json
 import subprocess
 import threading
 
 import horovod_tpu as hvd
 from horovod_tpu.ops import collective_ops
+
+DETERMINISTIC_ENTRYPOINTS = ("pr18_trajectory_consolidate",)
 
 
 class Pr1BytesProcessedRace:
@@ -79,6 +90,33 @@ class Pr6HandleLeak:
         if self._should_sync:
             return collective_ops.synchronize(h)
         return grads
+
+
+def pr18_watermark_field_drift(events):
+    """PR 18 schema drift: the decode doctor's watermark census read
+    `w.get("tokn")` — a misspelling of the declared `token` field —
+    which returned None for every record, so the resume-watermark
+    count silently collapsed to zero and the doctor reported a clean
+    decode tier while sequences were being replayed from scratch.
+    HVD008's consumer leg must flag the read against the registry."""
+    high = {}
+    for w in events:
+        if w["type"] == "seq_watermark":
+            high[w["sid"]] = w.get("tokn")  # EXPECT: HVD008
+    return high
+
+
+def pr18_trajectory_consolidate(dir_):
+    """PR 18 byte-identity flake: `bench --trajectory` consolidation
+    walked the per-round artifacts with an unsorted glob, so the row
+    order of the regenerated BENCH_trajectory.json depended on
+    filesystem enumeration order and the byte-identity pin flaked.
+    Declared in DETERMINISTIC_ENTRYPOINTS above so HVD009 seeds its
+    reachability here and must flag the unsorted walk."""
+    rows = []
+    for seg in glob.glob(dir_ + "/BENCH_r*.json"):  # EXPECT: HVD009
+        rows.append(seg)
+    return json.dumps({"rows": rows}, sort_keys=True, indent=1)
 
 
 def pr8_wire_gate_builder():

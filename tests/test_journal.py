@@ -402,6 +402,110 @@ class TestCommittedArtifact:
             report["source"]["faults"][0]["spec"]
 
 
+class TestEventSchemas:
+    """The declared EVENT_SCHEMAS registry (hvdlint HVD008's source
+    of truth): internal consistency, the strict-mode runtime
+    companion, validation of every committed journal artifact, and
+    the generated user_guide table's lockstep pin."""
+
+    def test_registry_shape(self):
+        names = [s.name for s in journal.EVENT_SCHEMAS]
+        assert len(names) == len(set(names)), "duplicate event decls"
+        for s in journal.EVENT_SCHEMAS:
+            assert s.name and s.name == s.name.lower()
+            assert s.writer and s.doc
+            overlap = (set(s.required) | set(s.optional)) \
+                & journal.BASE_FIELDS
+            assert not overlap, (s.name, overlap)
+            assert not set(s.required) & set(s.optional), s.name
+
+    def test_critical_events_derived_from_registry(self):
+        assert journal.CRITICAL_EVENTS <= journal.EVENT_NAMES
+        assert journal.CRITICAL_EVENTS == {
+            s.name for s in journal.EVENT_SCHEMAS if s.critical}
+        # the load-bearing recovery edges stay critical
+        for name in ("commit", "detect", "fault_fired",
+                     "epoch_published", "first_commit"):
+            assert name in journal.CRITICAL_EVENTS, name
+
+    def test_schema_problems_round_trip(self):
+        ok = journal.schema_problems(
+            "commit", {"epoch": 2, "durable": True, "step": 7})
+        assert ok == []
+        assert any("undeclared event" in p for p in
+                   journal.schema_problems("comitted", {"step": 1}))
+        assert any("missing required" in p for p in
+                   journal.schema_problems("commit", {"step": 1}))
+        assert any("undeclared field" in p for p in
+                   journal.schema_problems(
+                       "commit", {"epoch": 1, "stepp": 7}))
+
+    def test_strict_mode_warns_once_per_type(self, jdir,
+                                             monkeypatch):
+        monkeypatch.setenv("HOROVOD_JOURNAL_STRICT", "1")
+        seen = []
+        real = journal.hlog.warning
+        monkeypatch.setattr(
+            journal.hlog, "warning",
+            lambda msg, *a: seen.append(msg % a if a else msg))
+        j = journal.configure("worker", 0)
+        j.event("fx_not_a_real_event", x=1)  # never raises
+        j.event("fx_not_a_real_event", x=2)
+        j.event("commit", epoch=1, durable=True, step=3)
+        monkeypatch.setattr(journal.hlog, "warning", real)
+        warns = [m for m in seen
+                 if "HOROVOD_JOURNAL_STRICT" in m]
+        assert len(warns) == 1  # deduped per event type
+        assert "fx_not_a_real_event" in warns[0]
+        # the record is still written — strict mode observes, never
+        # drops
+        lines = open(os.path.join(jdir,
+                                  "journal-rank0.jsonl")).read()
+        assert '"fx_not_a_real_event"' in lines
+
+    def test_committed_artifacts_validate_against_registry(self):
+        """r11 chaos, r14 preempt, r16 serving, r18 decode journals —
+        every record of every committed artifact conforms to the
+        registry UNCHANGED (the registry documents history, it does
+        not rewrite it)."""
+        import glob as _glob
+        dirs = [os.path.join(REPO, "benchmarks", d) for d in
+                ("incident_chaos_r11", "incident_preempt_r14",
+                 "serving_trace_r16", "serving_decode_r18")]
+        checked = 0
+        problems = []
+        for d in dirs:
+            assert os.path.isdir(d), d
+            for seg in sorted(_glob.glob(
+                    os.path.join(d, "journal-*.jsonl*"))):
+                for line in open(seg):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail: the loader's repair job
+                    checked += 1
+                    for p in journal.validate_event(rec):
+                        problems.append((os.path.basename(seg), p))
+        assert checked > 100  # the artifacts are substantial
+        assert problems == [], problems[:10]
+
+    def test_user_guide_table_is_generated_form(self):
+        """The docs table between the hvdlint markers must be exactly
+        event_schema_table_md()'s output — HVD008's drift leg assumes
+        one source of truth."""
+        guide = open(os.path.join(REPO, "docs",
+                                  "user_guide.md")).read()
+        begin = "<!-- hvdlint:event-schema-table:begin -->"
+        end = "<!-- hvdlint:event-schema-table:end -->"
+        assert begin in guide and end in guide
+        between = guide.split(begin, 1)[1].split(end, 1)[0]
+        assert between.strip("\n") == \
+            journal.event_schema_table_md().strip("\n")
+
+
 # -- live 2-rank chaos run (multiproc-gated like the other chaos
 #    integration tests; the control-plane-only worker would run on
 #    this jaxlib, but the probe keeps the gate uniform) --------------

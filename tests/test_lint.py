@@ -92,11 +92,11 @@ class TestFixtureCorpus:
         result = run_analysis([FIXTURES], cwd=REPO_ROOT)
         rules = {f.rule for f in result.findings}
         assert rules == {"HVD001", "HVD002", "HVD003", "HVD004",
-                         "HVD005", "HVD006"}
+                         "HVD005", "HVD006", "HVD008", "HVD009"}
 
     def test_fixture_suppressions_filtered(self):
         result = run_analysis([FIXTURES], cwd=REPO_ROOT)
-        assert result.suppressed == 6
+        assert result.suppressed == 8
 
 
 class TestDeterminism:
@@ -206,7 +206,8 @@ class TestCli:
         assert cli_main(["--list-rules"]) == 0
         out = capsys.readouterr().out
         for rid in ("HVD001", "HVD002", "HVD003", "HVD004",
-                    "HVD005", "HVD006", "HVD007"):
+                    "HVD005", "HVD006", "HVD007", "HVD008",
+                    "HVD009"):
             assert rid in out
 
     def test_jaxpr_mode_exit_contract(self, tmp_path, capsys,
@@ -339,11 +340,12 @@ class TestDataflow:
 
 class TestHistoricalRegressions:
     """The bugs this repo actually shipped (PR 1 race, PR 4
-    Popen-under-lock, PR 6 handle leak; PR 8's two jaxpr-level
-    defects) reconstructed in tests/lint_fixtures/hvd_regressions.py
-    must each be caught by the tier that owns them."""
+    Popen-under-lock, PR 6 handle leak, PR 18's schema drift and
+    byte-identity flake; PR 8's two jaxpr-level defects)
+    reconstructed in tests/lint_fixtures/hvd_regressions.py must
+    each be caught by the tier that owns them."""
 
-    def test_all_three_are_flagged(self):
+    def test_ast_tier_regressions_are_flagged(self):
         result = run_analysis([FIXTURES], cwd=REPO_ROOT)
         rel = "tests/lint_fixtures/hvd_regressions.py"
         got = {(f.rule, f.context) for f in result.findings
@@ -352,6 +354,12 @@ class TestHistoricalRegressions:
                 "Pr1BytesProcessedRace._dispatch_loop") in got
         assert ("HVD003", "Pr4PopenUnderLock.spawn") in got
         assert ("HVD005", "Pr6HandleLeak.step") in got
+        # PR 18 schema drift: the doctor read a misspelled watermark
+        # field and silently counted nothing.
+        assert ("HVD008", "pr18_watermark_field_drift") in got
+        # PR 18 byte-identity flake: unsorted glob in the trajectory
+        # consolidation walk.
+        assert ("HVD009", "pr18_trajectory_consolidate") in got
 
     @staticmethod
     def _fixture_module():
