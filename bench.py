@@ -184,6 +184,20 @@ def _journal_digest():
         return {}
 
 
+def _health_digest(dir_=None):
+    """Compact continuous-telemetry digest for the JSON artifact:
+    sample/alert/anomaly counts from the time-series shards
+    ({'enabled': False} in the common un-recorded bench run) — a run
+    under HOROVOD_TELEMETRY_DIR carries its health-plane verdict in
+    the same artifact as its rate."""
+    try:
+        from horovod_tpu import telemetry
+        return telemetry.health_digest(dir_)
+    except Exception as e:  # pragma: no cover - defensive
+        log(f"bench: health digest unavailable ({e})")
+        return {}
+
+
 def _profile_block(profile_dir):
     """The `profile` digest every artifact carries (null when no
     capture ran): top-3 sinks + category split, parsed from the
@@ -639,6 +653,7 @@ def eager_main(model_name: str = "resnet50"):
         "metrics": _metrics_snapshot(),
         "trace": _trace_digest(),
         "journal": _journal_digest(),
+        "health": _health_digest(),
     }), flush=True)
 
 
@@ -785,6 +800,7 @@ def transformer_main():
         "compression": _compression_block(),
         "trace": _trace_digest(),
         "journal": _journal_digest(),
+        "health": _health_digest(),
     }), flush=True)
 
 
@@ -1877,6 +1893,7 @@ def serving_main() -> None:
         "retry": retry,
         "metrics": _metrics_snapshot(),
         "journal": _journal_digest(),
+        "health": _health_digest(),
     }
     attribution = _regen_serving_attribution(here)
     if attribution is not None:
@@ -2199,6 +2216,7 @@ def serving_decode_main() -> None:
         "chaos": chaos,
         "metrics": _metrics_snapshot(),
         "journal": _journal_digest(),
+        "health": _health_digest(),
     }
     attribution = _regen_decode_attribution(here)
     if attribution is not None:
@@ -2490,6 +2508,7 @@ def weight_swap_main() -> None:
         "rollback": rollback,
         "metrics": _metrics_snapshot(),
         "journal": _journal_digest(),
+        "health": _health_digest(),
     }
     shutil.rmtree(scratch, ignore_errors=True)
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
@@ -2503,6 +2522,184 @@ def weight_swap_main() -> None:
         "unit": "ms", "vs_baseline": 1.0}), flush=True)
 
 
+def _regen_health_report(here):
+    """Regenerate the health report from the COMMITTED telemetry
+    recording (benchmarks/health_r20/) — the same pure-function-of-
+    committed-bytes contract as the r16/r18 attribution artifacts:
+    `doctor health` on that directory and every rerun of this
+    function produce identical bytes. Returns the report, or None
+    when no recording is committed."""
+    from horovod_tpu import telemetry as htelemetry
+
+    record_dir = os.environ.get("BENCH_HEALTH_RECORD_DIR") \
+        or os.path.join(here, "benchmarks", "health_r20")
+    out = os.environ.get("BENCH_HEALTH_REPORT_OUT") or None
+    if not (os.path.isdir(record_dir)
+            and htelemetry.find_telemetry_files(record_dir)):
+        log(f"bench[health]: no recorded telemetry under "
+            f"{record_dir}; skipping health-report regeneration")
+        return None
+    path, report = htelemetry.write_health_report(record_dir,
+                                                  out=out)
+    log(f"bench[health]: report regenerated to {path}")
+    return report
+
+
+def health_report_main() -> None:
+    """`--health-report`: regenerate health_report.json from the
+    committed benchmarks/health_r20/ recording WITHOUT re-running the
+    legs (mirrors --serving-attribution: a pure deterministic
+    function of the committed shard bytes; BENCH_HEALTH_REPORT_OUT
+    redirects the output for byte-identity checks)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    report = _regen_health_report(here)
+    if report is None:
+        return
+    s = report["summary"]
+    print(json.dumps({
+        "metric": "health_anomalies", "value": s["anomalies"],
+        "unit": "alerts", "vs_baseline": 1.0}), flush=True)
+
+
+def health_main() -> None:
+    """`--health`: exercise the continuous health-telemetry plane
+    (horovod_tpu/telemetry.py) end to end on the decode tier and
+    write benchmarks/BENCH_health_r20.json — a steady leg (healthy
+    single-worker decode drain under tuned-but-plausible detector
+    thresholds: ZERO alerts is the acceptance bar) and a chaos leg
+    (an injected decode.step hang parks the victim worker past the
+    lease timeout; the survivor's continued sampling raises a
+    beat_stall health_alert while the watchdog's fault/seq_resumed
+    journal anchors attribute it to the recovery window — alerts >= 1
+    with ZERO anomalies is the bar). With BENCH_HEALTH_RECORD=1 both
+    legs record their telemetry shards and lifecycle journals into
+    benchmarks/health_r20/ (the committed recording behind
+    health_r20/health_report.json); every run then regenerates that
+    report from the committed bytes."""
+    import shutil
+    import tempfile
+
+    from horovod_tpu import decoding as hdecoding
+    from horovod_tpu import faults as hfaults
+    from horovod_tpu import journal as hjournal
+    from horovod_tpu import telemetry as htelemetry
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.environ.get("BENCH_HEALTH_OUT") or os.path.join(
+        here, "benchmarks", "BENCH_health_r20.json")
+    record = bool(os.environ.get("BENCH_HEALTH_RECORD"))
+    record_dir = os.environ.get("BENCH_HEALTH_RECORD_DIR") \
+        or os.path.join(here, "benchmarks", "health_r20")
+    if record:
+        # one coherent recording per commit: the report must stay a
+        # pure function of exactly these legs' shards
+        shutil.rmtree(record_dir, ignore_errors=True)
+        rec_to = record_dir
+    else:
+        rec_to = tempfile.mkdtemp(prefix="bench-health-")
+    os.makedirs(rec_to, exist_ok=True)
+
+    denv = dict(os.environ)
+    denv.update({
+        "HOROVOD_KV_PAGE_TOKENS": "8",
+        "HOROVOD_KV_MAX_CONTEXT": "64",
+        "HOROVOD_SERVING_DECODE_SLOTS": "4",
+        "HOROVOD_SERVING_DECODE_WATERMARK_STRIDE": "4",
+        "HOROVOD_SERVING_DECODE_LEASE_TIMEOUT_S": "2.0",
+        "HOROVOD_SERVING_DECODE_RETRY_BACKOFF_MS": "5",
+        "HOROVOD_JOURNAL_DIR": rec_to,
+        "HOROVOD_TELEMETRY_DIR": rec_to,
+        "HOROVOD_TELEMETRY_INTERVAL_S": "0",
+    })
+
+    def run_leg(tag, workers, n_seqs, max_new, **knobs):
+        env = dict(denv)
+        env.update({k: str(v) for k, v in knobs.items()})
+        fe = hdecoding.DecodeFrontend(workers=workers, env=env,
+                                     trace_tag=tag)
+        fe.start_watchdog()
+        t0 = time.perf_counter()
+        try:
+            futs = [fe.submit([1, 2, 3], max_new_tokens=max_new,
+                              seed=s) for s in range(n_seqs)]
+            outs = [list(f.result(timeout=300)) for f in futs]
+            st = fe.stats()
+        finally:
+            fe.close()
+            htelemetry.disarm()
+            hjournal.disarm()
+        wall = time.perf_counter() - t0
+        return {
+            "name": tag,
+            "workers": workers,
+            "sequences": n_seqs,
+            "delivered_tokens": sum(len(o) for o in outs),
+            "wall_s": round(wall, 3),
+            "completed": st["completed"],
+            "resumed": st["resumed"],
+            "failed": st["failed"],
+        }
+
+    legs = [run_leg("steady", 1, 4, 24,
+                    HOROVOD_TELEMETRY_STEP_MAD_K="30",
+                    HOROVOD_TELEMETRY_STALL_FLOOR_S="5.0")]
+    log(f"bench[health]: steady leg {legs[-1]}")
+
+    hfaults.configure("decode.step:hang:at=12", seed=0)
+    try:
+        legs.append(run_leg("chaos", 2, 2, 40,
+                            HOROVOD_TELEMETRY_STEP_MAD_K="10",
+                            HOROVOD_TELEMETRY_STALL_FLOOR_S="0.5"))
+    finally:
+        hfaults.configure("", seed=0)
+    log(f"bench[health]: chaos leg {legs[-1]}")
+    if legs[-1]["resumed"] < 1:
+        log("bench[health]: WARNING chaos leg resumed no sequences "
+            f"({legs[-1]})")
+
+    path, _ = htelemetry.write_health_report(rec_to)
+    log(f"bench[health]: report written to {path}")
+    if os.path.abspath(rec_to) != os.path.abspath(record_dir):
+        _regen_health_report(here)
+
+    health = _health_digest(rec_to)
+    if health.get("anomalies", 0) != 0 or not health.get("alerts"):
+        log(f"bench[health]: WARNING unexpected health verdict "
+            f"({health})")
+
+    doc = {
+        "what": "Continuous health telemetry measured on this host "
+                "(horovod_tpu/telemetry.py): a healthy decode drain "
+                "that the online detectors must stay silent on, and "
+                "an injected mid-decode hang whose beat_stall alert "
+                "must be attributed to the journaled recovery window "
+                "- alerts with zero unexplained anomalies is the "
+                "acceptance bar.",
+        "generated_by": "python bench.py --health",
+        "config": {
+            "slots": 4, "page_tokens": 8, "max_context": 64,
+            "watermark_stride": 4, "lease_timeout_s": 2.0,
+            "telemetry_interval_s": 0.0,
+            "chaos_fault": "decode.step:hang:at=12",
+        },
+        "legs": legs,
+        "health": health,
+        "metrics": _metrics_snapshot(),
+        "journal": _journal_digest(),
+    }
+    if not record:
+        shutil.rmtree(rec_to, ignore_errors=True)
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    log(f"bench[health]: written to {out_path}")
+    print(json.dumps({
+        "metric": "health_chaos_anomalies",
+        "value": health.get("anomalies", -1),
+        "unit": "alerts", "vs_baseline": 1.0}), flush=True)
+
+
 # The trajectory consolidation is a byte-pinned artifact path:
 # hvdlint HVD009 seeds its reachability here and flags wall-clock /
 # unsorted-walk / unsorted-json nondeterminism anywhere under it.
@@ -2512,7 +2709,7 @@ DETERMINISTIC_ENTRYPOINTS = ("trajectory_main",)
 def trajectory_main() -> None:
     """`--trajectory`: consolidate the committed per-round artifacts
     into one byte-deterministic BENCH_trajectory.json — the headline
-    perf story r01->r18 in a single file (ROADMAP satellite: the
+    perf story r01->r20 in a single file (ROADMAP satellite: the
     story used to stop at r05). Reads ONLY committed artifacts (no
     clocks, no env), writes with sorted keys — rerunning on the same
     tree reproduces the bytes exactly; this path is on hvdlint
@@ -2696,6 +2893,29 @@ def trajectory_main() -> None:
             "source": "benchmarks/BENCH_serving_decode_r18.json + "
                       "benchmarks/SERVING_ATTRIBUTION_r18.json",
         },
+        "r20_health": {
+            "samples": read(
+                "benchmarks/BENCH_health_r20.json",
+                "health", "samples"),
+            "alerts": read(
+                "benchmarks/BENCH_health_r20.json",
+                "health", "alerts"),
+            "attributed_alerts": read(
+                "benchmarks/BENCH_health_r20.json",
+                "health", "attributed_alerts"),
+            "anomalies": read(
+                "benchmarks/BENCH_health_r20.json",
+                "health", "anomalies"),
+            "note": "continuous health telemetry over the decode "
+                    "tier: the online detectors stay silent on the "
+                    "healthy drain, and the injected mid-decode "
+                    "hang's beat_stall alert is fully attributed to "
+                    "the journaled recovery window - zero "
+                    "unexplained anomalies across the committed "
+                    "recording",
+            "source": "benchmarks/BENCH_health_r20.json + "
+                      "benchmarks/health_r20/health_report.json",
+        },
     }
     with open(out_path, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True)
@@ -2703,7 +2923,7 @@ def trajectory_main() -> None:
     log(f"bench[trajectory]: written to {out_path}")
     print(json.dumps({
         "metric": "trajectory_rounds_recorded",
-        "value": len(headline) + 8, "unit": "rounds",
+        "value": len(headline) + 9, "unit": "rounds",
         "vs_baseline": 1.0}, sort_keys=True), flush=True)
 
 
@@ -3010,6 +3230,7 @@ def main(model_name: str = "resnet50"):
         "compression": compression_block,
         "trace": _trace_digest(),
         "journal": _journal_digest(),
+        "health": _health_digest(),
     }
     if overlap_block is not None:
         doc["overlap"] = overlap_block
@@ -3039,6 +3260,10 @@ if __name__ == "__main__":
         serving_decode_main()
     elif "--weight-swap" in sys.argv:
         weight_swap_main()
+    elif "--health-report" in sys.argv:
+        health_report_main()
+    elif "--health" in sys.argv:
+        health_main()
     elif "--serving" in sys.argv:
         serving_main()
     elif "--compression-ab" in sys.argv:

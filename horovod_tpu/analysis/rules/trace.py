@@ -128,6 +128,25 @@ def _journal_mutation(call: ast.Call) -> str:
     return ""
 
 
+# Telemetry seam of telemetry.py: a beat inside a traced function
+# fires ONCE per compilation, so the time-series plane would record a
+# single phantom sample per retrace instead of one per step — and a
+# due sample pays a metrics-registry snapshot plus a shard write at
+# trace time.
+_TELEMETRY_ATTRS = frozenset({"beat", "configure", "disarm"})
+
+
+def _telemetry_mutation(call: ast.Call) -> str:
+    f = call.func
+    if not isinstance(f, ast.Attribute) \
+            or f.attr not in _TELEMETRY_ATTRS:
+        return ""
+    recv = attr_chain(f.value).lower()
+    if "telemetry" in recv or recv.split(".")[-1] == "_telemetry":
+        return f"{attr_chain(f) or f.attr}()"
+    return ""
+
+
 def _side_effect(node: ast.AST) -> str:
     """Human-readable description when `node` is a trace-impure
     operation, else ''."""
@@ -151,6 +170,9 @@ def _side_effect(node: ast.AST) -> str:
     jw = _journal_mutation(node)
     if jw:
         return f"journal write '{jw}'"
+    tb = _telemetry_mutation(node)
+    if tb:
+        return f"telemetry beat '{tb}'"
     if call_name(node) == "fire" and "fault" in chain.lower():
         return f"fault-injection seam '{chain}()'"
     # The registry-routed point read mandated by HVD002 is just as
@@ -166,7 +188,8 @@ def _side_effect(node: ast.AST) -> str:
 class TracePurityRule(Rule):
     id = "HVD004"
     summary = ("python side-effect (metrics/faults/environ/wall-"
-               "clock/trace-span/journal-write/profiler-session) "
+               "clock/trace-span/journal-write/telemetry-beat/"
+               "profiler-session) "
                "inside a jit/shard_map/pmap-traced function")
 
     def run(self, project: Project) -> List[Finding]:

@@ -253,6 +253,12 @@ def init(config_overrides: Optional[Dict[str, Any]] = None,
         from .. import journal as _journal
         _journal.on_init(cfg, _state)
 
+        # Health telemetry LAST: it samples the metrics the layers
+        # above register, and its first beat should see an
+        # initialized world. Best-effort like the journal.
+        from .. import telemetry as _telemetry
+        _telemetry.on_init(cfg, _state)
+
         hlog.info("horovod_tpu initialized: rank=%d size=%d local_rank=%d "
                   "local_size=%d cross_rank=%d cross_size=%d devices=%d",
                   _state.topology.rank, _state.topology.size,

@@ -277,6 +277,79 @@ KNOBS: List[Knob] = [
          "default: the same contract is enforced statically by "
          "hvdlint HVD008; this runtime leg exists for soaks and "
          "chaos runs exercising code paths lint cannot see."),
+    # -- continuous health telemetry (telemetry.py) ---------------------------
+    Knob("HOROVOD_TELEMETRY_DIR", str, "",
+         "Directory for the per-rank health-telemetry time-series "
+         "shards (telemetry.py): each process samples the metrics "
+         "registry at its plane's natural beats (elastic commits, "
+         "serving/decode loop ticks, weight adoptions), folds "
+         "counter deltas into rates, and appends monotonic-anchored "
+         "JSONL records to telemetry-rank{r}.jsonl with the "
+         "journal's fsync/rotation discipline; "
+         "`python -m horovod_tpu.runner.doctor health <dir>` folds "
+         "shards + journals into a health report. Empty (default) "
+         "disables telemetry entirely (one load + compare per "
+         "beat)."),
+    Knob("HOROVOD_TELEMETRY_INTERVAL_S", float, 1.0,
+         "Minimum seconds between persisted telemetry samples; "
+         "beats arriving inside the interval only update beat "
+         "bookkeeping. 0 samples at every beat (tests/benches)."),
+    Knob("HOROVOD_TELEMETRY_RING", int, 512,
+         "Bounded in-memory ring of recent samples kept for "
+         "in-process consumers (the live autotuner objective); "
+         "oldest samples fall off, the shard keeps everything."),
+    Knob("HOROVOD_TELEMETRY_FSYNC", int, 32,
+         "Telemetry shard flush cadence: fsync after every N "
+         "samples (telemetry is volume, not lifecycle — losing the "
+         "unflushed tail on SIGKILL costs trend points, not "
+         "recovery truth; telemetry_meta/health-critical records "
+         "fsync regardless)."),
+    Knob("HOROVOD_TELEMETRY_ROTATE_MB", int, 64,
+         "Telemetry shard rotation cap in MiB (same single-.1 "
+         "sibling discipline as the journal). 0 disables rotation."),
+    Knob("HOROVOD_TELEMETRY_DETECT_WINDOW", int, 16,
+         "Rolling window (samples) the online detectors compute "
+         "median/MAD baselines over; also bounds each beat source's "
+         "inter-beat period history."),
+    Knob("HOROVOD_TELEMETRY_TREND_RUN", int, 5,
+         "Consecutive strictly-increasing samples before the trend "
+         "detectors (collective skew, queue depth) alert."),
+    Knob("HOROVOD_TELEMETRY_STEP_MAD_K", float, 8.0,
+         "Step-time regression threshold: alert when the current "
+         "beat period / histogram mean exceeds rolling median + "
+         "K*MAD (MAD floored at 5% of median) for 3 consecutive "
+         "samples; also scales the beat-stall age threshold "
+         "(K*median period)."),
+    Knob("HOROVOD_TELEMETRY_STALL_FLOOR_S", float, 0.5,
+         "Floor on the beat-stall age threshold so millisecond-"
+         "period sources don't alert on ordinary scheduling jitter: "
+         "a source is stalled when its age exceeds "
+         "max(K*median_period, this floor)."),
+    Knob("HOROVOD_TELEMETRY_SLO_BURST", int, 5,
+         "SLO-miss burst threshold: alert when any "
+         "*_slo_miss_total series advances by at least this many "
+         "misses within one sample interval."),
+    Knob("HOROVOD_TELEMETRY_QUEUE_MIN", int, 8,
+         "Queue-depth growth detector floor: a strictly-growing "
+         "admission/decode queue only alerts once its depth also "
+         "reaches this many entries (small queues breathe)."),
+    Knob("HOROVOD_TELEMETRY_STALENESS_LIMIT", int, 50,
+         "Weight-staleness runaway threshold: alert when a serving "
+         "worker's hvd_weights_staleness_steps gauge reaches this "
+         "many train steps and is still climbing."),
+    Knob("HOROVOD_TELEMETRY_ALERT_COOLDOWN_S", float, 30.0,
+         "Per-(detector, signal) alert cooldown: a persisting "
+         "condition re-alerts at most this often instead of "
+         "flooding the journal every sample."),
+    Knob("HOROVOD_TELEMETRY_RECOVERY_GRACE_S", float, 10.0,
+         "Runtime recovery-attribution stickiness: after any "
+         "recovery-signal counter (recoveries, elastic resets, "
+         "decode resumes, serving retries, fault firings) moves, "
+         "alerts within this many seconds carry "
+         "attributed=\"recovery\" instead of counting as anomalies. "
+         "The offline analyzer uses its own fixed "
+         "journal-anchored windows (telemetry.RECOVERY_GRACE_S) so "
+         "committed reports stay byte-stable."),
     # -- autotune ------------------------------------------------------------
     Knob("HOROVOD_AUTOTUNE", _parse_bool, False,
          "Enable online autotuning of fusion threshold and cycle time."),
@@ -728,6 +801,9 @@ class Config:
         "journal_fsync": "HOROVOD_JOURNAL_FSYNC",
         "journal_rotate_mb": "HOROVOD_JOURNAL_ROTATE_MB",
         "journal_strict": "HOROVOD_JOURNAL_STRICT",
+        "telemetry_dir": "HOROVOD_TELEMETRY_DIR",
+        "telemetry_interval_s": "HOROVOD_TELEMETRY_INTERVAL_S",
+        "telemetry_ring": "HOROVOD_TELEMETRY_RING",
         "autotune": "HOROVOD_AUTOTUNE",
         "autotune_log": "HOROVOD_AUTOTUNE_LOG",
         "autotune_mode": "HOROVOD_AUTOTUNE_MODE",

@@ -69,6 +69,7 @@ import numpy as np
 
 from . import faults as _faults
 from . import journal as _journal
+from . import telemetry as _telemetry
 from . import tracing as _tracing
 from .common import config as _config
 from .common import logging as hlog
@@ -709,6 +710,11 @@ class _DecodeWorker(threading.Thread):
         while True:
             if fe._retired(self.wid):
                 return
+            # Per-worker telemetry beat: the engine loop ticks even
+            # when idle (the bounded cond.wait below), so a worker
+            # that stops beating is DEAD, not quiet — exactly what
+            # the stall detector keys on, per wid.
+            _telemetry.beat("decode", key=self.wid)
             # Fault seam: one fire per running-batch step.  An error
             # kills this worker (its leases resume on survivors); a
             # hang parks past the lease timeout, after which the
@@ -812,6 +818,7 @@ class DecodeFrontend:
 
         role = "serving-%s" % (trace_tag or "decode")
         _journal.configure(role, env=env)
+        _telemetry.configure(role, env=env)
         _journal.record(
             "decode_meta",
             slots=self.slots,
@@ -1404,6 +1411,8 @@ def remote_decode_loop(addr: str, port: int, step_fn=None, params=None,
         secret = _secret_mod.from_env()
     if _journal._journal is None:
         _journal.configure("decode-worker-%s" % wid, env=env)
+    if _telemetry._recorder is None:
+        _telemetry.configure("decode-worker-%s" % wid, env=env)
     emit_stride = int(_config.env_value(
         "HOROVOD_SERVING_DECODE_EMIT_STRIDE", env=env))
     eng = DecodeEngine(
@@ -1434,6 +1443,7 @@ def remote_decode_loop(addr: str, port: int, step_fn=None, params=None,
         return bool(rep.get("stop"))
 
     while True:
+        _telemetry.beat("decode", key=wid)
         if eng.free_slots() > 0 and not stop:
             lanes = eng.active_by_lane()
             rep = cli.try_request({

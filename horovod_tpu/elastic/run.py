@@ -174,6 +174,13 @@ def run(func: Callable) -> Callable:
             # gang measures its loss instead of assuming the snapshot
             # was current (hvd_committed_step_loss_total).
             _journal.note_sync(getattr(state, "step", None))
+            # Telemetry beat at the sync boundary: every elastic
+            # attempt (first start, post-recovery, post-resize)
+            # passes here, so recovery fallout lands in a sample
+            # adjacent to the journaled reinit/internal_error anchors
+            # the health analyzer attributes it against.
+            from .. import telemetry as _telemetry
+            _telemetry.beat("sync")
             # A trainer that died mid-publish can leave the live
             # weight pipeline's CURRENT pointer at a torn version;
             # re-point it at the newest intact one before training

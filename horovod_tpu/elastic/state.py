@@ -129,6 +129,13 @@ class State:
         _journal.note_commit(getattr(self, "step", None),
                              durable=getattr(
                                  self, "_last_save_durable", False))
+        # Health telemetry beat at the commit boundary — the training
+        # plane's steady-state clock. The sample it may trigger sees
+        # the committed step's metrics (skew, commit counters), which
+        # is the signal history ROADMAP item 5's live autotuner
+        # objective reads. Disarmed = one load + compare.
+        from .. import telemetry as _telemetry
+        _telemetry.beat("commit")
         # Live weight pipeline AFTER the journaled commit: rank 0
         # publishes the just-committed params for the serving pool
         # (weights.py rides the host copies save() made, so this is

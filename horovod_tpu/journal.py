@@ -433,6 +433,34 @@ EVENT_SCHEMAS: List[EventSchema] = [
         "its token frontier.",
         required=("sid", "worker", "cause", "resumes", "at_token"),
         critical=True),
+    EventSchema(
+        "telemetry_meta", "telemetry",
+        "First record of a telemetry time-series shard "
+        "(telemetry-*.jsonl, telemetry.py): schema version, the "
+        "monotonic/wall anchor pair the offline merge aligns on, and "
+        "the sampling config in force.",
+        required=("schema", "anchor_mono_ns", "anchor_unix", "host",
+                  "interval_s", "ring"),
+        critical=True),
+    EventSchema(
+        "telemetry_sample", "telemetry",
+        "One time-series sample: counter deltas folded into rates "
+        "over dt_s, raw gauges, per-histogram count/mean deltas, and "
+        "the per-source beat counts since the previous sample "
+        "(volume; batched fsync).",
+        required=("beat", "seq", "dt_s", "beats", "rates", "gauges",
+                  "hist"),
+        optional=("recovering",)),
+    EventSchema(
+        "health_alert", "telemetry",
+        "An online health detector fired: observed value vs the "
+        "rolling baseline and threshold that tripped it. "
+        "`attributed` marks alerts raised while a recovery signal "
+        "was moving — expected fallout, not an anomaly.",
+        required=("detector", "beat", "signal", "value", "baseline",
+                  "threshold", "window"),
+        optional=("attributed",),
+        critical=True),
 ]
 
 SCHEMA_BY_NAME: Dict[str, EventSchema] = {

@@ -11,7 +11,13 @@ accounting — and `python -m horovod_tpu.runner.doctor serve <dir>`
 — fold the serving tier's request-lifecycle journals and timelines
 (serving_trace.py) into a byte-deterministic serving_report.json
 with per-phase latency decomposition, per-worker utilization, retry
-chains, and goodput-vs-SLO accounting."""
+chains, and goodput-vs-SLO accounting — and
+`python -m horovod_tpu.runner.doctor health <dir>` — fold the
+continuous-telemetry time-series shards plus sibling lifecycle
+journals (telemetry.py) into a byte-deterministic
+health_report.json with per-signal trend tables, the health-alert
+timeline correlated against recovery windows, and a steady-state vs
+recovery decomposition."""
 
 from __future__ import annotations
 
@@ -123,9 +129,23 @@ def serve(target: str, out: Optional[str] = None) -> str:
             + f"\n\nreport: {path}")
 
 
+def health(target: str, out: Optional[str] = None) -> str:
+    """Fold the telemetry time-series shards (and sibling lifecycle
+    journals) under `target` into `health_report.json` —
+    byte-deterministic for identical inputs, the same regeneration
+    contract as `incident`/`serve` — and return the rendered
+    per-signal trend tables and the alert timeline correlated
+    against journaled recovery windows."""
+    from .. import telemetry
+    path, report = telemetry.write_health_report(target, out=out)
+    return (telemetry.render_health_report(report)
+            + f"\n\nreport: {path}")
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     """`python -m horovod_tpu.runner.doctor
-    [trace <dir>|incident <dir>|serve <dir>|check-build]`."""
+    [trace <dir>|incident <dir>|serve <dir>|health <dir>|
+    check-build]`."""
     import argparse
 
     p = argparse.ArgumentParser(
@@ -175,6 +195,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     ps.add_argument("--out", default=None,
                     help="report output path (default: "
                          "serving_report.json inside the dir)")
+    ph = sub.add_parser(
+        "health",
+        help="fold the continuous-telemetry shards "
+             "(HOROVOD_TELEMETRY_DIR) plus sibling lifecycle "
+             "journals into health_report.json (per-signal trend "
+             "tables, alert timeline vs recovery windows, "
+             "steady-state vs recovery decomposition) and print the "
+             "summary")
+    ph.add_argument("target",
+                    help="the run's HOROVOD_TELEMETRY_DIR (holds "
+                         "telemetry-rankN.jsonl, plus any sibling "
+                         "journal-*.jsonl)")
+    ph.add_argument("--out", default=None,
+                    help="report output path (default: "
+                         "health_report.json inside the dir)")
     args = p.parse_args(argv)
     if args.cmd == "trace":
         try:
@@ -196,6 +231,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(serve(args.target, out=args.out))
         except (OSError, ValueError) as e:
             print(f"doctor serve: {e}")
+            return 1
+        return 0
+    if args.cmd == "health":
+        try:
+            print(health(args.target, out=args.out))
+        except (OSError, ValueError) as e:
+            print(f"doctor health: {e}")
             return 1
         return 0
     print(check_build(verbose=getattr(args, "verbose", False)))

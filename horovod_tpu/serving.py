@@ -111,6 +111,7 @@ import numpy as np
 
 from . import faults as _faults
 from . import journal as _journal
+from . import telemetry as _telemetry
 from . import tracing as _tracing
 from . import weights as _weights_mod
 from .common import config as _config
@@ -700,6 +701,11 @@ class ServingFrontend:
 
         _journal.configure(f"serving-{trace_tag}" if trace_tag
                            else "serving", env=env)
+        # Health telemetry rides the same role naming so one record
+        # dir collects journal + telemetry shards side by side
+        # (disarmed when HOROVOD_TELEMETRY_DIR is unset).
+        _telemetry.configure(f"serving-{trace_tag}" if trace_tag
+                             else "serving", env=env)
         _journal.record(
             "serving_meta", ladder=self.ladder.digest,
             max_batch=self._max_batch,
@@ -868,6 +874,10 @@ class ServingFrontend:
         win0_ns = time.monotonic_ns()
         idle_ns = 0
         while True:
+            # Telemetry beat at the loop's natural tick: samples (and
+            # the stall dual that catches a loop that STOPPED beating)
+            # key on it. One load + compare when disarmed.
+            _telemetry.beat("serving")
             with self._queue_cond:
                 while not self._cut_ready_locked():
                     if self._closing and not self._queue:
@@ -1549,6 +1559,10 @@ def remote_worker_loop(addr: str, port: int,
         # runner this process journals as its rank, and fault_fired /
         # batch records must stay attributable to that rank.
         _journal.configure(f"serving-{wid}", env=env)
+    if _telemetry._recorder is None:
+        # Same don't-steal rule: an elastic-rank recorder keeps its
+        # shard; a standalone serving worker gets its own.
+        _telemetry.configure(f"serving-{wid}", env=env)
     cli = BasicClient(addr, port, secret, timeout=10.0)
     ladder = build_ladder(env=env)
     jitted = jax.jit(forward_fn)

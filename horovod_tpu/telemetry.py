@@ -1,0 +1,833 @@
+"""Continuous per-rank health telemetry.
+
+Everything the repo's other observability surfaces record is either an
+instantaneous snapshot (the metrics registry's ``/metrics`` text) or a
+post-hoc artifact (the lifecycle journal, the serving traces); nothing
+records *how signals evolve* while a job runs, so a step-time
+regression or a queue-depth ramp is invisible until an offline
+analyzer runs after the job dies. This module closes that gap with
+three coupled pieces:
+
+1. **A per-rank time-series recorder** sampling the metrics registry's
+   ``snapshot()`` at the planes' natural beats — the elastic commit
+   boundary, the serving batch loop, the decode engine loop, a weight
+   adoption — computing counter deltas into rates and persisting
+   monotonic-ns-anchored JSONL shards (``telemetry-rank{r}.jsonl``)
+   with the journal's fsync/rotation discipline (the shard writer IS a
+   ``journal.Journal``, so torn tails, O_APPEND interleaving and the
+   per-segment ``n`` tiebreak come for free and the offline reader is
+   ``journal.read_journal``). A bounded in-memory ring keeps the
+   recent window for in-process consumers (the live autotuner
+   objective ROADMAP item 5 reads this substrate).
+
+2. **Online detectors** over the stream: rolling-median + MAD beat-
+   period regression (and its dual, the beat-stall check that catches
+   a source that stopped beating entirely), rolling-median + MAD
+   regression over ``*_seconds`` histogram means (step time), a
+   collective-skew trend, admission/queue-depth growth, SLO-miss
+   bursts, and weight-staleness runaway. Each emits a typed
+   ``health_alert`` journal event (registered in
+   ``journal.EVENT_SCHEMAS`` so hvdlint HVD008 machine-checks every
+   write site and consumer) plus ``hvd_health_alerts_total{detector}``.
+   Alerts that coincide with a recovery in flight (a recovery-signal
+   counter moved within the grace window) are *attributed* to it —
+   the ``attributed`` field — not raised as anomalies: a crash is
+   supposed to dent the gauges, and re-alarming on the recovery would
+   bury the real signal.
+
+3. **The offline half**: ``health_report(dir)`` folds the telemetry
+   shards and the sibling lifecycle journals into a byte-deterministic
+   ``health_report.json`` — per-signal trend tables, the alert
+   timeline correlated against journaled recovery windows, and a
+   steady-state vs recovery-window decomposition of every signal —
+   surfaced as ``python -m horovod_tpu.runner.doctor health <dir>``.
+   The entry points are declared in ``DETERMINISTIC_ENTRYPOINTS`` so
+   hvdlint HVD009 patrols them for nondeterminism sources; committed
+   recordings under ``benchmarks/`` regenerate byte-identically.
+
+Disarmed cost: ``beat()`` is one module-global load + compare, the
+same contract as ``faults.fire`` / ``journal.record`` — hot loops may
+call it unconditionally.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob as _glob
+import json
+import os
+import threading
+import time
+from typing import Any, Deque, Dict, List, Optional, Tuple
+
+from . import journal as _journal_mod
+from .common import config as _config
+from .common import logging as hlog
+from .metrics import REGISTRY as _METRICS
+
+TELEMETRY_SCHEMA = "hvd-telemetry-v1"
+HEALTH_REPORT_SCHEMA = "hvd-health-report-v1"
+_m_samples = _METRICS.counter(
+    "hvd_telemetry_samples_total",
+    "Telemetry samples persisted to this rank's time-series shard, "
+    "by the beat that triggered them.", ("beat",))
+_m_alerts = _METRICS.counter(
+    "hvd_health_alerts_total",
+    "health_alert events the online detectors emitted (attributed "
+    "recovery-window alerts included — the journal carries the "
+    "attribution).", ("detector",))
+
+# Counter families whose movement means a recovery is in flight on
+# this process: while any of them advanced within the grace window,
+# detector alerts carry attributed="recovery" instead of counting as
+# anomalies. Prefix match over the flattened snapshot keys.
+RECOVERY_SIGNALS = (
+    "hvd_recoveries_total",
+    "hvd_elastic_resets_total",
+    "hvd_decode_sequences_resumed_total",
+    "hvd_serving_retries_total",
+    "hvd_faults_fired_total",
+)
+
+# Journal event types anchoring an offline recovery window: the
+# analyzer draws [t - grace, t + grace] around each and merges
+# overlaps. FIXED grace (not a knob): the committed health reports
+# must regenerate byte-identically regardless of the reader's env.
+RECOVERY_ANCHOR_EVENTS = (
+    "detect", "internal_error", "fault_fired", "reinit_begin",
+    "host_preempt", "seq_resumed", "seq_failed", "batch_retried",
+    "worker_exit",
+)
+RECOVERY_GRACE_S = 5.0
+
+
+def _flatten(snap: Dict[str, Dict[Tuple[str, ...], Any]]
+             ) -> Tuple[Dict[str, float], Dict[str, Tuple[float, float]]]:
+    """(scalars, hists) with JSON-safe string keys: ``name`` for the
+    unlabeled series, ``name{a,b}`` for labeled ones. Histogram values
+    collapse to (count, sum) — the buckets stay in /metrics."""
+    scalars: Dict[str, float] = {}
+    hists: Dict[str, Tuple[float, float]] = {}
+    for name, series in snap.items():
+        for labels, value in series.items():
+            key = (name if not labels
+                   else name + "{" + ",".join(str(x) for x in labels)
+                   + "}")
+            if isinstance(value, dict):
+                hists[key] = (float(value.get("count", 0)),
+                              float(value.get("sum", 0.0)))
+            else:
+                scalars[key] = float(value)
+    return scalars, hists
+
+
+def _is_counter(key: str) -> bool:
+    # Registry convention: counters end in _total (before any label
+    # suffix); everything else scalar is a gauge.
+    base = key.split("{", 1)[0]
+    return base.endswith("_total")
+
+
+class Recorder:
+    """One process's telemetry plane: beat bookkeeping, periodic
+    sampling, the shard writer, and the online detectors. All entry
+    is via ``beat()`` — there is no background thread; a plane that
+    stops beating stops sampling, which is itself the signal the
+    surviving sources' stall detector reads."""
+
+    def __init__(self, dir_: str, role: str, rank: int = -1,
+                 env: Optional[Dict[str, str]] = None):
+        def ev(k: str) -> Any:
+            return _config.env_value(k, env=env)
+        self.role = role
+        self.rank = int(rank)
+        self.interval_s = float(ev("HOROVOD_TELEMETRY_INTERVAL_S"))
+        self.window = int(ev("HOROVOD_TELEMETRY_DETECT_WINDOW"))
+        self.trend_run = int(ev("HOROVOD_TELEMETRY_TREND_RUN"))
+        self.mad_k = float(ev("HOROVOD_TELEMETRY_STEP_MAD_K"))
+        self.stall_floor_s = float(ev("HOROVOD_TELEMETRY_STALL_FLOOR_S"))
+        self.slo_burst = int(ev("HOROVOD_TELEMETRY_SLO_BURST"))
+        self.queue_min = float(ev("HOROVOD_TELEMETRY_QUEUE_MIN"))
+        self.staleness_limit = float(
+            ev("HOROVOD_TELEMETRY_STALENESS_LIMIT"))
+        self.cooldown_s = float(ev("HOROVOD_TELEMETRY_ALERT_COOLDOWN_S"))
+        self.recovery_grace_s = float(
+            ev("HOROVOD_TELEMETRY_RECOVERY_GRACE_S"))
+        ring = max(8, int(ev("HOROVOD_TELEMETRY_RING")))
+        self.ring: Deque[dict] = collections.deque(maxlen=ring)
+        safe_role = "".join(c if (c.isalnum() or c in "._-") else "_"
+                            for c in role)
+        name = (f"telemetry-{safe_role}.jsonl" if rank < 0
+                else f"telemetry-rank{rank}.jsonl")
+        self.path = os.path.join(dir_, name)
+        os.makedirs(dir_, exist_ok=True)
+        # The shard writer IS a Journal: O_APPEND whole-line writes,
+        # per-segment `n` tiebreak, fsync batching, .1 rotation — the
+        # identical durability contract, pointed at a telemetry-*.jsonl
+        # path the journal merge's glob never picks up.
+        self._journal = _journal_mod.Journal(
+            self.path, role, rank,
+            fsync_every=int(ev("HOROVOD_TELEMETRY_FSYNC")),
+            rotate_bytes=int(ev("HOROVOD_TELEMETRY_ROTATE_MB"))
+            * (1 << 20))
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._last_sample_t: Optional[float] = None
+        self._prev_scalars: Dict[str, float] = {}
+        self._prev_hists: Dict[str, Tuple[float, float]] = {}
+        # beat bookkeeping, keyed (name, key)
+        self._last_beat: Dict[Tuple[str, str], float] = {}
+        self._pending: Dict[Tuple[str, str], int] = {}
+        self._periods: Dict[Tuple[str, str], Deque[float]] = {}
+        # detector state
+        self._period_anomaly_run: Dict[str, int] = {}
+        self._hist_series: Dict[str, Deque[float]] = {}
+        self._hist_anomaly_run: Dict[str, int] = {}
+        self._gauge_series: Dict[str, Deque[float]] = {}
+        self._last_alert_t: Dict[Tuple[str, str], float] = {}
+        self._recovery_until = float("-inf")
+        self._journal.event(
+            "telemetry_meta", _critical=True,
+            schema=TELEMETRY_SCHEMA,
+            anchor_mono_ns=self._journal._anchor_mono,
+            anchor_unix=round(self._journal._anchor_unix, 6),
+            host=_config.env_value("HOROVOD_HOSTNAME") or "",
+            interval_s=self.interval_s,
+            ring=ring)
+
+    # -- hot path -----------------------------------------------------
+
+    def beat(self, name: str, key: str = "") -> None:
+        """One tick of a plane's natural loop. Cheap when no sample is
+        due: a dict update and an interval compare under the lock."""
+        now = time.monotonic()
+        with self._lock:
+            k = (name, key)
+            last = self._last_beat.get(k)
+            self._last_beat[k] = now
+            self._pending[k] = self._pending.get(k, 0) + 1
+            if last is not None:
+                dq = self._periods.get(k)
+                if dq is None:
+                    dq = self._periods[k] = collections.deque(
+                        maxlen=max(4, self.window))
+                dq.append(now - last)
+            if (self._last_sample_t is not None
+                    and now - self._last_sample_t < self.interval_s):
+                return
+            self._sample_locked(name, now)
+
+    # -- sampling (under self._lock) ----------------------------------
+
+    def _sample_locked(self, beat: str, now: float) -> None:
+        scalars, hists = _flatten(_METRICS.snapshot())
+        first = self._last_sample_t is None
+        dt = 0.0 if first else now - self._last_sample_t
+        self._last_sample_t = now
+        rates: Dict[str, float] = {}
+        gauges: Dict[str, float] = {}
+        deltas: Dict[str, float] = {}
+        for key in sorted(scalars):
+            cur = scalars[key]
+            if _is_counter(key):
+                # The first sample only establishes baselines: a
+                # counter's pre-arm total is history, not activity in
+                # this window — treating it as a delta would (among
+                # other lies) mark the whole first grace period as
+                # "recovering" whenever the process ever recovered
+                # from anything before telemetry armed.
+                if first:
+                    continue
+                d = cur - self._prev_scalars.get(key, 0.0)
+                if d != 0.0:
+                    deltas[key] = d
+                    rates[key] = round(d / dt, 6) if dt > 0 else 0.0
+            else:
+                gauges[key] = round(cur, 6)
+        hist: Dict[str, dict] = {}
+        for key in sorted(hists):
+            if first:
+                continue
+            c, s = hists[key]
+            pc, ps = self._prev_hists.get(key, (0.0, 0.0))
+            dc = c - pc
+            if dc > 0:
+                hist[key] = {"n": int(dc),
+                             "mean_s": round((s - ps) / dc, 6)}
+        self._prev_scalars = scalars
+        self._prev_hists = hists
+        beats = {f"{n}/{k}" if k else n: c
+                 for (n, k), c in sorted(self._pending.items())}
+        self._pending = {}
+        recovering = self._update_recovery(deltas, now)
+        rec = {"beat": beat, "seq": self._seq, "dt_s": round(dt, 6),
+               "beats": beats, "rates": rates, "gauges": gauges,
+               "hist": hist}
+        extra = {"recovering": True} if recovering else {}
+        self._journal.event(
+            "telemetry_sample", beat=beat, seq=self._seq,
+            dt_s=round(dt, 6), beats=beats, rates=rates,
+            gauges=gauges, hist=hist, **extra)
+        self._seq += 1
+        self.ring.append(rec)
+        _m_samples.labels(beat=beat).inc()
+        if self._seq > 1:
+            # Detectors need a delta baseline; the first sample is it.
+            self._detect(now, deltas, gauges, hist, recovering)
+
+    def _update_recovery(self, deltas: Dict[str, float],
+                         now: float) -> bool:
+        moved = any(key.startswith(sig) for key in deltas
+                    for sig in RECOVERY_SIGNALS)
+        if moved:
+            self._recovery_until = now + self.recovery_grace_s
+        return moved or now < self._recovery_until
+
+    # -- online detectors (under self._lock) --------------------------
+
+    def _alert(self, now: float, detector: str, beat: str,
+               signal: str, value: float, baseline: float,
+               threshold: float, window: int,
+               recovering: bool) -> None:
+        k = (detector, signal)
+        if now - self._last_alert_t.get(k, float("-inf")) \
+                < self.cooldown_s:
+            return
+        self._last_alert_t[k] = now
+        _m_alerts.labels(detector=detector).inc()
+        extra = {"attributed": "recovery"} if recovering else {}
+        # Into the LIFECYCLE journal, not the telemetry shard: an
+        # alert is a lifecycle fact the incident/health analyzers
+        # correlate against detects and recoveries on one stream.
+        _journal_mod.record(
+            "health_alert", detector=detector, beat=beat,
+            signal=signal, value=round(float(value), 6),
+            baseline=round(float(baseline), 6),
+            threshold=round(float(threshold), 6),
+            window=int(window), **extra)
+        hlog.warning(
+            "telemetry: health_alert %s %s value=%.6g baseline=%.6g "
+            "threshold=%.6g%s", detector, signal, value, baseline,
+            threshold, " (attributed: recovery)" if recovering else "")
+
+    @staticmethod
+    def _median(vals: List[float]) -> float:
+        s = sorted(vals)
+        n = len(s)
+        mid = n // 2
+        return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+    def _med_mad(self, vals: List[float]) -> Tuple[float, float]:
+        med = self._median(vals)
+        mad = self._median([abs(v - med) for v in vals])
+        # MAD floor at 5% of the median: a perfectly regular series
+        # has MAD 0 and would alert on any jitter at all.
+        return med, max(mad, 0.05 * abs(med))
+
+    def _detect(self, now: float, deltas: Dict[str, float],
+                gauges: Dict[str, float], hist: Dict[str, dict],
+                recovering: bool) -> None:
+        self._detect_periods(now, recovering)
+        self._detect_hist_means(now, hist, recovering)
+        self._detect_queues(now, gauges, recovering)
+        self._detect_slo_bursts(now, deltas, recovering)
+        self._detect_staleness(now, gauges, recovering)
+
+    def _detect_periods(self, now: float, recovering: bool) -> None:
+        """Beat-period regression + the stall dual. Period: the last
+        observed inter-beat gap vs rolling median + K*MAD, requiring 3
+        consecutive anomalous samples (one slow GC pause is not a
+        regression). Stall: a known source whose age since its last
+        beat exceeds K*median (floored) — the form a hard-stopped
+        peer takes, since a dead source contributes no more periods
+        for the regression form to see."""
+        for k in sorted(self._periods):
+            dq = self._periods[k]
+            if len(dq) < 4:
+                continue
+            sig = f"{k[0]}/{k[1]}" if k[1] else k[0]
+            vals = list(dq)
+            med, mad = self._med_mad(vals[:-1])
+            thresh = med + self.mad_k * mad
+            cur = vals[-1]
+            run = self._period_anomaly_run.get(sig, 0)
+            run = run + 1 if cur > thresh else 0
+            self._period_anomaly_run[sig] = run
+            if run >= 3:
+                self._period_anomaly_run[sig] = 0
+                self._alert(now, "step_time_regression", k[0],
+                            f"beat_period:{sig}", cur, med, thresh,
+                            len(vals), recovering)
+            age = now - self._last_beat.get(k, now)
+            stall = max(self.mad_k * med, self.stall_floor_s)
+            if age > stall:
+                self._alert(now, "step_time_regression", k[0],
+                            f"beat_stall:{sig}", age, med, stall,
+                            len(vals), recovering)
+
+    def _detect_hist_means(self, now: float, hist: Dict[str, dict],
+                           recovering: bool) -> None:
+        for key in sorted(hist):
+            base = key.split("{", 1)[0]
+            if not base.endswith("_seconds"):
+                continue
+            mean = float(hist[key]["mean_s"])
+            dq = self._hist_series.get(key)
+            if dq is None:
+                dq = self._hist_series[key] = collections.deque(
+                    maxlen=max(4, self.window))
+            if base == "hvd_collective_skew_seconds":
+                # Skew gets the trend detector, not the MAD one: a
+                # straggler grows skew monotonically long before it
+                # breaches any fixed multiple of the baseline.
+                dq.append(mean)
+                vals = list(dq)
+                r = self.trend_run
+                if (len(vals) >= r + 1
+                        and all(vals[-i] > vals[-i - 1]
+                                for i in range(1, r + 1))):
+                    self._alert(now, "collective_skew_trend", "",
+                                f"hist_mean:{key}", mean,
+                                vals[-r - 1], vals[-r - 1], r,
+                                recovering)
+                continue
+            if len(dq) >= 4:
+                med, mad = self._med_mad(list(dq))
+                thresh = med + self.mad_k * mad
+                run = self._hist_anomaly_run.get(key, 0)
+                run = run + 1 if mean > thresh else 0
+                self._hist_anomaly_run[key] = run
+                if run >= 3:
+                    self._hist_anomaly_run[key] = 0
+                    self._alert(now, "step_time_regression", "",
+                                f"hist_mean:{key}", mean, med,
+                                thresh, len(dq), recovering)
+            dq.append(mean)
+
+    def _detect_queues(self, now: float, gauges: Dict[str, float],
+                       recovering: bool) -> None:
+        for key in sorted(gauges):
+            if not key.startswith(("hvd_serving_queue_depth",
+                                   "hvd_decode_queue_depth")):
+                continue
+            v = gauges[key]
+            dq = self._gauge_series.get(key)
+            if dq is None:
+                dq = self._gauge_series[key] = collections.deque(
+                    maxlen=max(4, self.window))
+            dq.append(v)
+            r = self.trend_run
+            vals = list(dq)
+            if (len(vals) >= r + 1 and v >= self.queue_min
+                    and all(vals[-i] > vals[-i - 1]
+                            for i in range(1, r + 1))):
+                self._alert(now, "queue_depth_growth", "",
+                            f"gauge:{key}", v, vals[-r - 1],
+                            self.queue_min, r, recovering)
+
+    def _detect_slo_bursts(self, now: float,
+                           deltas: Dict[str, float],
+                           recovering: bool) -> None:
+        for key in sorted(deltas):
+            if "slo_miss_total" not in key.split("{", 1)[0]:
+                continue
+            d = deltas[key]
+            if d >= self.slo_burst:
+                self._alert(now, "slo_miss_burst", "",
+                            f"rate:{key}", d, 0.0,
+                            float(self.slo_burst), 1, recovering)
+
+    def _detect_staleness(self, now: float,
+                          gauges: Dict[str, float],
+                          recovering: bool) -> None:
+        for key in sorted(gauges):
+            if not key.startswith("hvd_weights_staleness_steps"):
+                continue
+            v = gauges[key]
+            dq = self._gauge_series.get(key)
+            if dq is None:
+                dq = self._gauge_series[key] = collections.deque(
+                    maxlen=max(4, self.window))
+            prev = dq[-1] if dq else None
+            dq.append(v)
+            # Runaway means OBSERVED climbing past the limit: a gauge
+            # that was already high when the recorder armed (and never
+            # moves again) is stuck, not running away.
+            if (prev is not None and v >= self.staleness_limit
+                    and v > prev):
+                self._alert(now, "weight_staleness_runaway", "",
+                            f"gauge:{key}", v, prev,
+                            self.staleness_limit, 1, recovering)
+
+    # -- lifecycle ----------------------------------------------------
+
+    def snapshot_ring(self) -> List[dict]:
+        with self._lock:
+            return list(self.ring)
+
+    def close(self) -> None:
+        self._journal.close()
+
+
+# ---------------------------------------------------------------------------
+# module seam (one recorder per process; disarmed = one load + compare,
+# the faults.fire / journal.record contract)
+# ---------------------------------------------------------------------------
+
+_recorder: Optional[Recorder] = None
+
+
+def enabled() -> bool:
+    return _recorder is not None
+
+
+def get() -> Optional[Recorder]:
+    return _recorder
+
+
+def telemetry_dir(env: Optional[Dict[str, str]] = None) -> str:
+    return _config.env_value("HOROVOD_TELEMETRY_DIR", env=env)
+
+
+def beat(name: str, key: str = "") -> None:
+    """The instrumentation seam hot loops call unconditionally."""
+    r = _recorder
+    if r is None:
+        return
+    r.beat(name, key)
+
+
+def configure(role: str, rank: int = -1,
+              env: Optional[Dict[str, str]] = None
+              ) -> Optional[Recorder]:
+    """(Re)arm this process's recorder; no-op (and disarm-preserving)
+    when HOROVOD_TELEMETRY_DIR is unset. A rank change (elastic
+    reassignment) re-points at the new rank's shard."""
+    global _recorder
+    d = telemetry_dir(env)
+    if not d:
+        return None
+    if _recorder is not None:
+        safe_role = "".join(c if (c.isalnum() or c in "._-") else "_"
+                            for c in role)
+        name = (f"telemetry-{safe_role}.jsonl" if rank < 0
+                else f"telemetry-rank{rank}.jsonl")
+        if _recorder.path == os.path.join(d, name):
+            return _recorder
+        _recorder.close()
+        _recorder = None
+    try:
+        _recorder = Recorder(d, role, rank, env=env)
+    except OSError as e:
+        hlog.warning("telemetry: cannot open shard under %s (%s); "
+                     "telemetry disabled for this process", d, e)
+        _recorder = None
+    return _recorder
+
+
+def disarm() -> None:
+    """Close and detach this process's recorder (bench legs recording
+    into per-leg directories, test hygiene). Safe when already
+    disarmed."""
+    global _recorder
+    if _recorder is not None:
+        _recorder.close()
+        _recorder = None
+
+
+def on_init(cfg, state) -> None:
+    """Worker wiring from common/basics.init: (re)bind the recorder
+    to this rank's shard. Best effort — observability never fails
+    init."""
+    try:
+        configure("worker", state.topology.rank)
+    except Exception as e:  # noqa: BLE001 — observability only
+        hlog.warning("telemetry: init wiring failed (%s); continuing",
+                     e)
+
+
+# ---------------------------------------------------------------------------
+# offline: shard parsing, recovery windows, the health report
+# ---------------------------------------------------------------------------
+
+def find_telemetry_files(dir_: str) -> List[str]:
+    """Telemetry segments under `dir_`, rotated siblings first so
+    each shard's samples stay in write order after the stable sort."""
+    paths = sorted(_glob.glob(os.path.join(dir_,
+                                           "telemetry-*.jsonl")))
+    rotated = sorted(_glob.glob(os.path.join(dir_,
+                                             "telemetry-*.jsonl.1")))
+    return rotated + paths
+
+
+def load_telemetry(dir_: str) -> Tuple[List[dict], List[dict]]:
+    """All telemetry records under `dir_`, time-ordered, plus per-file
+    source descriptors. Raises ValueError when the directory holds no
+    shards (the doctor CLI exit contract)."""
+    events: List[dict] = []
+    sources: List[dict] = []
+    for path in find_telemetry_files(dir_):
+        base = os.path.basename(path)
+        try:
+            evs, dropped = _journal_mod.read_journal(path)
+        except OSError as e:
+            hlog.warning("telemetry: skipping unreadable %s (%s)",
+                         path, e)
+            continue
+        for e in evs:
+            e["_src"] = base
+        events.extend(evs)
+        sources.append({
+            "file": base,
+            "events": len(evs),
+            "repaired_tail_lines": dropped,
+            "roles": sorted({str(e.get("role", "?")) for e in evs}),
+            "ranks": sorted({int(e.get("rank", -1)) for e in evs}),
+        })
+    if not events:
+        raise ValueError(
+            f"no telemetry shards under {dir_!r} (produced by runs "
+            "with HOROVOD_TELEMETRY_DIR set)")
+    events.sort(key=lambda e: (float(e.get("t", 0.0)),
+                               str(e.get("_src", "")),
+                               int(e.get("n", 0))))
+    return events, sources
+
+
+def recovery_windows(journal_events: List[dict]) -> List[dict]:
+    """Merged [t_begin, t_end] windows (absolute `t`) around every
+    journaled recovery anchor, RECOVERY_GRACE_S of slack each side —
+    the offline ground truth the alert timeline is attributed
+    against."""
+    anchors: List[Tuple[float, str]] = []
+    for e in journal_events:
+        ty = str(e.get("type", ""))
+        if ty in RECOVERY_ANCHOR_EVENTS:
+            anchors.append((float(e.get("t", 0.0)), ty))
+    anchors.sort()
+    windows: List[dict] = []
+    for t, ty in anchors:
+        lo, hi = t - RECOVERY_GRACE_S, t + RECOVERY_GRACE_S
+        if windows and lo <= windows[-1]["_hi"]:
+            windows[-1]["_hi"] = max(windows[-1]["_hi"], hi)
+            windows[-1]["anchors"].append(ty)
+        else:
+            windows.append({"_lo": lo, "_hi": hi, "anchors": [ty]})
+    return windows
+
+
+def _in_window(t: float, windows: List[dict]) -> Optional[int]:
+    for i, w in enumerate(windows):
+        if w["_lo"] <= t <= w["_hi"]:
+            return i
+    return None
+
+
+def _series_stats(vals: List[float]) -> dict:
+    s = sorted(vals)
+    n = len(s)
+    mid = n // 2
+    med = s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+    return {"n": n, "min": round(s[0], 6), "max": round(s[-1], 6),
+            "mean": round(sum(s) / n, 6), "median": round(med, 6),
+            "last": round(vals[-1], 6)}
+
+
+def health_report(dir_: str) -> dict:
+    """Fold the telemetry shards (and sibling lifecycle journals)
+    under `dir_` into the health report dict. Byte-deterministic for
+    identical inputs: every float is rounded, every time is relative
+    to the earliest record, every iteration order is sorted."""
+    tel, tel_sources = load_telemetry(dir_)
+    try:
+        jev, _ = _journal_mod.load_journals(dir_)
+    except ValueError:
+        jev = []
+    t0 = float(tel[0].get("t", 0.0))
+    if jev:
+        t0 = min(t0, float(jev[0].get("t", 0.0)))
+    windows = recovery_windows(jev)
+
+    samples = [e for e in tel if e.get("type") == "telemetry_sample"]
+    # Per-signal series, decomposed steady vs recovery by sample time.
+    series: Dict[str, Dict[str, List[float]]] = {}
+
+    def _feed(sig: str, v: float, in_recovery: bool) -> None:
+        buckets = series.setdefault(sig, {"all": [], "steady": [],
+                                          "recovery": []})
+        buckets["all"].append(float(v))
+        buckets["recovery" if in_recovery else "steady"].append(
+            float(v))
+
+    beat_totals: Dict[str, int] = {}
+    n_recovery_samples = 0
+    for s in samples:
+        t = float(s.get("t", 0.0))
+        in_rec = (_in_window(t, windows) is not None
+                  or bool(s.get("recovering")))
+        if in_rec:
+            n_recovery_samples += 1
+        for key in sorted(dict(s.get("rates") or {})):
+            _feed(f"rate:{key}", (s.get("rates") or {})[key], in_rec)
+        for key in sorted(dict(s.get("gauges") or {})):
+            _feed(f"gauge:{key}", (s.get("gauges") or {})[key],
+                  in_rec)
+        for key in sorted(dict(s.get("hist") or {})):
+            _feed(f"hist_mean:{key}",
+                  float((s.get("hist") or {})[key].get("mean_s",
+                                                       0.0)),
+                  in_rec)
+        for bk in sorted(dict(s.get("beats") or {})):
+            beat_totals[bk] = (beat_totals.get(bk, 0)
+                               + int((s.get("beats") or {})[bk]))
+
+    signals = {}
+    for sig in sorted(series):
+        b = series[sig]
+        entry = {"all": _series_stats(b["all"])}
+        if b["steady"]:
+            entry["steady"] = _series_stats(b["steady"])
+        if b["recovery"]:
+            entry["recovery"] = _series_stats(b["recovery"])
+        signals[sig] = entry
+
+    # Alert timeline from the lifecycle journals, each alert tagged
+    # with its runtime attribution and the offline window (if any) it
+    # falls inside; an anomaly is an alert neither explains.
+    alerts = []
+    n_attr = 0
+    for e in jev:
+        if e.get("type") != "health_alert":
+            continue
+        t = float(e.get("t", 0.0))
+        widx = _in_window(t, windows)
+        attributed = e.get("attributed")
+        anomaly = attributed is None and widx is None
+        if not anomaly:
+            n_attr += 1
+        alerts.append({
+            "t": round(t - t0, 6),
+            "rank": int(e.get("rank", -1)),
+            "detector": str(e.get("detector", "")),
+            "signal": str(e.get("signal", "")),
+            "value": e.get("value"),
+            "baseline": e.get("baseline"),
+            "threshold": e.get("threshold"),
+            "attributed": attributed,
+            "recovery_window": widx,
+            "anomaly": anomaly,
+        })
+
+    win_out = [{"t_begin": round(w["_lo"] - t0, 6),
+                "t_end": round(w["_hi"] - t0, 6),
+                "anchors": sorted(set(w["anchors"]))}
+               for w in windows]
+    report = {
+        "schema": HEALTH_REPORT_SCHEMA,
+        "sources": tel_sources,
+        "samples": len(samples),
+        "recovery_grace_s": RECOVERY_GRACE_S,
+        "recovery_windows": win_out,
+        "beats": {k: beat_totals[k] for k in sorted(beat_totals)},
+        "signals": signals,
+        "alerts": alerts,
+        "summary": {
+            "samples": len(samples),
+            "steady_samples": len(samples) - n_recovery_samples,
+            "recovery_samples": n_recovery_samples,
+            "signals": len(signals),
+            "alerts": len(alerts),
+            "attributed_alerts": n_attr,
+            "anomalies": len(alerts) - n_attr,
+            "recovery_windows": len(win_out),
+        },
+    }
+    return report
+
+
+def write_health_report(dir_: str, out: Optional[str] = None
+                        ) -> Tuple[str, dict]:
+    """health_report + the canonical byte encoding (indent=1,
+    sort_keys, trailing newline — the committed-artifact regeneration
+    contract shared with the incident/serving reports)."""
+    report = health_report(dir_)
+    path = out or os.path.join(dir_, "health_report.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path, report
+
+
+def render_health_report(report: dict) -> str:
+    s = report.get("summary", {})
+    lines = [
+        "health report "
+        f"({report.get('schema', '?')}): {s.get('samples', 0)} "
+        f"samples, {s.get('signals', 0)} signals, "
+        f"{s.get('alerts', 0)} alerts "
+        f"({s.get('anomalies', 0)} anomalies, "
+        f"{s.get('attributed_alerts', 0)} attributed), "
+        f"{s.get('recovery_windows', 0)} recovery windows",
+        "",
+        "signals (steady-state mean -> recovery mean):",
+    ]
+    signals = report.get("signals", {})
+    for sig in sorted(signals):
+        entry = signals[sig]
+        steady = entry.get("steady", {}).get("mean")
+        rec = entry.get("recovery", {}).get("mean")
+        lines.append(
+            f"  {sig}: n={entry['all']['n']} "
+            f"median={entry['all']['median']} "
+            f"steady={steady if steady is not None else '-'} "
+            f"recovery={rec if rec is not None else '-'}")
+    alerts = report.get("alerts", [])
+    if alerts:
+        lines += ["", "alert timeline:"]
+        for a in alerts:
+            tag = ("ANOMALY" if a.get("anomaly") else
+                   f"attributed:{a.get('attributed') or 'window'}")
+            lines.append(
+                f"  +{a['t']:.3f}s rank{a['rank']} "
+                f"{a['detector']} {a['signal']} "
+                f"value={a['value']} baseline={a['baseline']} "
+                f"[{tag}]")
+    else:
+        lines += ["", "alert timeline: (none)"]
+    return "\n".join(lines)
+
+
+def health_digest(dir_: Optional[str] = None) -> dict:
+    """Small summary for bench doc blocks: {'enabled': False} when no
+    telemetry was recorded, else sample/alert/anomaly counts from the
+    shards under `dir_` (default: this process's telemetry dir)."""
+    d = dir_ if dir_ is not None else telemetry_dir()
+    if not d or not find_telemetry_files(d):
+        return {"enabled": False}
+    try:
+        report = health_report(d)
+    except (OSError, ValueError):
+        return {"enabled": False}
+    s = report["summary"]
+    by_det: Dict[str, int] = {}
+    for a in report["alerts"]:
+        by_det[a["detector"]] = by_det.get(a["detector"], 0) + 1
+    return {
+        "enabled": True,
+        "samples": s["samples"],
+        "signals": s["signals"],
+        "alerts": s["alerts"],
+        "anomalies": s["anomalies"],
+        "attributed_alerts": s["attributed_alerts"],
+        "alerts_by_detector": {k: by_det[k] for k in sorted(by_det)},
+    }
+
+
+# hvdlint HVD009 patrols everything reachable from these for
+# nondeterminism (wall clock, unseeded RNG, set iteration, unsorted
+# globs): the committed health recordings must regenerate
+# byte-identically forever.
+DETERMINISTIC_ENTRYPOINTS = (
+    "health_report", "write_health_report", "render_health_report",
+    "health_digest",
+)

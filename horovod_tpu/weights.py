@@ -66,6 +66,7 @@ import numpy as np
 
 from . import faults as _faults
 from . import journal as _journal
+from . import telemetry as _telemetry
 from .common import config as _config
 from .common import logging as hlog
 from .metrics import REGISTRY as _METRICS
@@ -606,6 +607,9 @@ def note_adopted(worker: str, version: WeightVersion, swap_s: float,
     _m_swap_s.observe(swap_s)
     _m_staleness.labels(worker=worker).set(float(
         max(0, staleness_steps)))
+    # Telemetry beat AFTER the gauges moved so the sample this beat
+    # may trigger already sees the fresh staleness/adoption values.
+    _telemetry.beat("weights", key=worker)
     _journal.record(
         "weights_adopted", worker=worker, digest=version.digest,
         seq=version.seq, step=version.step,

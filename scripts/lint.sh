@@ -88,7 +88,7 @@ if [ "$CHANGED_ONLY" = "1" ]; then
                  git ls-files --others --exclude-standard 2>/dev/null; } \
                | sort -u )
     if ! printf '%s\n' "$changed" | grep -qE \
-        '^horovod_tpu/(parallel/|ops/bucketing\.py|ops/compression\.py|numerics\.py|serving\.py|serving_trace\.py|decoding\.py|weights\.py|analysis/)'
+        '^horovod_tpu/(parallel/|ops/bucketing\.py|ops/compression\.py|numerics\.py|serving\.py|serving_trace\.py|decoding\.py|weights\.py|telemetry\.py|analysis/)'
     then
         run_jaxpr=0
         echo "== hvdlint (jaxpr tier): skipped (no semantic-tier files changed) =="
@@ -114,7 +114,7 @@ if [ "$CHANGED_ONLY" = "1" ]; then
                  git ls-files --others --exclude-standard 2>/dev/null; } \
                | sort -u )
     if ! printf '%s\n' "$changed" | grep -qE \
-        '^(horovod_tpu/(journal\.py|serving_trace\.py|serving\.py|decoding\.py|weights\.py|faults\.py|numerics\.py|tracing\.py|elastic/|runner/|analysis/|common/config\.py)|docs/user_guide\.md)'
+        '^(horovod_tpu/(journal\.py|serving_trace\.py|serving\.py|decoding\.py|weights\.py|telemetry\.py|faults\.py|numerics\.py|tracing\.py|elastic/|runner/|analysis/|common/config\.py)|docs/user_guide\.md)'
     then
         run_events=0
         echo "== hvdlint (event-schema tier): skipped (no journal-surface files changed) =="

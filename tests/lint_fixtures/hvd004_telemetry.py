@@ -1,0 +1,61 @@
+"""HVD004 fixture: telemetry beats inside traced functions (round 20).
+
+telemetry.py's contract is the journal's: the beat seam, the sampling
+it may trigger (a metrics-registry snapshot plus a shard write) and
+the detector alerts all live in the UNTRACED loops around the
+compiled step — the serving batch loop, the decode engine loop, the
+elastic commit boundary. The positives are the tempting wrong
+version: beating (or arming) the recorder from inside a jitted step,
+which would record exactly one phantom sample per retrace and pay a
+registry snapshot + fsync'd shard write at trace time. The negatives
+are the engine-loop shape the planes actually use: a pure jitted
+step with the beat wrapping it from plain python.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from horovod_tpu import telemetry
+
+
+@jax.jit
+def train_step_beats_inside(params, grads):
+    telemetry.beat("commit")  # EXPECT: HVD004
+    return jax.tree_util.tree_map(
+        lambda p, g: p - 0.1 * g, params, grads)
+
+
+@jax.jit
+def decode_step_beats_per_worker(kv, tokens):
+    from horovod_tpu import telemetry as _telemetry
+    _telemetry.beat("decode", key="w0")  # EXPECT: HVD004
+    return kv.at[0].set(0.0), tokens + 1
+
+
+@jax.jit
+def serving_step_arms_recorder(x):
+    telemetry.configure("serving")  # EXPECT: HVD004
+    return x * 2.0
+
+
+# -- negatives: the loop shape the planes actually use ---------------------
+
+@jax.jit
+def pure_step(params, grads):
+    """The real traced-step shape: pure pytree math, no seams."""
+    return jax.tree_util.tree_map(
+        lambda p, g: p - 0.1 * g, params, grads)
+
+
+def commit_loop_beats_outside_trace(params, grads):
+    # The intended split: the compiled step is pure; the beat ticks
+    # the telemetry plane from plain python at the commit boundary.
+    new_params = pure_step(params, grads)
+    telemetry.beat("commit")
+    return new_params
+
+
+def engine_loop_beats_per_tick(kv, tokens, wid):
+    kv2 = jnp.asarray(kv) * 1.0
+    telemetry.beat("decode", key=wid)
+    return kv2, tokens

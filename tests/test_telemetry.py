@@ -1,0 +1,654 @@
+"""Continuous health telemetry tests: recorder shard discipline
+(meta-first, schema-valid records, rates from counter deltas, bounded
+ring, rotation, torn-tail tolerance), the disarmed one-load fast path
+and its overhead guard, the online detectors on seeded synthetic
+series (regression caught, steady series silent, runtime recovery
+attribution, stall dual, cooldown), the offline `doctor health`
+analyzer (byte determinism, journal-anchored recovery-window
+attribution, CLI exit contract), a live decode chaos leg whose
+injected crash must alert AND be attributed to the recovery, and the
+committed r20 recording's byte-identity pins."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from horovod_tpu import decoding, faults, journal, telemetry
+from horovod_tpu.metrics import REGISTRY
+from horovod_tpu.runner import doctor
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HEALTH_DIR = os.path.join(REPO, "benchmarks", "health_r20")
+HEALTH_BENCH = os.path.join(REPO, "benchmarks",
+                            "BENCH_health_r20.json")
+TRAJECTORY = os.path.join(REPO, "benchmarks", "BENCH_trajectory.json")
+COMMITTED_JOURNAL_DIRS = (
+    "incident_chaos_r11", "incident_preempt_r14",
+    "serving_trace_r16", "serving_decode_r18",
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Recorder, journal and fault plan are process-global seams;
+    restore all three so state never leaks across tests."""
+    yield
+    faults.configure("", seed=0)
+    telemetry.disarm()
+    journal.disarm()
+
+
+def _env(tmp_path, **over):
+    d = os.path.join(str(tmp_path), "rec")
+    os.makedirs(d, exist_ok=True)
+    env = {
+        "HOROVOD_TELEMETRY_DIR": d,
+        "HOROVOD_TELEMETRY_INTERVAL_S": "0",
+        "HOROVOD_JOURNAL_DIR": d,
+        # Defaults for tests that are NOT about the wall-clock
+        # detectors: tight python loops have genuinely jittery beat
+        # periods, so park the MAD/stall thresholds out of reach and
+        # let each detector test re-arm the one it targets.
+        "HOROVOD_TELEMETRY_STEP_MAD_K": "1e9",
+        "HOROVOD_TELEMETRY_STALL_FLOOR_S": "1e9",
+    }
+    env.update({k: str(v) for k, v in over.items()})
+    return env, d
+
+
+def _arm(tmp_path, rank=0, **over):
+    env, d = _env(tmp_path, **over)
+    journal.configure("worker", rank, env=env)
+    rec = telemetry.configure("worker", rank, env=env)
+    assert rec is not None
+    return rec, d
+
+
+def _shard_events(d, rank=0):
+    evs, dropped = journal.read_journal(
+        os.path.join(d, f"telemetry-rank{rank}.jsonl"))
+    return evs, dropped
+
+
+def _alerts(d):
+    evs, _ = journal.load_journals(d)
+    return [e for e in evs if e.get("type") == "health_alert"]
+
+
+class TestRecorder:
+    def test_disarmed_beat_is_inert(self):
+        assert not telemetry.enabled()
+        telemetry.beat("commit")          # must not raise
+        telemetry.beat("decode", key="w0")
+
+    def test_disarmed_fast_path_overhead(self):
+        """The unconditional-call contract: disarmed beat() is one
+        module load + compare, cheap enough for hot loops."""
+        assert telemetry.get() is None
+        t0 = time.perf_counter()
+        for _ in range(100_000):
+            telemetry.beat("decode", key="w0")
+        dt = time.perf_counter() - t0
+        assert dt < 1.0, f"100k disarmed beats took {dt:.3f}s"
+
+    def test_meta_first_and_records_schema_valid(self, tmp_path):
+        rec, d = _arm(tmp_path)
+        c = REGISTRY.counter("hvdtest_tel_ticks_total", "seeded")
+        for _ in range(5):
+            c.inc()
+            telemetry.beat("commit")
+        telemetry.disarm()
+        evs, dropped = _shard_events(d)
+        assert dropped == 0
+        # journal_meta (the Journal writer's own anchor record) then
+        # telemetry_meta, then samples — and every record validates
+        # against the declared EVENT_SCHEMAS.
+        types = [e["type"] for e in evs]
+        assert types[0] == "journal_meta"
+        assert types[1] == "telemetry_meta"
+        assert types.count("telemetry_sample") == 5
+        for e in evs:
+            assert journal.validate_event(e) == [], e["type"]
+        meta = evs[1]
+        assert meta["schema"] == telemetry.TELEMETRY_SCHEMA
+        assert meta["interval_s"] == 0.0
+
+    def test_counter_deltas_become_rates(self, tmp_path):
+        rec, d = _arm(tmp_path)
+        c = REGISTRY.counter("hvdtest_tel_rate_total", "seeded")
+        telemetry.beat("commit")          # baseline sample
+        c.inc(10)
+        time.sleep(0.05)                  # a measurable dt
+        telemetry.beat("commit")
+        telemetry.disarm()
+        evs, _ = _shard_events(d)
+        samples = [e for e in evs if e["type"] == "telemetry_sample"]
+        assert len(samples) == 2
+        last = samples[-1]
+        key = "hvdtest_tel_rate_total"
+        assert last["rates"][key] > 0
+        assert last["dt_s"] > 0
+        # rate * dt recovers the delta
+        assert last["rates"][key] * last["dt_s"] == pytest.approx(
+            10.0, rel=0.05)
+        # per-beat counts since the previous sample
+        assert last["beats"] == {"commit": 1}
+
+    def test_gauges_recorded_raw(self, tmp_path):
+        rec, d = _arm(tmp_path)
+        g = REGISTRY.gauge("hvdtest_tel_depth", "seeded")
+        g.set(7.5)
+        telemetry.beat("serving")
+        telemetry.disarm()
+        evs, _ = _shard_events(d)
+        s = [e for e in evs if e["type"] == "telemetry_sample"][-1]
+        assert s["gauges"]["hvdtest_tel_depth"] == 7.5
+
+    def test_hist_deltas_mean(self, tmp_path):
+        rec, d = _arm(tmp_path)
+        h = REGISTRY.histogram("hvdtest_tel_lat_seconds", "seeded")
+        telemetry.beat("commit")
+        h.observe(0.2)
+        h.observe(0.4)
+        telemetry.beat("commit")
+        telemetry.disarm()
+        evs, _ = _shard_events(d)
+        s = [e for e in evs if e["type"] == "telemetry_sample"][-1]
+        ent = s["hist"]["hvdtest_tel_lat_seconds"]
+        assert ent["n"] == 2
+        assert ent["mean_s"] == pytest.approx(0.3, abs=1e-6)
+
+    def test_ring_bounded(self, tmp_path):
+        rec, d = _arm(tmp_path, HOROVOD_TELEMETRY_RING=8)
+        for _ in range(40):
+            telemetry.beat("commit")
+        ring = rec.snapshot_ring()
+        assert len(ring) == 8
+        assert ring[-1]["seq"] == 39
+
+    def test_rotation_rolls_to_sibling(self, tmp_path):
+        rec, d = _arm(tmp_path)
+        rec._journal._rotate_bytes = 4096
+        for _ in range(200):
+            telemetry.beat("commit")
+        telemetry.disarm()
+        assert os.path.exists(
+            os.path.join(d, "telemetry-rank0.jsonl.1"))
+        # rotated sibling + live segment both load, time-ordered; the
+        # latest sample survives (older rotated-away segments may not)
+        evs, _ = telemetry.load_telemetry(d)
+        seqs = [e["seq"] for e in evs
+                if e["type"] == "telemetry_sample"]
+        assert seqs and seqs == sorted(seqs)
+        assert seqs[-1] == 199
+
+    def test_interval_batches_beats(self, tmp_path):
+        rec, d = _arm(tmp_path, HOROVOD_TELEMETRY_INTERVAL_S=3600)
+        for _ in range(50):
+            telemetry.beat("decode", key="w0")
+        telemetry.disarm()
+        evs, _ = _shard_events(d)
+        samples = [e for e in evs if e["type"] == "telemetry_sample"]
+        assert len(samples) == 1  # the first beat's baseline sample
+
+    def test_configure_unset_dir_noop(self):
+        assert telemetry.configure("worker", 0, env={}) is None
+        assert not telemetry.enabled()
+
+
+class TestDetectors:
+    def test_step_time_regression_caught(self, tmp_path):
+        """Seeded synthetic series: a stable histogram mean that
+        steps up must alert within 3 anomalous samples."""
+        rec, d = _arm(tmp_path, HOROVOD_TELEMETRY_STEP_MAD_K="8")
+        h = REGISTRY.histogram("hvdtest_reg_step_seconds", "seeded")
+        for _ in range(8):                 # baseline
+            h.observe(0.1)
+            telemetry.beat("bench")
+        for _ in range(4):                 # regression
+            h.observe(1.0)
+            telemetry.beat("bench")
+        telemetry.disarm()
+        hits = [a for a in _alerts(d)
+                if a["signal"]
+                == "hist_mean:hvdtest_reg_step_seconds"]
+        assert hits, f"no regression alert in {_alerts(d)}"
+        a = hits[0]
+        assert a["detector"] == "step_time_regression"
+        assert a["value"] > a["threshold"] > a["baseline"]
+        assert "attributed" not in a       # steady state: an anomaly
+
+    def test_steady_series_zero_false_alerts(self, tmp_path):
+        """Seeded jitter around a stable mean stays silent."""
+        import random
+        rng = random.Random(20)
+        rec, d = _arm(tmp_path, HOROVOD_TELEMETRY_STEP_MAD_K="8")
+        h = REGISTRY.histogram("hvdtest_steady_seconds", "seeded")
+        for _ in range(64):
+            h.observe(0.1 + rng.uniform(-0.004, 0.004))
+            telemetry.beat("bench")
+        telemetry.disarm()
+        assert [a for a in _alerts(d)
+                if a["signal"]
+                == "hist_mean:hvdtest_steady_seconds"] == []
+
+    def test_beat_stall_detected(self, tmp_path):
+        """A source that stops beating is caught by its peers'
+        samples — the form a hard-stopped worker takes."""
+        rec, d = _arm(tmp_path, HOROVOD_TELEMETRY_STEP_MAD_K="8",
+                      HOROVOD_TELEMETRY_STALL_FLOOR_S="0.05")
+        for _ in range(10):
+            telemetry.beat("decode", key="a")
+            telemetry.beat("decode", key="b")
+            time.sleep(0.002)
+        time.sleep(0.3)                    # b dies; a keeps ticking
+        for _ in range(3):
+            telemetry.beat("decode", key="a")
+            time.sleep(0.002)
+        telemetry.disarm()
+        sigs = {a["signal"] for a in _alerts(d)}
+        assert "beat_stall:decode/b" in sigs
+        assert "beat_stall:decode/a" not in sigs
+
+    def test_queue_growth_alerts_with_floor(self, tmp_path):
+        rec, d = _arm(tmp_path, HOROVOD_TELEMETRY_QUEUE_MIN=8,
+                      HOROVOD_TELEMETRY_TREND_RUN=3)
+        g = REGISTRY.gauge("hvd_serving_queue_depth", "depth")
+        for v in [1, 2, 3, 2, 3, 4]:       # grows but under floor
+            g.set(float(v))
+            telemetry.beat("serving")
+        assert _alerts(d) == []
+        for v in [6, 9, 12, 15]:           # grows past the floor
+            g.set(float(v))
+            telemetry.beat("serving")
+        telemetry.disarm()
+        g.set(0.0)                      # don't leak into later tests
+        hits = [a for a in _alerts(d)
+                if a["detector"] == "queue_depth_growth"]
+        assert hits and hits[0]["value"] >= 8
+
+    def test_slo_burst_alerts(self, tmp_path):
+        rec, d = _arm(tmp_path, HOROVOD_TELEMETRY_SLO_BURST=5)
+        c = REGISTRY.counter("hvdtest_tel_slo_miss_total", "seeded",
+                             ("slo",))
+        telemetry.beat("serving")          # baseline
+        c.labels(slo="interactive").inc(2)
+        telemetry.beat("serving")          # under burst: silent
+        assert _alerts(d) == []
+        c.labels(slo="interactive").inc(7)
+        telemetry.beat("serving")
+        telemetry.disarm()
+        hits = [a for a in _alerts(d)
+                if a["detector"] == "slo_miss_burst"]
+        assert hits and hits[0]["value"] == 7.0
+
+    def test_staleness_runaway_alerts(self, tmp_path):
+        rec, d = _arm(tmp_path,
+                      HOROVOD_TELEMETRY_STALENESS_LIMIT=50)
+        g = REGISTRY.gauge("hvd_weights_staleness_steps", "lag",
+                           ("worker",))
+        for v in [10, 30, 49]:
+            g.labels(worker="w0").set(float(v))
+            telemetry.beat("weights", key="w0")
+        assert _alerts(d) == []
+        g.labels(worker="w0").set(80.0)
+        telemetry.beat("weights", key="w0")
+        telemetry.disarm()
+        g.labels(worker="w0").set(0.0)
+        hits = [a for a in _alerts(d)
+                if a["detector"] == "weight_staleness_runaway"]
+        assert hits and hits[0]["value"] == 80.0
+
+    def test_stuck_high_gauge_is_not_runaway(self, tmp_path):
+        """A staleness gauge already past the limit when the recorder
+        arms (and never climbing again) must NOT alert: runaway means
+        observed climbing, not a stale leftover level."""
+        g = REGISTRY.gauge("hvd_weights_staleness_steps", "lag",
+                           ("worker",))
+        g.labels(worker="w0").set(80.0)
+        rec, d = _arm(tmp_path,
+                      HOROVOD_TELEMETRY_STALENESS_LIMIT=50)
+        for _ in range(6):
+            telemetry.beat("weights", key="w0")
+        telemetry.disarm()
+        g.labels(worker="w0").set(0.0)
+        assert _alerts(d) == []
+
+    def test_runtime_recovery_attribution(self, tmp_path):
+        """An alert raised while a recovery signal is moving carries
+        attributed="recovery" — expected fallout, not an anomaly."""
+        rec, d = _arm(tmp_path, HOROVOD_TELEMETRY_STEP_MAD_K="8")
+        h = REGISTRY.histogram("hvdtest_attr_step_seconds", "seeded")
+        recov = REGISTRY.counter("hvd_recoveries_total",
+                                 "recoveries", ("cause",))
+        for _ in range(8):
+            h.observe(0.1)
+            telemetry.beat("bench")
+        recov.labels(cause="crash").inc()  # recovery in flight
+        for _ in range(4):
+            h.observe(1.0)
+            telemetry.beat("bench")
+        telemetry.disarm()
+        hits = [a for a in _alerts(d)
+                if a["signal"]
+                == "hist_mean:hvdtest_attr_step_seconds"]
+        assert hits
+        assert all(a.get("attributed") == "recovery" for a in hits)
+
+    def test_prearm_recovery_totals_are_history(self, tmp_path):
+        """A recovery counter that was already nonzero when the
+        recorder armed is history, not a recovery in flight: the
+        baseline sample must not treat pre-arm totals as deltas, or
+        every alert in the first grace period gets falsely attributed
+        (the long-lived-process shape: telemetry armed mid-life)."""
+        recov = REGISTRY.counter("hvd_recoveries_total",
+                                 "recoveries", ("cause",))
+        recov.labels(cause="crash").inc()  # ancient, pre-arm
+        rec, d = _arm(tmp_path, HOROVOD_TELEMETRY_STEP_MAD_K="8")
+        h = REGISTRY.histogram("hvdtest_hist_step_seconds", "seeded")
+        for _ in range(8):
+            h.observe(0.1)
+            telemetry.beat("bench")
+        for _ in range(4):
+            h.observe(1.0)
+            telemetry.beat("bench")
+        telemetry.disarm()
+        hits = [a for a in _alerts(d)
+                if a["signal"]
+                == "hist_mean:hvdtest_hist_step_seconds"]
+        assert hits
+        assert all("attributed" not in a for a in hits)
+
+    def test_alert_cooldown(self, tmp_path):
+        rec, d = _arm(tmp_path, HOROVOD_TELEMETRY_SLO_BURST=1,
+                      HOROVOD_TELEMETRY_ALERT_COOLDOWN_S=3600)
+        c = REGISTRY.counter("hvdtest_cool_slo_miss_total", "seeded")
+        telemetry.beat("serving")
+        for _ in range(6):                 # persisting burst
+            c.inc(5)
+            telemetry.beat("serving")
+        telemetry.disarm()
+        hits = [a for a in _alerts(d)
+                if a["signal"] == "rate:hvdtest_cool_slo_miss_total"]
+        assert len(hits) == 1              # cooled down, not flooded
+
+
+class TestOfflineReport:
+    def _synthetic(self, d):
+        """Hand-written shards with controlled timestamps: a steady
+        run, one journaled fault at t=100 with an attributed alert
+        beside it, and one far-from-anything anomaly at t=200."""
+        def w(path, recs):
+            with open(os.path.join(d, path), "w") as f:
+                for i, r in enumerate(recs):
+                    r.setdefault("role", "worker")
+                    r.setdefault("rank", 0)
+                    r.setdefault("pid", 1)
+                    r.setdefault("mono_ns", int(r["t"] * 1e9))
+                    r["n"] = i
+                    f.write(json.dumps(r, sort_keys=True) + "\n")
+        samples = [{"type": "telemetry_sample", "t": 10.0 + i,
+                    "beat": "commit", "seq": i, "dt_s": 1.0,
+                    "beats": {"commit": 1},
+                    "rates": {"hvd_x_total": 4.0},
+                    "gauges": {"hvd_depth": float(i % 3)},
+                    "hist": {"hvd_step_seconds":
+                             {"n": 1, "mean_s": 0.1}}}
+                   for i in range(200)]
+        meta = [{"type": "telemetry_meta", "t": 9.0,
+                 "schema": telemetry.TELEMETRY_SCHEMA,
+                 "anchor_mono_ns": 0, "anchor_unix": 9.0,
+                 "host": "h", "interval_s": 1.0, "ring": 512}]
+        w("telemetry-rank0.jsonl", meta + samples)
+        alert = {"detector": "step_time_regression", "beat": "commit",
+                 "signal": "hist_mean:hvd_step_seconds",
+                 "value": 1.0, "baseline": 0.1, "threshold": 0.2,
+                 "window": 16}
+        jrecs = [
+            {"type": "fault_fired", "t": 100.0, "point": "x",
+             "action": "error"},
+            dict(alert, type="health_alert", t=102.0),
+            dict(alert, type="health_alert", t=200.0),
+        ]
+        w("journal-rank0.jsonl", jrecs)
+
+    def test_window_attribution_and_anomaly(self, tmp_path):
+        d = str(tmp_path)
+        self._synthetic(d)
+        rep = telemetry.health_report(d)
+        assert rep["summary"]["alerts"] == 2
+        assert rep["summary"]["attributed_alerts"] == 1
+        assert rep["summary"]["anomalies"] == 1
+        attributed = [a for a in rep["alerts"]
+                      if not a["anomaly"]]
+        assert attributed[0]["recovery_window"] == 0
+        wins = rep["recovery_windows"]
+        assert len(wins) == 1
+        assert wins[0]["anchors"] == ["fault_fired"]
+        # grace is the FIXED analyzer constant, not an env knob
+        assert (wins[0]["t_end"] - wins[0]["t_begin"]
+                == pytest.approx(2 * telemetry.RECOVERY_GRACE_S))
+
+    def test_steady_vs_recovery_decomposition(self, tmp_path):
+        d = str(tmp_path)
+        self._synthetic(d)
+        rep = telemetry.health_report(d)
+        sig = rep["signals"]["hist_mean:hvd_step_seconds"]
+        assert sig["all"]["n"] == 200
+        # samples inside the fault window decompose into "recovery"
+        assert sig["recovery"]["n"] > 0
+        assert (sig["steady"]["n"] + sig["recovery"]["n"]
+                == sig["all"]["n"])
+        assert rep["beats"] == {"commit": 200}
+
+    def test_byte_determinism(self, tmp_path):
+        d = str(tmp_path)
+        self._synthetic(d)
+        p1, _ = telemetry.write_health_report(d)
+        with open(p1, "rb") as f:
+            b1 = f.read()
+        p2, _ = telemetry.write_health_report(
+            d, out=os.path.join(d, "again.json"))
+        with open(p2, "rb") as f:
+            assert b1 == f.read()
+        raw = b1.decode()
+        assert d not in raw                # no absolute paths
+
+    def test_torn_tail_tolerated(self, tmp_path):
+        d = str(tmp_path)
+        self._synthetic(d)
+        with open(os.path.join(d, "telemetry-rank0.jsonl"),
+                  "a") as f:
+            f.write('{"type": "telemetry_sample", "t": 999')  # torn
+        rep = telemetry.health_report(d)
+        assert rep["sources"][0]["repaired_tail_lines"] == 1
+        assert rep["summary"]["samples"] == 200
+
+    def test_no_shards_raises(self, tmp_path):
+        with pytest.raises(ValueError, match="no telemetry shards"):
+            telemetry.health_report(str(tmp_path))
+
+    def test_render_mentions_anomaly(self, tmp_path):
+        d = str(tmp_path)
+        self._synthetic(d)
+        txt = telemetry.render_health_report(
+            telemetry.health_report(d))
+        assert "ANOMALY" in txt
+        assert "attributed" in txt
+
+    def test_health_digest_disarmed_and_armed(self, tmp_path):
+        assert telemetry.health_digest(str(tmp_path)) \
+            == {"enabled": False}
+        d = str(tmp_path)
+        self._synthetic(d)
+        dig = telemetry.health_digest(d)
+        assert dig["enabled"] is True
+        assert dig["samples"] == 200
+        assert dig["alerts_by_detector"] \
+            == {"step_time_regression": 2}
+
+
+class TestDoctorHealthCLI:
+    def test_exit_contract(self, tmp_path, capsys):
+        assert doctor.main(["health", "/nonexistent"]) == 1
+        assert "doctor health:" in capsys.readouterr().out
+        assert doctor.main(["health", str(tmp_path)]) == 1
+        assert "doctor health:" in capsys.readouterr().out
+
+    def test_success_prints_report_path(self, tmp_path, capsys):
+        d = str(tmp_path)
+        TestOfflineReport()._synthetic(d)
+        assert doctor.main(["health", d]) == 0
+        out = capsys.readouterr().out
+        assert "health report" in out
+        assert "report: " in out
+        assert os.path.exists(os.path.join(d, "health_report.json"))
+
+
+def _decode_env(tmp_path, **over):
+    d = os.path.join(str(tmp_path), "rec")
+    os.makedirs(d, exist_ok=True)
+    env = {
+        "HOROVOD_KV_PAGE_TOKENS": "8",
+        "HOROVOD_KV_MAX_CONTEXT": "64",
+        "HOROVOD_SERVING_DECODE_SLOTS": "4",
+        "HOROVOD_SERVING_DECODE_MAX_NEW_TOKENS": "16",
+        "HOROVOD_SERVING_DECODE_WATERMARK_STRIDE": "4",
+        "HOROVOD_SERVING_DECODE_LEASE_TIMEOUT_S": "2.0",
+        "HOROVOD_SERVING_DECODE_RETRY_BACKOFF_MS": "5",
+        "HOROVOD_JOURNAL_DIR": d,
+        "HOROVOD_TELEMETRY_DIR": d,
+        "HOROVOD_TELEMETRY_INTERVAL_S": "0",
+    }
+    env.update({k: str(v) for k, v in over.items()})
+    return env, d
+
+
+class TestChaosAttribution:
+    def test_steady_decode_run_zero_alerts(self, tmp_path):
+        """Healthy single-worker decode drain: telemetry records the
+        run but no detector fires (tuned-but-plausible thresholds)."""
+        env, d = _decode_env(tmp_path,
+                             HOROVOD_TELEMETRY_STEP_MAD_K="30",
+                             HOROVOD_TELEMETRY_STALL_FLOOR_S="5.0")
+        fe = decoding.DecodeFrontend(workers=1, env=env,
+                                     trace_tag="steady")
+        try:
+            futs = [fe.submit([1, 2, 3], max_new_tokens=24, seed=s)
+                    for s in range(4)]
+            for f in futs:
+                list(f.result(timeout=120))
+        finally:
+            fe.close()
+        telemetry.disarm()
+        journal.disarm()
+        assert _alerts(d) == []
+        rep = telemetry.health_report(d)
+        assert rep["summary"]["samples"] > 0
+        assert rep["summary"]["anomalies"] == 0
+
+    def test_injected_hang_alerts_and_attributes(self, tmp_path):
+        """The tentpole chaos leg: an injected decode.step hang
+        parks the victim past the lease timeout, so its beats stall
+        while the survivor keeps sampling; those samples raise a
+        beat_stall health_alert, and the attribution paths (runtime
+        recovery flag from the moved fault counter, offline
+        journal-anchored windows) explain it — zero anomalies in the
+        final report. (An in-process *error* is detected and resumed
+        immediately, leaving no stall window — the hang is the shape
+        the stall detector exists for.)"""
+        env, d = _decode_env(tmp_path,
+                             HOROVOD_TELEMETRY_STEP_MAD_K="10")
+        faults.configure("decode.step:hang:at=12", seed=0)
+        fe = decoding.DecodeFrontend(workers=2, env=env,
+                                     trace_tag="chaos")
+        fe.start_watchdog()
+        try:
+            futs = [fe.submit([1, 2, 3], max_new_tokens=40, seed=s)
+                    for s in range(2)]
+            for f in futs:
+                list(f.result(timeout=120))
+            assert fe.stats()["resumed"] >= 1
+        finally:
+            fe.close()
+        telemetry.disarm()
+        journal.disarm()
+        alerts = _alerts(d)
+        stalls = [a for a in alerts
+                  if a["signal"].startswith("beat_stall:decode/")]
+        assert stalls, f"no stall alert; alerts={alerts}"
+        rep = telemetry.health_report(d)
+        assert rep["summary"]["alerts"] >= 1
+        assert rep["summary"]["anomalies"] == 0
+        assert rep["summary"]["attributed_alerts"] \
+            == rep["summary"]["alerts"]
+        assert rep["summary"]["recovery_windows"] >= 1
+
+
+@pytest.mark.skipif(not os.path.isdir(HEALTH_DIR),
+                    reason="committed health recording not present")
+class TestCommittedRecording:
+    def test_committed_journals_still_validate(self):
+        """Satellite pin: the new schema entries must not invalidate
+        any committed artifact journal."""
+        for name in COMMITTED_JOURNAL_DIRS:
+            d = os.path.join(REPO, "benchmarks", name)
+            evs, _ = journal.load_journals(d)
+            for e in evs:
+                assert journal.validate_event(e) == [], (name, e)
+
+    def test_recording_regenerates_byte_identically(self, tmp_path):
+        with open(os.path.join(HEALTH_DIR, "health_report.json"),
+                  "rb") as f:
+            committed = f.read()
+        out = os.path.join(str(tmp_path), "regen.json")
+        path, _ = telemetry.write_health_report(HEALTH_DIR, out=out)
+        with open(path, "rb") as f:
+            assert f.read() == committed
+
+    def test_committed_chaos_attribution(self):
+        rep = telemetry.health_report(HEALTH_DIR)
+        s = rep["summary"]
+        assert s["alerts"] >= 1
+        assert s["anomalies"] == 0
+        assert s["attributed_alerts"] == s["alerts"]
+        assert s["recovery_windows"] >= 1
+        assert any(a["signal"].startswith("beat_stall:decode/")
+                   for a in rep["alerts"])
+
+    def test_bench_doc_pins(self):
+        with open(HEALTH_BENCH) as f:
+            doc = json.load(f)
+        assert doc["health"]["enabled"] is True
+        assert doc["health"]["anomalies"] == 0
+        assert doc["health"]["alerts"] >= 1
+        legs = {leg["name"] for leg in doc["legs"]}
+        assert {"steady", "chaos"} <= legs
+
+    def test_trajectory_row(self):
+        with open(TRAJECTORY) as f:
+            doc = json.load(f)
+        assert "r20_health" in doc
+        assert doc["r20_health"]["anomalies"] == 0
+
+    @pytest.mark.integration
+    def test_bench_cli_regenerates_byte_identically(self, tmp_path):
+        with open(os.path.join(HEALTH_DIR, "health_report.json"),
+                  "rb") as f:
+            committed = f.read()
+        out = os.path.join(str(tmp_path), "regen.json")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["BENCH_HEALTH_REPORT_OUT"] = out
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"),
+             "--health-report"],
+            cwd=REPO, env=env, capture_output=True, text=True,
+            timeout=300)
+        assert r.returncode == 0, r.stdout + r.stderr
+        with open(out, "rb") as f:
+            assert f.read() == committed
